@@ -1,0 +1,24 @@
+"""edl_tpu — a TPU-native elastic deep-learning framework.
+
+A ground-up re-design of the capabilities of PaddlePaddle EDL
+(reference: /root/reference, surveyed in SURVEY.md) for TPU hardware:
+
+- **Elastic collective training**: an elastic launcher coordinates a
+  resizable set of TPU hosts through a coordination store (leader
+  election, TTL-leased membership, stage-keyed barrier), spawns one
+  trainer process per host, and stop-resumes training from Orbax
+  checkpoints whenever membership changes.  Gradient reduction is
+  emitted by XLA from `jax.jit`-sharded graphs over ICI/DCN — there is
+  no NCCL and no graph rewriting.
+- **Service distillation**: students stream minibatches to a fleet of
+  discovered, load-balanced TPU teacher servers running jitted
+  fixed-shape forward passes.
+- **Distributed data service**: a leader-hosted data server slices file
+  lists across pods and rebalances batch ids so elastic pods get even
+  work, with record-range data checkpoints for resume.
+- **Parallelism beyond the reference**: tensor/sequence/expert
+  parallelism and ring attention over a `jax.sharding.Mesh`, expressed
+  as shardings, not process topology.
+"""
+
+__version__ = "0.1.0"

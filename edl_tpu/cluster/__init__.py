@@ -1,0 +1,17 @@
+"""Cluster model: pods, trainers, cluster membership, job/train state.
+
+Reference layer L2 (SURVEY.md §2.2).  A **pod** is one launcher on one
+TPU host; a **trainer** is one spawned training process (normally one
+per host on TPU — all local chips belong to one process — but N-per-pod
+is kept general so CPU simulations and tests can pack several trainers
+on one machine).  The **cluster** is the rank-ordered pod list plus a
+``stage`` id regenerated on every membership change.
+"""
+
+from edl_tpu.cluster.pod import Pod, Trainer
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.env import JobEnv, TrainerEnv
+from edl_tpu.cluster.status import Status
+from edl_tpu.cluster.train_status import TrainStatus
+
+__all__ = ["Pod", "Trainer", "Cluster", "JobEnv", "TrainerEnv", "Status", "TrainStatus"]

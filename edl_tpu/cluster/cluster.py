@@ -1,0 +1,88 @@
+"""Cluster: rank-ordered pods + membership stage.
+
+Reference: python/edl/utils/cluster.py (175).  The ``stage`` is a uuid
+regenerated iff membership changes (cluster.py:137-139); every barrier
+and restart decision keys off it.  Leader = pods[0] (cluster.py:129-135).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlTableError
+from edl_tpu.utils.serialization import JsonSerializable, register_serializable
+
+
+@register_serializable
+class Cluster(JsonSerializable):
+    def __init__(self):
+        self.pods: list[Pod] = []
+        self.stage: str = ""
+
+    def new_stage(self) -> None:
+        self.stage = uuid.uuid4().hex
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_pods(pods: list[Pod]) -> "Cluster":
+        """Rank pods in the given order and renumber trainer global ranks."""
+        c = Cluster()
+        c.pods = pods
+        c.new_stage()
+        base = 0
+        for rank, pod in enumerate(pods):
+            pod.rank = rank
+            pod.stage = c.stage
+            base = pod.update_trainer_global_ranks(base)
+        return c
+
+    # -- queries ------------------------------------------------------------
+    @property
+    def leader(self) -> Pod | None:
+        return self.pods[0] if self.pods else None
+
+    def get_pod(self, pod_id: str) -> Pod | None:
+        return next((p for p in self.pods if p.pod_id == pod_id), None)
+
+    def pod_ids(self) -> list[str]:
+        return [p.pod_id for p in self.pods]
+
+    def get_trainers_endpoints(self) -> list[str]:
+        """All trainer endpoints in global-rank order (cluster.py:61-66)."""
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def get_pods_endpoints(self) -> list[str]:
+        return [p.endpoint for p in self.pods]
+
+    @property
+    def world_size(self) -> int:
+        return sum(p.trainers_num for p in self.pods)
+
+    def same_membership(self, other: "Cluster | None") -> bool:
+        """True iff stage and rank-ordered pod-id list match
+        (the watcher's change predicate, cluster_watcher.py:71-95)."""
+        return (other is not None and self.stage == other.stage
+                and self.pod_ids() == other.pod_ids())
+
+    # -- persistence ---------------------------------------------------------
+    @staticmethod
+    def load_from_store(store, job_id: str) -> "Cluster | None":
+        rec = store.get(paths.key(job_id, constants.ETCD_CLUSTER, "cluster"))
+        if rec is None or not rec.value:
+            return None
+        return Cluster().from_json(rec.value.decode())
+
+    def save_to_store(self, store, job_id: str, leader_pod_id: str) -> bool:
+        """Guarded write: only while ``leader_pod_id`` still holds the seat
+        (reference txn, cluster_generator.py:223-250)."""
+        ok = store.put_if_equals(
+            paths.key(job_id, constants.ETCD_POD_RANK, constants.LEADER_KEY),
+            leader_pod_id.encode(),
+            paths.key(job_id, constants.ETCD_CLUSTER, "cluster"),
+            self.to_json().encode())
+        if not ok:
+            raise EdlTableError(f"pod {leader_pod_id} is no longer leader; cluster not written")
+        return True

@@ -1,0 +1,107 @@
+"""Job/trainer environment: the ``EDL_TPU_*`` env-var ABI.
+
+Reference: python/edl/utils/env.py — ``JobEnv`` parses launcher
+args+env (env authoritative, env.py:33-37); ``TrainerEnv`` is what a
+spawned trainer reads back (env.py:179-229).  The env-var set **is**
+the launcher↔trainer contract (SURVEY.md §1 L3→L4): the launcher never
+touches the training code, it only exports these variables and restarts
+processes.  Where Paddle read ``PADDLE_TRAINER_ID`` /
+``PADDLE_TRAINER_ENDPOINTS``, a TPU trainer reads
+``EDL_TPU_TRAINER_ID`` / ``EDL_TPU_TRAINER_ENDPOINTS`` and boots
+``jax.distributed`` with them (edl_tpu/training/setup.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def from_args_or_env(args, attr: str, env_key: str, default=None):
+    """Env var wins over CLI arg (reference get_from_dict_or_env, env.py:33-37)."""
+    if env_key in os.environ and os.environ[env_key] != "":
+        return os.environ[env_key]
+    v = getattr(args, attr, None) if args is not None else None
+    return v if v is not None else default
+
+
+class JobEnv:
+    """Launcher-side job configuration."""
+
+    def __init__(self, args=None):
+        self.job_id = from_args_or_env(args, "job_id", "EDL_TPU_JOB_ID")
+        assert self.job_id, "job_id required (--job_id or EDL_TPU_JOB_ID)"
+        self.coord_endpoints = from_args_or_env(
+            args, "coord_endpoints", "EDL_TPU_COORD_ENDPOINTS", "127.0.0.1:2379")
+
+        nodes_range = str(from_args_or_env(args, "nodes_range", "EDL_TPU_NODES_RANGE", "1:1"))
+        lo, _, hi = nodes_range.partition(":")
+        self.min_nodes = int(lo)
+        self.max_nodes = int(hi or lo)
+        assert 1 <= self.min_nodes <= self.max_nodes, f"bad nodes_range {nodes_range}"
+
+        self.nproc_per_node = int(from_args_or_env(args, "nproc_per_node",
+                                                   "EDL_TPU_NPROC_PER_NODE", 1))
+        devices = from_args_or_env(args, "devices", "EDL_TPU_DEVICES", "")
+        self.device_ids = [int(d) for d in str(devices).split(",") if d != ""]
+        self.checkpoint_dir = from_args_or_env(args, "checkpoint_dir",
+                                               "EDL_TPU_CKPT_DIR", "")
+        self.log_dir = from_args_or_env(args, "log_dir", "EDL_TPU_LOG_DIR", "./log")
+        self.log_level = from_args_or_env(args, "log_level", "EDL_TPU_LOG_LEVEL", "INFO")
+
+    def export(self) -> dict[str, str]:
+        return {
+            "EDL_TPU_JOB_ID": self.job_id,
+            "EDL_TPU_COORD_ENDPOINTS": self.coord_endpoints,
+            "EDL_TPU_CKPT_DIR": self.checkpoint_dir,
+            "EDL_TPU_LOG_LEVEL": str(self.log_level),
+        }
+
+
+class TrainerEnv:
+    """What a spawned trainer process reads back from its environment."""
+
+    def __init__(self, env: dict[str, str] | None = None):
+        e = env if env is not None else os.environ
+        self.job_id = e.get("EDL_TPU_JOB_ID", "")
+        self.coord_endpoints = e.get("EDL_TPU_COORD_ENDPOINTS", "")
+        self.global_rank = int(e.get("EDL_TPU_TRAINER_ID", "0"))
+        self.rank_in_pod = int(e.get("EDL_TPU_TRAINER_RANK_IN_POD", "0"))
+        eps = e.get("EDL_TPU_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = [p for p in eps.split(",") if p]
+        self.world_size = int(e.get("EDL_TPU_TRAINERS_NUM", "1"))
+        self.coordinator = e.get("EDL_TPU_COORDINATOR", "")
+        self.pod_id = e.get("EDL_TPU_POD_ID", "")
+        self.pod_rank = int(e.get("EDL_TPU_POD_RANK", "0"))
+        self.cluster_stage = e.get("EDL_TPU_CLUSTER_STAGE", "")
+        ids = e.get("EDL_TPU_DEVICE_IDS", "")
+        self.device_ids = [int(d) for d in ids.split(",") if d != ""]
+        self.checkpoint_dir = e.get("EDL_TPU_CKPT_DIR", "")
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    @property
+    def endpoint(self) -> str:
+        if self.trainer_endpoints and self.global_rank < len(self.trainer_endpoints):
+            return self.trainer_endpoints[self.global_rank]
+        return ""
+
+
+def trainer_env_vars(job_env: JobEnv, pod, trainer, cluster) -> dict[str, str]:
+    """Env exported into one trainer subprocess
+    (reference train_process.py:46-56 building PADDLE_* vars)."""
+    endpoints = cluster.get_trainers_endpoints()
+    env = dict(job_env.export())
+    env.update({
+        "EDL_TPU_TRAINER_ID": str(trainer.global_rank),
+        "EDL_TPU_TRAINER_RANK_IN_POD": str(trainer.rank_in_pod),
+        "EDL_TPU_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "EDL_TPU_TRAINERS_NUM": str(len(endpoints)),
+        "EDL_TPU_COORDINATOR": endpoints[0] if endpoints else "",
+        "EDL_TPU_POD_ID": pod.pod_id,
+        "EDL_TPU_POD_RANK": str(pod.rank),
+        "EDL_TPU_CLUSTER_STAGE": cluster.stage,
+        "EDL_TPU_DEVICE_IDS": ",".join(str(d) for d in trainer.device_ids),
+    })
+    return env

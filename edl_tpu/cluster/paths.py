@@ -1,0 +1,19 @@
+"""Key-path schema for the coordination store.
+
+Mirrors the reference's etcd layout ``/<root>/<job_id>/<table>/<key>``
+(python/edl/discovery/etcd_client.py:85 + utils/constants.py:15-23).
+"""
+
+ROOT = "/edl_tpu"
+
+
+def job_prefix(job_id: str) -> str:
+    return f"{ROOT}/{job_id}"
+
+
+def table_prefix(job_id: str, table: str) -> str:
+    return f"{ROOT}/{job_id}/{table}/"
+
+
+def key(job_id: str, table: str, name: str) -> str:
+    return f"{ROOT}/{job_id}/{table}/{name}"

@@ -1,0 +1,97 @@
+"""Pod and Trainer models.
+
+Reference: python/edl/utils/pod.py (181) and trainer.py (55).  A Pod is
+one launcher process on one host: unique id, cluster rank, address, RPC
+port, local device list, and its trainers.  Setting ``pod.rank``
+recomputes every trainer's global rank (pod.py:145-150).  On TPU a pod
+is a slice host and normally carries exactly one trainer owning all
+local chips (JAX is one-process-per-host); ``nproc_per_pod > 1`` is
+used by CPU simulations and tests.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from edl_tpu.utils.serialization import JsonSerializable, register_serializable
+
+
+@register_serializable
+class Trainer(JsonSerializable):
+    def __init__(self, endpoint: str = "", rank_in_pod: int = 0,
+                 global_rank: int = -1, device_ids: list[int] | None = None):
+        self.endpoint = endpoint          # ip:port used as jax.distributed id
+        self.rank_in_pod = rank_in_pod
+        self.global_rank = global_rank
+        self.device_ids = list(device_ids or [])
+
+
+@register_serializable
+class Pod(JsonSerializable):
+    def __init__(self, pod_id: str | None = None, addr: str = "127.0.0.1",
+                 port: int = 0, device_ids: list[int] | None = None):
+        self.pod_id = pod_id or uuid.uuid4().hex
+        self._rank = -1
+        self.addr = addr
+        self.port = port                  # pod RPC server port
+        self.device_ids = list(device_ids or [])
+        self.trainers: list[Trainer] = []
+        self.stage: str = ""              # cluster stage this pod joined at
+
+    # -- rank: assigning it renumbers trainer global ranks ------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @rank.setter
+    def rank(self, value: int) -> None:
+        self._rank = value
+
+    def update_trainer_global_ranks(self, base: int) -> int:
+        """Assign global ranks to this pod's trainers starting at ``base``;
+        returns the next free rank (reference pod.py:145-150)."""
+        for i, t in enumerate(self.trainers):
+            t.rank_in_pod = i
+            t.global_rank = base + i
+        return base + len(self.trainers)
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.addr}:{self.port}"
+
+    @property
+    def trainers_num(self) -> int:
+        return len(self.trainers)
+
+    def make_trainers(self, nproc: int, ports: list[int],
+                      devices_per_proc: list[list[int]] | None = None) -> None:
+        """Build the trainer list (reference Pod.from_env, pod.py:72-103)."""
+        assert len(ports) >= nproc, f"need {nproc} trainer ports, got {len(ports)}"
+        self.trainers = []
+        for i in range(nproc):
+            devs = (devices_per_proc[i] if devices_per_proc
+                    else self._split_devices(nproc)[i])
+            self.trainers.append(Trainer(endpoint=f"{self.addr}:{ports[i]}",
+                                         rank_in_pod=i, device_ids=devs))
+
+    def _split_devices(self, nproc: int) -> list[list[int]]:
+        if not self.device_ids:
+            return [[] for _ in range(nproc)]
+        assert len(self.device_ids) % nproc == 0, (
+            f"{len(self.device_ids)} devices not divisible by {nproc} procs")
+        per = len(self.device_ids) // nproc
+        return [self.device_ids[i * per:(i + 1) * per] for i in range(nproc)]
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["rank"] = self._rank
+        d.pop("_rank", None)
+        return d
+
+    def from_dict(self, d: dict) -> "Pod":
+        if not hasattr(self, "trainers"):  # instance came from __new__
+            self.__init__()
+        d = dict(d)
+        self._rank = d.pop("rank", self._rank)
+        super().from_dict(d)
+        return self

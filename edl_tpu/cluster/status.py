@@ -1,0 +1,48 @@
+"""Pod and job status records in the coordination store.
+
+Reference: python/edl/utils/status.py:36-109.  Each pod writes its
+Status under ``pod_status/<pod_id>``; the singleton job flag lives at
+``job_status/job``.  Unlike the reference (whose job-flag writer only
+ever wrote SUCCEED — SURVEY.md §7 known defects), failure flags are
+written too.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+class Status(str, enum.Enum):
+    INITIAL = "initial"
+    RUNNING = "running"
+    PENDING = "pending"
+    SUCCEED = "succeed"
+    FAILED = "failed"
+
+
+def save_pod_status(store, job_id: str, pod_id: str, status: Status) -> None:
+    store.put(paths.key(job_id, constants.ETCD_POD_STATUS, pod_id),
+              status.value.encode())
+
+
+def load_pod_status(store, job_id: str, pod_id: str) -> Status | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_POD_STATUS, pod_id))
+    return Status(rec.value.decode()) if rec else None
+
+
+def load_pods_status(store, job_id: str) -> dict[str, Status]:
+    recs, _ = store.get_prefix(paths.table_prefix(job_id, constants.ETCD_POD_STATUS))
+    return {r.key.rsplit("/", 1)[-1]: Status(r.value.decode()) for r in recs}
+
+
+def save_job_status(store, job_id: str, status: Status) -> None:
+    store.put(paths.key(job_id, constants.ETCD_JOB_STATUS, "job"),
+              status.value.encode())
+
+
+def load_job_status(store, job_id: str) -> Status | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_JOB_STATUS, "job"))
+    return Status(rec.value.decode()) if rec else None

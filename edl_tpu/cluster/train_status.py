@@ -1,0 +1,42 @@
+"""Per-pod training progress status.
+
+Reference: python/edl/utils/train_status.py.  ``NEARTHEEND`` is the
+anti-meaningless-scaling hook: the generator refuses to add pods once
+training is close to done (doc/edl_collective_design_doc.md:26-29,
+cluster_generator.py:200-215).  The reference had NEARTHEEND and
+SUCCEED share enum value 3 (train_status.py:24-25) — fixed here.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+class TrainStatus(str, enum.Enum):
+    INITIAL = "initial"
+    RUNNING = "running"
+    NEARTHEEND = "neartheend"
+    SUCCEED = "succeed"
+    FAILED = "failed"
+
+
+#: statuses during which the generator may still scale out
+SCALABLE = (TrainStatus.INITIAL, TrainStatus.RUNNING)
+
+
+def save_train_status(store, job_id: str, pod_id: str, status: TrainStatus) -> None:
+    store.put(paths.key(job_id, constants.ETCD_TRAIN_STATUS, pod_id),
+              status.value.encode())
+
+
+def load_train_status(store, job_id: str, pod_id: str) -> TrainStatus | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_TRAIN_STATUS, pod_id))
+    return TrainStatus(rec.value.decode()) if rec else None
+
+
+def load_train_statuses(store, job_id: str) -> dict[str, TrainStatus]:
+    recs, _ = store.get_prefix(paths.table_prefix(job_id, constants.ETCD_TRAIN_STATUS))
+    return {r.key.rsplit("/", 1)[-1]: TrainStatus(r.value.decode()) for r in recs}

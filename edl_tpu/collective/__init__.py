@@ -1,0 +1,8 @@
+"""Elastic collective control plane (reference layer L3a, SURVEY.md §2.3).
+
+``python -m edl_tpu.collective.launch`` runs on every TPU host: it
+advertises the pod in the coordination store, elects a leader, lets the
+leader generate the cluster, barriers on membership, spawns trainer
+processes with the ``EDL_TPU_*`` env ABI, and stop-resumes them from
+checkpoints whenever membership changes.
+"""

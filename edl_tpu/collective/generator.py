@@ -1,0 +1,129 @@
+"""Cluster generator: the leader's reconciliation loop.
+
+Reference: python/edl/utils/cluster_generator.py (272).  Every 3 s the
+leader reads the resource adverts + pod statuses and reconciles the
+cluster record:
+
+- no cluster yet → build one from resource pods, leader rank 0
+  (cluster_generator.py:95-134);
+- a member vanished (TTL expiry) or FAILED → rebuild from the alive
+  set, new stage (:179-192);
+- new INITIAL pods, room under ``max_nodes``, and train status still
+  INITIAL/RUNNING → append them with new ranks, new stage (:136-153,
+  :200-215) — the NEARTHEEND anti-meaningless-scaling rule;
+- alive membership below ``min_nodes`` → log and wait (:255-264).
+
+Every write is the guarded transaction "leader seat still mine"
+(:223-250) so a deposed leader can never clobber its successor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.cluster.status import Status, load_pods_status
+from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
+from edl_tpu.collective.resource import load_resource_pods
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlTableError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class ClusterGenerator(threading.Thread):
+    def __init__(self, store, job_id: str, leader_pod_id: str,
+                 min_nodes: int, max_nodes: int,
+                 period: float = constants.GENERATOR_PERIOD):
+        super().__init__(daemon=True, name=f"generator:{leader_pod_id[:8]}")
+        self._store = store
+        self._job_id = job_id
+        self._leader_id = leader_pod_id
+        self._min_nodes = min_nodes
+        self._max_nodes = max_nodes
+        self._period = period
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.is_set():
+            try:
+                self.reconcile_once()
+            except EdlTableError as e:
+                logger.warning("generator lost leadership mid-write: %s", e)
+                return
+            except Exception:  # noqa: BLE001
+                logger.exception("generator iteration failed")
+            self._halt.wait(self._period)
+
+    def stop(self):
+        self._halt.set()
+
+    # one reconciliation step; factored out for direct unit testing
+    def reconcile_once(self) -> Cluster | None:
+        resource = load_resource_pods(self._store, self._job_id)
+        if self._leader_id not in resource:
+            return None  # our own advert hasn't landed / expired; wait
+        statuses = load_pods_status(self._store, self._job_id)
+        current = Cluster.load_from_store(self._store, self._job_id)
+
+        if current is None:
+            return self._write(self._build_initial(resource))
+
+        alive = [p for p in current.pods
+                 if p.pod_id in resource and statuses.get(p.pod_id) != Status.FAILED]
+        gone = [p for p in current.pods if p.pod_id not in {a.pod_id for a in alive}]
+        # a pod that left after SUCCEEDing is job completion, not a failure —
+        # rebuilding would pointlessly restart the survivors mid-finish
+        lost = any(statuses.get(p.pod_id) != Status.SUCCEED for p in gone)
+
+        any_succeeded = any(s == Status.SUCCEED for s in statuses.values())
+        new_ids = [pid for pid in resource if current.get_pod(pid) is None
+                   and statuses.get(pid, Status.INITIAL) == Status.INITIAL]
+        joiners: list[Pod] = []
+        if new_ids and not any_succeeded and self._scaling_allowed():
+            room = self._max_nodes - len(alive)
+            joiners = [resource[pid] for pid in sorted(new_ids)[:max(0, room)]]
+
+        if not lost and not joiners:
+            return current
+
+        pods = self._leader_first(alive + joiners, resource)
+        if len(pods) < self._min_nodes:
+            logger.error("alive pods %d below min_nodes %d; waiting",
+                         len(pods), self._min_nodes)
+            return current
+        cluster = Cluster.from_pods(pods)
+        logger.info("cluster stage %s: %d pods (%s%s)", cluster.stage[:8], len(pods),
+                    f"-{len(current.pods) - len(alive)} lost " if lost else "",
+                    f"+{len(joiners)} joined" if joiners else "")
+        return self._write(cluster)
+
+    def _scaling_allowed(self) -> bool:
+        """Only scale out while training is INITIAL/RUNNING (NEARTHEEND rule)."""
+        ts = load_train_statuses(self._store, self._job_id)
+        return all(s in SCALABLE for s in ts.values())
+
+    def _build_initial(self, resource: dict[str, Pod]) -> Cluster | None:
+        if len(resource) < self._min_nodes:
+            logger.info("waiting for pods: %d/%d registered",
+                        len(resource), self._min_nodes)
+            return None
+        pods = self._leader_first(list(resource.values()), resource)[:self._max_nodes]
+        cluster = Cluster.from_pods(pods)
+        logger.info("initial cluster stage %s with %d pods", cluster.stage[:8], len(pods))
+        return cluster
+
+    def _leader_first(self, pods: list[Pod], resource: dict[str, Pod]) -> list[Pod]:
+        """Leader pod first (it must be rank 0), stable order for the rest:
+        surviving members keep relative rank order, joiners sort by id."""
+        uniq = {p.pod_id: p for p in pods}
+        leader = uniq.pop(self._leader_id, None) or resource.get(self._leader_id)
+        rest = sorted(uniq.values(), key=lambda p: (p.rank if p.rank >= 0 else 1 << 30, p.pod_id))
+        return ([leader] if leader else []) + rest
+
+    def _write(self, cluster: Cluster | None) -> Cluster | None:
+        if cluster is not None:
+            cluster.save_to_store(self._store, self._job_id, self._leader_id)
+        return cluster

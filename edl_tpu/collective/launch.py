@@ -1,0 +1,77 @@
+"""CLI entry: ``python -m edl_tpu.collective.launch`` — run on every host.
+
+Reference: python/edl/collective/launch.py (the ``edlrun`` console
+script).  Parses args + env into a JobEnv, skips the job if it already
+SUCCEEDed (launch.py:44-47), builds this host's Pod, and runs the
+Launcher until the job finishes or this pod fails.
+
+Example::
+
+    python -m edl_tpu.collective.launch \
+        --job_id imagenet-rn50 --coord_endpoints 10.0.0.2:2379 \
+        --nodes_range 2:8 --nproc_per_node 1 \
+        train.py --epochs 90 --batch_size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from edl_tpu.cluster.env import JobEnv
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.cluster.status import Status, load_job_status
+from edl_tpu.collective.launcher import Launcher
+from edl_tpu.coord.client import connect
+from edl_tpu.utils.logger import configure, get_logger
+from edl_tpu.utils.network import find_free_ports, local_ip
+
+logger = get_logger(__name__)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "edl_tpu.collective.launch",
+        description="Elastic TPU training launcher (one per host)")
+    p.add_argument("--job_id", type=str, default=None)
+    p.add_argument("--coord_endpoints", type=str, default=None,
+                   help="comma-separated coordination-store endpoints")
+    p.add_argument("--nodes_range", type=str, default=None, help="min:max hosts")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--devices", type=str, default=None,
+                   help="comma-separated local device ids (default: all)")
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--log_level", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    job_env = JobEnv(args)
+    configure(job_env.log_level)
+
+    store = connect(job_env.coord_endpoints)
+    if load_job_status(store, job_env.job_id) == Status.SUCCEED:
+        logger.info("job %s already SUCCEED; nothing to do", job_env.job_id)
+        return 0
+
+    pod = Pod(addr=local_ip(), device_ids=job_env.device_ids)
+    pod.make_trainers(job_env.nproc_per_node,
+                      find_free_ports(job_env.nproc_per_node))
+    logger.info("pod %s on %s launching job %s", pod.pod_id, pod.addr, job_env.job_id)
+
+    final = Launcher(job_env, pod, store, args.training_script,
+                     args.script_args).launch()
+    logger.info("pod %s finished with %s", pod.pod_id, final.value)
+    return 0 if final == Status.SUCCEED else 1
+
+
+def main():  # pragma: no cover - thin wrapper
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

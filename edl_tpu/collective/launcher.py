@@ -1,0 +1,195 @@
+"""The elastic launcher: per-host supervisor implementing stop-resume
+elasticity.
+
+Reference: python/edl/utils/launcher.py (261).  Flow (launcher.py:160-246):
+save INITIAL status → start pod RPC server → register resource advert +
+start the leader elector (winner runs the cluster generator) → barrier
+(600 s) → save RUNNING → start the cluster watcher → spawn trainers →
+supervisor loop every 3 s watching {local trainer exit codes, register
+health, membership changes}; on membership change: re-barrier (60 s),
+kill & respawn trainers against the new cluster (trainers resume from
+the latest checkpoint — the stop-resume trick,
+doc/edl_collective_design_doc.md:12); on exit: write the pod flag, the
+leader waits for followers and writes the job flag (launcher.py:100-130).
+"""
+
+from __future__ import annotations
+
+import time
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.env import JobEnv
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.cluster.status import Status, load_pods_status, save_job_status, save_pod_status
+from edl_tpu.collective import pod_client, resource, train_process
+from edl_tpu.collective.generator import ClusterGenerator
+from edl_tpu.collective.leader import LeaderElector
+from edl_tpu.collective.pod_server import start_pod_server
+from edl_tpu.collective.watcher import ClusterWatcher
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class Launcher:
+    def __init__(self, job_env: JobEnv, pod: Pod, store, training_script: str,
+                 script_args: list[str] | None = None,
+                 barrier_timeout: float = constants.BARRIER_TIMEOUT_INIT,
+                 resize_barrier_timeout: float = constants.BARRIER_TIMEOUT_RESIZE,
+                 period: float = constants.SUPERVISOR_PERIOD,
+                 register_ttl: float = constants.ETCD_TTL):
+        self._job_env = job_env
+        self._pod = pod
+        self._store = store
+        self._script = training_script
+        self._script_args = list(script_args or [])
+        self._barrier_timeout = barrier_timeout
+        self._resize_barrier_timeout = resize_barrier_timeout
+        self._period = period
+        self._ttl = register_ttl
+        self._server = None
+        self._resource_register = None
+        self._elector: LeaderElector | None = None
+        self._generator: ClusterGenerator | None = None
+        self._procs: list[train_process.TrainerProc] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def launch(self) -> Status:
+        job_id = self._job_env.job_id
+        save_pod_status(self._store, job_id, self._pod.pod_id, Status.INITIAL)
+        self._server = start_pod_server(self._store, job_id, self._pod.pod_id,
+                                        self._pod.port)
+        self._pod.port = self._server.port
+        try:
+            final = self._run()
+        except Exception:
+            logger.exception("launcher failed")
+            final = Status.FAILED
+        finally:
+            self._shutdown_trainers()
+        self._report_and_cleanup(final)
+        return final
+
+    def _run(self) -> Status:
+        job_id = self._job_env.job_id
+        self._resource_register = resource.register_pod(self._store, job_id,
+                                                        self._pod, ttl=self._ttl)
+        self._elector = LeaderElector(
+            self._store, job_id, self._pod.pod_id,
+            on_become_leader=self._start_generator,
+            on_lose_leader=self._stop_generator, ttl=self._ttl)
+        self._elector.start()
+
+        cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
+                                     timeout=self._barrier_timeout)
+        save_pod_status(self._store, job_id, self._pod.pod_id, Status.RUNNING)
+
+        while True:  # one iteration per cluster generation (stage)
+            self._sync_pod_from(cluster)
+            watcher = ClusterWatcher(self._store, job_id, cluster, self._period)
+            watcher.start()
+            self._procs = train_process.start_trainers(
+                self._job_env, self._pod, cluster, self._script,
+                self._script_args, self._log_dir())
+            try:
+                verdict = self._supervise(watcher)
+            finally:
+                watcher.stop()
+            if verdict is not None:
+                return verdict
+            # membership changed: stop-resume
+            logger.info("membership changed; re-barrier + restart trainers")
+            self._shutdown_trainers()
+            cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
+                                         timeout=self._resize_barrier_timeout)
+
+    def _supervise(self, watcher: ClusterWatcher) -> Status | None:
+        """Returns final status, or None on membership change (resize)."""
+        while True:
+            local = train_process.watch_procs(self._procs)
+            if local == Status.FAILED:
+                return Status.FAILED
+            if local == Status.SUCCEED:
+                return Status.SUCCEED
+            if self._resource_register.is_stopped or self._elector.is_stopped:
+                logger.error("registration lost; failing pod")
+                return Status.FAILED
+            if watcher.changed:
+                return None
+            time.sleep(self._period)
+
+    # -- helpers -------------------------------------------------------------
+    def _sync_pod_from(self, cluster: Cluster) -> None:
+        me = cluster.get_pod(self._pod.pod_id)
+        assert me is not None, "barrier returned a cluster without this pod"
+        me.port = self._pod.port  # keep live RPC port
+        self._pod = me
+
+    def _log_dir(self) -> str:
+        import os
+        return os.path.join(self._job_env.log_dir, self._pod.pod_id[:8])
+
+    def _start_generator(self):
+        self._generator = ClusterGenerator(
+            self._store, self._job_env.job_id, self._pod.pod_id,
+            self._job_env.min_nodes, self._job_env.max_nodes)
+        self._generator.start()
+
+    def _stop_generator(self):
+        if self._generator is not None:
+            self._generator.stop()
+            self._generator = None
+
+    def _shutdown_trainers(self):
+        if self._procs:
+            train_process.terminate_procs(self._procs)
+            self._procs = []
+
+    def _report_and_cleanup(self, final: Status) -> None:
+        job_id = self._job_env.job_id
+        try:
+            save_pod_status(self._store, job_id, self._pod.pod_id, final)
+            if final == Status.FAILED:
+                # provisional: flags the job failed so external watchers see
+                # it (fixes the reference defect of only ever writing
+                # success); a later *leader* completion based on the final
+                # cluster membership overwrites this — a job that recovered
+                # elastically from this pod's death must still end SUCCEED
+                save_job_status(self._store, job_id, Status.FAILED)
+            elif final == Status.SUCCEED and self._elector and self._elector.is_leader:
+                self._leader_final_verdict()
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to write final status")
+        if self._elector:
+            self._elector.stop()
+        self._stop_generator()
+        if self._resource_register:
+            self._resource_register.stop()
+        if self._server:
+            self._server.stop()
+
+    def _leader_final_verdict(self, timeout: float = 60.0) -> None:
+        """Leader exit path (reference launcher.py:100-130): wait for the
+        *current cluster members* to finish, then write the job flag from
+        their statuses alone — pods that failed and were since removed by
+        the generator don't count against a recovered job."""
+        job_id = self._job_env.job_id
+        cluster = Cluster.load_from_store(self._store, job_id)
+        members = set(cluster.pod_ids()) if cluster else {self._pod.pod_id}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            statuses = load_pods_status(self._store, job_id)
+            live = set(resource.load_resource_pods(self._store, job_id))
+            pending = {pid for pid in members
+                       if statuses.get(pid) not in (Status.SUCCEED, Status.FAILED)
+                       and pid in live}
+            pending.discard(self._pod.pod_id)
+            if not pending:
+                break
+            time.sleep(1.0)
+        statuses = load_pods_status(self._store, job_id)
+        if any(statuses.get(pid) == Status.FAILED for pid in members):
+            save_job_status(self._store, job_id, Status.FAILED)
+        else:
+            save_job_status(self._store, job_id, Status.SUCCEED)

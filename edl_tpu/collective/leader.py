@@ -1,0 +1,102 @@
+"""Leader election: seize the ``rank/0`` seat, run the generator while held.
+
+Reference: python/edl/utils/leader_pod.py — the seat is a lease-guarded
+put-if-absent of the pod id (leader_pod.py:57-88); losers retry every
+3 s; leadership is lost when the lease refresh fails (leader failover =
+TTL expiry + another pod's successful seize, tested in
+test_leader_pod.py:45-60).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.collective.resource import load_resource_pods
+from edl_tpu.coord.kv import KVStore
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlRegisterError, EdlTableError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def load_leader_pod(store: KVStore, job_id: str) -> Pod | None:
+    """Resolve the current leader Pod via rank/0 → resource table
+    (reference leader_pod.py:150-165)."""
+    rec = store.get(paths.key(job_id, constants.ETCD_POD_RANK, constants.LEADER_KEY))
+    if rec is None:
+        return None
+    return load_resource_pods(store, job_id).get(rec.value.decode())
+
+
+class LeaderElector(threading.Thread):
+    """Background seize loop.  While this pod holds the seat,
+    ``on_become_leader`` is active (the launcher passes the cluster
+    generator's start/stop)."""
+
+    def __init__(self, store: KVStore, job_id: str, pod_id: str,
+                 on_become_leader=None, on_lose_leader=None,
+                 ttl: float = constants.ETCD_TTL,
+                 retry_period: float = constants.GENERATOR_PERIOD):
+        super().__init__(daemon=True, name=f"leader-elector:{pod_id[:8]}")
+        self._store = store
+        self._job_id = job_id
+        self._pod_id = pod_id
+        self._on_become = on_become_leader
+        self._on_lose = on_lose_leader
+        self._ttl = ttl
+        self._retry_period = retry_period
+        self._halt = threading.Event()
+        self._register: Register | None = None
+        self._is_leader = threading.Event()
+        self._failed: Exception | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._halt.is_set()
+
+    @property
+    def error(self) -> Exception | None:
+        return self._failed
+
+    def run(self):
+        key = paths.key(self._job_id, constants.ETCD_POD_RANK, constants.LEADER_KEY)
+        while not self._halt.is_set():
+            if self._register is None:
+                try:
+                    self._register = Register(self._store, key, self._pod_id.encode(),
+                                              ttl=self._ttl, exclusive=True)
+                    self._is_leader.set()
+                    logger.info("pod %s became leader", self._pod_id)
+                    if self._on_become:
+                        self._on_become()
+                except EdlRegisterError:
+                    pass  # someone else holds the seat; retry
+                except Exception as e:  # noqa: BLE001
+                    self._failed = e
+                    self._halt.set()
+                    return
+            elif self._register.is_stopped:
+                # lost the seat (store unreachable / lease not refreshable)
+                logger.warning("pod %s lost leadership", self._pod_id)
+                self._register = None
+                self._is_leader.clear()
+                if self._on_lose:
+                    self._on_lose()
+            self._halt.wait(self._retry_period)
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5.0)
+        if self._register is not None:
+            self._register.stop()
+            if self._is_leader.is_set() and self._on_lose:
+                self._on_lose()
+            self._is_leader.clear()

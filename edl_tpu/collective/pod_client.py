@@ -1,0 +1,45 @@
+"""Barrier client: poll the current leader's pod server until the
+cluster stage completes.
+
+Reference: python/edl/utils/pod_server_client.py:37-60 — 1 s poll; plus
+launcher.py:175's pattern of resolving the leader pod each attempt so
+leader failover mid-barrier is survived.
+"""
+
+from __future__ import annotations
+
+import time
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.collective.leader import load_leader_pod
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils.exceptions import EdlBarrierError, EdlCoordError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def barrier(store, job_id: str, pod_id: str, timeout: float,
+            period: float = 1.0) -> Cluster:
+    deadline = time.monotonic() + timeout
+    last_err: Exception = EdlBarrierError("barrier never attempted")
+    client: RpcClient | None = None  # pooled across polls; leader rarely moves
+    try:
+        while time.monotonic() < deadline:
+            try:
+                leader = load_leader_pod(store, job_id)
+                if leader is None:
+                    raise EdlBarrierError("no leader elected yet")
+                if client is None or client.endpoint != leader.endpoint:
+                    if client is not None:
+                        client.close()
+                    client = RpcClient(leader.endpoint, timeout=10.0)
+                r = client.call("barrier", job_id=job_id, pod_id=pod_id)
+                return Cluster().from_json(r["cluster"])
+            except (EdlBarrierError, EdlCoordError) as e:
+                last_err = e
+                time.sleep(period)
+        raise EdlBarrierError(f"barrier timed out after {timeout}s: {last_err}")
+    finally:
+        if client is not None:
+            client.close()

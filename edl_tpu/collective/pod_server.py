@@ -1,0 +1,70 @@
+"""Per-pod RPC server; the leader's instance runs the stage barrier.
+
+Reference: python/edl/utils/pod_server.py — ``Barrier`` collects pod
+ids per cluster stage and returns the cluster JSON only once the
+arrived set equals the cluster's pod set (:69-116); otherwise a typed
+retryable error.  ``scale_out``/``scale_in`` mirror the stubs an
+external controller would call (:47-67).  The reference's barrier cache
+never evicted finished stages (:35-38, known defect) — here only the
+current stage's arrivals are kept.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.exceptions import EdlBarrierError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class PodService:
+    def __init__(self, store, job_id: str, pod_id: str):
+        self._store = store
+        self._job_id = job_id
+        self._pod_id = pod_id
+        self._lock = threading.Lock()
+        self._stage: str | None = None
+        self._arrived: set[str] = set()
+
+    def barrier(self, job_id: str, pod_id: str) -> dict:
+        assert job_id == self._job_id, f"wrong job {job_id}"
+        cluster = Cluster.load_from_store(self._store, self._job_id)
+        if cluster is None:
+            raise EdlBarrierError("cluster not generated yet")
+        with self._lock:
+            if self._stage != cluster.stage:  # new stage: evict stale arrivals
+                self._stage = cluster.stage
+                self._arrived = set()
+            members = set(cluster.pod_ids())
+            if pod_id in members:
+                self._arrived.add(pod_id)
+            missing = members - self._arrived
+            if missing:
+                raise EdlBarrierError(
+                    f"barrier stage {cluster.stage[:8]}: {len(self._arrived)}/"
+                    f"{len(members)} arrived, missing {sorted(missing)[:3]}")
+            if pod_id not in members:
+                raise EdlBarrierError(
+                    f"pod {pod_id} not in cluster stage {cluster.stage[:8]}")
+        return {"cluster": cluster.to_json()}
+
+    def scale_out(self, num: int = 1) -> dict:
+        logger.info("scale_out(%d) requested (external controller hook)", num)
+        return {"accepted": True}
+
+    def scale_in(self, num: int = 1) -> dict:
+        logger.info("scale_in(%d) requested (external controller hook)", num)
+        return {"accepted": True}
+
+    def ping(self) -> dict:
+        return {"pod_id": self._pod_id}
+
+
+def start_pod_server(store, job_id: str, pod_id: str, port: int = 0) -> RpcServer:
+    server = RpcServer("0.0.0.0", port)
+    server.register_instance(PodService(store, job_id, pod_id))
+    return server.start()

@@ -1,0 +1,44 @@
+"""Pod resource registry: TTL-leased self-adverts.
+
+Reference: python/edl/utils/resource_pods.py + utils/register.py — each
+pod advertises its JSON under the ``resource`` table with a 15 s lease
+refreshed at ttl/2; vanishing from the table (TTL expiry) **is** the
+failure signal the leader's generator acts on.
+"""
+
+from __future__ import annotations
+
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.coord.kv import KVStore
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+
+
+def register_pod(store: KVStore, job_id: str, pod: Pod,
+                 ttl: float = constants.ETCD_TTL) -> Register:
+    return Register(store, paths.key(job_id, constants.ETCD_POD_RESOURCE, pod.pod_id),
+                    pod.to_json().encode(), ttl=ttl)
+
+
+def load_resource_pods(store: KVStore, job_id: str) -> dict[str, Pod]:
+    recs, _ = store.get_prefix(paths.table_prefix(job_id, constants.ETCD_POD_RESOURCE))
+    pods = {}
+    for r in recs:
+        pod = Pod().from_json(r.value.decode())
+        pods[pod.pod_id] = pod
+    return pods
+
+
+def wait_until_alone(store: KVStore, job_id: str, pod_id: str, timeout: float) -> bool:
+    """Leader exit path: wait until every other pod's advert is gone
+    (reference wait_resource, resource_pods.py:57-71)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods = load_resource_pods(store, job_id)
+        if set(pods) <= {pod_id}:
+            return True
+        time.sleep(1.0)
+    return False

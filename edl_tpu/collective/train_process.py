@@ -1,0 +1,110 @@
+"""Trainer subprocess management: spawn with the env ABI, watch exit
+codes, terminate process trees.
+
+Reference: python/edl/utils/train_process.py — per-trainer env
+(:46-56), proxy vars stripped (:40-42), per-rank ``workerlog.N`` files
+(:115-127), exit-code watch (:130-175), psutil child-tree SIGTERM then
+SIGKILL (:89-112).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+import psutil
+
+from edl_tpu.cluster.env import JobEnv, trainer_env_vars
+from edl_tpu.cluster.status import Status
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_PROXY_VARS = ("http_proxy", "https_proxy", "HTTP_PROXY", "HTTPS_PROXY")
+
+
+@dataclass
+class TrainerProc:
+    proc: subprocess.Popen
+    global_rank: int
+    rank_in_pod: int
+    log_path: str
+
+
+def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
+                   script_args: list[str], log_dir: str) -> list[TrainerProc]:
+    os.makedirs(log_dir, exist_ok=True)
+    procs = []
+    for trainer in pod.trainers:
+        env = dict(os.environ)
+        for var in _PROXY_VARS:
+            env.pop(var, None)
+        env.update(trainer_env_vars(job_env, pod, trainer, cluster))
+        log_path = os.path.join(log_dir, f"workerlog.{trainer.rank_in_pod}")
+        logf = open(log_path, "ab", buffering=0)
+        cmd = [sys.executable, "-u", training_script] + list(script_args)
+        proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()  # child holds its own fd
+        logger.info("spawned trainer global_rank=%d pid=%d log=%s",
+                    trainer.global_rank, proc.pid, log_path)
+        procs.append(TrainerProc(proc, trainer.global_rank, trainer.rank_in_pod, log_path))
+    return procs
+
+
+def watch_procs(procs: list[TrainerProc]) -> Status:
+    """RUNNING while any child lives; FAILED on first nonzero exit;
+    SUCCEED when all exited zero (reference train_process.py:130-175)."""
+    alive = False
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive = True
+        elif ret != 0:
+            logger.error("trainer rank %d exited with %d; tail of %s:\n%s",
+                         tp.global_rank, ret, tp.log_path, _tail(tp.log_path))
+            return Status.FAILED
+    return Status.RUNNING if alive else Status.SUCCEED
+
+
+def terminate_procs(procs: list[TrainerProc], grace: float = 3.0) -> None:
+    """SIGTERM every child's whole process tree, then SIGKILL stragglers
+    (reference train_process.py:89-112)."""
+    victims: list[psutil.Process] = []
+    for tp in procs:
+        try:
+            parent = psutil.Process(tp.proc.pid)
+            victims.extend(parent.children(recursive=True))
+            victims.append(parent)
+        except psutil.NoSuchProcess:
+            continue
+    for p in victims:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except psutil.NoSuchProcess:
+            pass
+    _, survivors = psutil.wait_procs(victims, timeout=grace)
+    for p in survivors:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+    for tp in procs:
+        try:
+            tp.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:  # pragma: no cover - kill-resistant child
+            logger.warning("trainer pid %d did not die", tp.proc.pid)
+
+
+def _tail(path: str, n: int = 30) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 8192))
+            return "\n".join(f.read().decode(errors="replace").splitlines()[-n:])
+    except OSError:
+        return "<no log>"

@@ -1,0 +1,58 @@
+"""Cluster watcher: every pod polls the cluster record for membership
+changes.
+
+Reference: python/edl/utils/cluster_watcher.py — 3 s poll;
+``changed`` is true iff the stage or the rank-ordered pod-id list
+differs from the cluster this watcher was started with (:71-95).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class ClusterWatcher(threading.Thread):
+    def __init__(self, store, job_id: str, cluster: Cluster,
+                 period: float = constants.WATCHER_PERIOD):
+        super().__init__(daemon=True, name="cluster-watcher")
+        self._store = store
+        self._job_id = job_id
+        self._base = cluster
+        self._period = period
+        self._halt = threading.Event()
+        self._changed = threading.Event()
+        self._latest = cluster
+
+    @property
+    def changed(self) -> bool:
+        return self._changed.is_set()
+
+    @property
+    def latest(self) -> Cluster:
+        return self._latest
+
+    def run(self):
+        while not self._halt.wait(self._period):
+            try:
+                cur = Cluster.load_from_store(self._store, self._job_id)
+            except Exception:  # noqa: BLE001 — transient store errors
+                logger.warning("watcher failed to read cluster", exc_info=True)
+                continue
+            if cur is None:
+                continue
+            self._latest = cur
+            if not self._base.same_membership(cur):
+                logger.info("cluster changed: stage %s -> %s",
+                            self._base.stage[:8], cur.stage[:8])
+                self._changed.set()
+                return
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5.0)

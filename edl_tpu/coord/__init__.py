@@ -1,0 +1,21 @@
+"""Coordination store — the framework's etcd-equivalent.
+
+The reference leaned on an external etcd (Go) server via the etcd3
+client (python/edl/discovery/etcd_client.py).  Here the store is
+in-tree: the same semantic surface (TTL leases, put-if-absent
+transactions, guarded puts, revisioned prefix reads, watches) backed by
+
+- :class:`edl_tpu.coord.memory.MemoryKV` — in-process engine, used
+  directly in unit tests and embedded in the servers;
+- ``edl_tpu.coord.server`` — a Python TCP server exposing MemoryKV over
+  the framed-msgpack wire protocol (``python -m edl_tpu.coord.server``);
+- ``native/coordd.cc`` — the production C++ daemon speaking the same
+  protocol (epoll, single-writer); and
+- :class:`edl_tpu.coord.client.CoordClient` — the client, which is what
+  every other subsystem programs against.
+"""
+
+from edl_tpu.coord.kv import KVRecord, KVStore, WatchEvent
+from edl_tpu.coord.memory import MemoryKV
+
+__all__ = ["KVRecord", "KVStore", "WatchEvent", "MemoryKV"]

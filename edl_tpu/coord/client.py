@@ -1,0 +1,98 @@
+"""Coordination-store client: a KVStore over the RPC wire.
+
+Every subsystem programs against :class:`edl_tpu.coord.kv.KVStore`; in
+tests that is a MemoryKV directly, in a job it is this client pointed
+at ``--coord_endpoints`` (reference analog: EtcdClient pointed at
+--etcd_endpoints, python/edl/discovery/etcd_client.py:85).
+"""
+
+from __future__ import annotations
+
+from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
+from edl_tpu.rpc.client import RpcClient
+
+
+def _wire_to_rec(w):
+    return None if w is None else KVRecord(w[0], w[1], w[2], w[3])
+
+
+class CoordClient(KVStore):
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._rpc = RpcClient(endpoint, timeout)
+
+    # -- kv ----------------------------------------------------------------
+    def put(self, key, value, lease_id=0):
+        return self._rpc.call("kv_put", key=key, value=value, lease_id=lease_id)["rev"]
+
+    def get(self, key):
+        return _wire_to_rec(self._rpc.call("kv_get", key=key)["rec"])
+
+    def get_prefix(self, prefix):
+        r = self._rpc.call("kv_range", prefix=prefix)
+        return [_wire_to_rec(w) for w in r["recs"]], r["rev"]
+
+    def delete(self, key):
+        return self._rpc.call("kv_del", key=key)["deleted"]
+
+    def delete_prefix(self, prefix):
+        return self._rpc.call("kv_del_range", prefix=prefix)["n"]
+
+    # -- leases ------------------------------------------------------------
+    def lease_grant(self, ttl):
+        return self._rpc.call("lease_grant", ttl=ttl)["lease_id"]
+
+    def lease_keepalive(self, lease_id):
+        return self._rpc.call("lease_keepalive", lease_id=lease_id)["alive"]
+
+    def lease_revoke(self, lease_id):
+        self._rpc.call("lease_revoke", lease_id=lease_id)
+
+    # -- transactions ------------------------------------------------------
+    def put_if_absent(self, key, value, lease_id=0):
+        return self._rpc.call("txn_put_if_absent", key=key, value=value,
+                              lease_id=lease_id)["succeeded"]
+
+    def put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
+        return self._rpc.call("txn_put_if_equals", guard_key=guard_key,
+                              guard_value=guard_value, key=key, value=value,
+                              lease_id=lease_id)["succeeded"]
+
+    # -- watches -----------------------------------------------------------
+    def wait(self, prefix, since_revision, timeout):
+        r = self._rpc.call("wait", prefix=prefix, since_revision=since_revision,
+                           timeout=timeout, _timeout=timeout + 10.0)
+        return WaitResult([WatchEvent(t, _wire_to_rec(w)) for t, w in r["events"]], r["rev"])
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._rpc.call("ping").get("pong"))
+        except Exception:
+            return False
+
+    def watch_prefix(self, prefix, callback, period: float = 5.0):
+        # dedicated connection so long-polls don't block regular ops
+        return CoordClient(self.endpoint, self._timeout)._watch(prefix, callback, period)
+
+    def _watch(self, prefix, callback, period):
+        return KVStore.watch_prefix(self, prefix, callback, period)
+
+    def close(self):
+        self._rpc.close()
+
+
+def connect(endpoints: str | list[str], timeout: float = 30.0) -> CoordClient:
+    """Connect to the first reachable endpoint of a comma-separated list."""
+    if isinstance(endpoints, str):
+        endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+    last_err: Exception | None = None
+    for ep in endpoints:
+        client = CoordClient(ep, timeout)
+        try:
+            if client.ping():
+                return client
+        except Exception as e:  # pragma: no cover - defensive
+            last_err = e
+        client.close()
+    raise ConnectionError(f"no reachable coordination endpoint in {endpoints}: {last_err}")

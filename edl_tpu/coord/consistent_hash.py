@@ -1,0 +1,66 @@
+"""Consistent hash ring with virtual nodes and copy-on-write updates.
+
+Reference behavior (python/edl/discovery/consistent_hash.py:21-141):
+300 virtual nodes per physical node, MD5 placement, lock-free reads via
+copy-on-write for a single-writer/multi-reader pattern.  Used to shard
+service names across discovery servers (balance_table.py:519-535).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class _Ring:
+    """Immutable snapshot: sorted virtual-node positions → node names."""
+
+    __slots__ = ("points", "owners", "nodes")
+
+    def __init__(self, nodes: list[str], vnodes: int):
+        pairs = sorted(
+            (_hash(f"{node}#{i}"), node) for node in nodes for i in range(vnodes)
+        )
+        self.points = [p for p, _ in pairs]
+        self.owners = [n for _, n in pairs]
+        self.nodes = sorted(nodes)
+
+    def lookup(self, key: str) -> str | None:
+        if not self.points:
+            return None
+        idx = bisect.bisect_right(self.points, _hash(key)) % len(self.points)
+        return self.owners[idx]
+
+
+class ConsistentHash:
+    def __init__(self, nodes: list[str] | None = None, vnodes: int = 300):
+        self._vnodes = vnodes
+        self._lock = threading.Lock()  # writers only; readers grab the snapshot
+        self._ring = _Ring(list(nodes or []), vnodes)
+
+    @property
+    def nodes(self) -> list[str]:
+        return list(self._ring.nodes)
+
+    def add_node(self, node: str) -> None:
+        with self._lock:
+            if node not in self._ring.nodes:
+                self._ring = _Ring(self._ring.nodes + [node], self._vnodes)
+
+    def remove_node(self, node: str) -> None:
+        with self._lock:
+            if node in self._ring.nodes:
+                self._ring = _Ring([n for n in self._ring.nodes if n != node], self._vnodes)
+
+    def set_nodes(self, nodes: list[str]) -> None:
+        with self._lock:
+            self._ring = _Ring(list(dict.fromkeys(nodes)), self._vnodes)
+
+    def get_node(self, key: str) -> str | None:
+        """Owner of ``key`` (reference get_node_nodes, :138-141)."""
+        return self._ring.lookup(key)

@@ -1,0 +1,131 @@
+"""KV-store interface: the contract every backend implements.
+
+Semantics follow what the reference used from etcd3
+(python/edl/discovery/etcd_client.py:85-263):
+
+- flat byte keys, prefix range reads with a store-wide revision;
+- TTL **leases**: keys attached to a lease vanish when it expires;
+  refreshing the lease keeps them alive (registration heartbeats);
+- ``put_if_absent`` — the lease-guarded put-if-absent transaction that
+  the reference built leader election on (etcd_client.py:177-197);
+- ``put_if_equals`` — guarded write used by the cluster generator
+  ("write cluster only if I am still leader",
+  cluster_generator.py:223-250);
+- ``wait`` — long-poll for changes under a prefix since a revision;
+  :meth:`KVStore.watch_prefix` builds callback watches on top of it
+  (etcd_client.py:122-155 watch_service).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class KVRecord:
+    key: str
+    value: bytes
+    revision: int = 0          # store revision of last modification
+    lease_id: int = 0          # 0 = no lease
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str                  # "put" | "delete"
+    record: KVRecord
+
+
+@dataclass
+class WaitResult:
+    events: list[WatchEvent] = field(default_factory=list)
+    revision: int = 0          # store revision as of this response
+
+
+class KVStore:
+    """Abstract coordination store."""
+
+    # -- kv ----------------------------------------------------------------
+    def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[KVRecord]:
+        raise NotImplementedError
+
+    def get_prefix(self, prefix: str) -> tuple[list[KVRecord], int]:
+        """Returns (records sorted by key, store revision)."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> int:
+        raise NotImplementedError
+
+    # -- leases ------------------------------------------------------------
+    def lease_grant(self, ttl: float) -> int:
+        raise NotImplementedError
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        """Refresh; False if the lease already expired/was revoked."""
+        raise NotImplementedError
+
+    def lease_revoke(self, lease_id: int) -> None:
+        raise NotImplementedError
+
+    # -- transactions ------------------------------------------------------
+    def put_if_absent(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        """Atomic create; also succeeds if key holds the same value under
+        the same live lease (idempotent re-seize, cf. etcd_client.py:177-197)."""
+        raise NotImplementedError
+
+    def put_if_equals(self, guard_key: str, guard_value: bytes, key: str, value: bytes,
+                      lease_id: int = 0) -> bool:
+        """Write ``key`` iff ``guard_key`` currently holds ``guard_value``."""
+        raise NotImplementedError
+
+    # -- watches -----------------------------------------------------------
+    def wait(self, prefix: str, since_revision: int, timeout: float) -> WaitResult:
+        """Block until a change under ``prefix`` with revision > since_revision,
+        or timeout; returns buffered events (may be a compacted snapshot
+        marked as puts) and the new revision."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- derived helpers ---------------------------------------------------
+    def watch_prefix(self, prefix: str, callback: Callable[[list[WatchEvent]], None],
+                     period: float = 5.0) -> "PrefixWatcher":
+        """Spawn a thread long-polling ``wait`` and invoking ``callback``."""
+        w = PrefixWatcher(self, prefix, callback, period)
+        w.start()
+        return w
+
+
+class PrefixWatcher(threading.Thread):
+    def __init__(self, store: KVStore, prefix: str, callback, period: float):
+        super().__init__(daemon=True, name=f"watch:{prefix}")
+        self._store = store
+        self._prefix = prefix
+        self._callback = callback
+        self._period = period
+        self._halt = threading.Event()
+        _, self._revision = store.get_prefix(prefix)
+
+    def run(self):
+        while not self._halt.is_set():
+            try:
+                res = self._store.wait(self._prefix, self._revision, self._period)
+            except Exception:
+                if self._halt.is_set():
+                    return
+                self._halt.wait(1.0)
+                continue
+            self._revision = res.revision
+            if res.events:
+                self._callback(res.events)
+
+    def stop(self):
+        self._halt.set()

@@ -1,0 +1,200 @@
+"""In-process KV engine with TTL leases, revisions and wait/watch.
+
+This is the storage engine behind both the Python coordination server
+(`edl_tpu.coord.server`) and in-process unit tests (the reference ran a
+real etcd binary per test — etcd_test.sh; we make the engine importable
+instead so the same tests need no external process).
+
+Concurrency model: one lock + condition variable around a dict; waiters
+block on the condition and replay the bounded event log.  A background
+sweeper expires leases (and their keys) so TTL-failover tests behave
+like real etcd lease expiry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
+
+_EVENT_LOG_CAP = 4096
+
+
+class _Lease:
+    __slots__ = ("ttl", "expires_at", "keys")
+
+    def __init__(self, ttl: float, now: float):
+        self.ttl = ttl
+        self.expires_at = now + ttl
+        self.keys: set[str] = set()
+
+
+class MemoryKV(KVStore):
+    def __init__(self, sweep_period: float = 0.25):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._data: dict[str, KVRecord] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._revision = 0
+        self._next_lease = 1
+        self._events: deque[tuple[int, WatchEvent]] = deque(maxlen=_EVENT_LOG_CAP)
+        self._closed = False
+        self._sweeper = threading.Thread(target=self._sweep_loop, args=(sweep_period,),
+                                         daemon=True, name="memkv-sweeper")
+        self._sweeper.start()
+
+    # -- internal (lock held) ----------------------------------------------
+    def _bump(self) -> int:
+        self._revision += 1
+        return self._revision
+
+    def _emit(self, etype: str, rec: KVRecord):
+        self._events.append((rec.revision, WatchEvent(etype, rec)))
+        self._cond.notify_all()
+
+    def _put_locked(self, key: str, value: bytes, lease_id: int) -> int:
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise KeyError(f"lease {lease_id} not found")
+            lease.keys.add(key)
+        old = self._data.get(key)
+        if old is not None and old.lease_id and old.lease_id != lease_id:
+            ol = self._leases.get(old.lease_id)
+            if ol:
+                ol.keys.discard(key)
+        rec = KVRecord(key, value, self._bump(), lease_id)
+        self._data[key] = rec
+        self._emit("put", rec)
+        return rec.revision
+
+    def _delete_locked(self, key: str) -> bool:
+        rec = self._data.pop(key, None)
+        if rec is None:
+            return False
+        if rec.lease_id:
+            lease = self._leases.get(rec.lease_id)
+            if lease:
+                lease.keys.discard(key)
+        tomb = KVRecord(key, b"", self._bump(), rec.lease_id)
+        self._emit("delete", tomb)
+        return True
+
+    def _expire_locked(self, now: float):
+        dead = [lid for lid, l in self._leases.items() if l.expires_at <= now]
+        for lid in dead:
+            lease = self._leases.pop(lid)
+            for key in list(lease.keys):
+                self._delete_locked(key)
+
+    def _sweep_loop(self, period: float):
+        while True:
+            time.sleep(period)
+            with self._lock:
+                if self._closed:
+                    return
+                self._expire_locked(time.monotonic())
+
+    # -- kv ----------------------------------------------------------------
+    def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            return self._put_locked(key, value, lease_id)
+
+    def get(self, key: str):
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            return self._data.get(key)
+
+    def get_prefix(self, prefix: str):
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            recs = sorted((r for k, r in self._data.items() if k.startswith(prefix)),
+                          key=lambda r: r.key)
+            return recs, self._revision
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            return self._delete_locked(key)
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            keys = [k for k in self._data if k.startswith(prefix)]
+            for k in keys:
+                self._delete_locked(k)
+            return len(keys)
+
+    # -- leases ------------------------------------------------------------
+    def lease_grant(self, ttl: float) -> int:
+        with self._lock:
+            lid = self._next_lease
+            self._next_lease += 1
+            self._leases[lid] = _Lease(ttl, time.monotonic())
+            return lid
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return False
+            lease.expires_at = time.monotonic() + lease.ttl
+            return True
+
+    def lease_revoke(self, lease_id: int) -> None:
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease:
+                for key in list(lease.keys):
+                    self._delete_locked(key)
+
+    # -- transactions ------------------------------------------------------
+    def put_if_absent(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            cur = self._data.get(key)
+            if cur is not None:
+                # idempotent re-seize: same value + same live lease
+                return bool(cur.value == value and lease_id and cur.lease_id == lease_id)
+            self._put_locked(key, value, lease_id)
+            return True
+
+    def put_if_equals(self, guard_key: str, guard_value: bytes, key: str, value: bytes,
+                      lease_id: int = 0) -> bool:
+        with self._lock:
+            self._expire_locked(time.monotonic())
+            cur = self._data.get(guard_key)
+            if cur is None or cur.value != guard_value:
+                return False
+            self._put_locked(key, value, lease_id)
+            return True
+
+    # -- watches -----------------------------------------------------------
+    def wait(self, prefix: str, since_revision: int, timeout: float) -> WaitResult:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._expire_locked(time.monotonic())
+                if (self._events and since_revision < self._events[0][0] - 1
+                        and since_revision < self._revision):
+                    # caller's revision predates the bounded event log
+                    # (compaction): fall back to a full snapshot-as-puts
+                    recs = [r for k, r in self._data.items() if k.startswith(prefix)]
+                    return WaitResult([WatchEvent("put", r) for r in sorted(recs, key=lambda r: r.key)],
+                                      self._revision)
+                evs = [e for rev, e in self._events
+                       if rev > since_revision and e.record.key.startswith(prefix)]
+                if evs:
+                    return WaitResult(evs, self._revision)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return WaitResult([], self._revision)
+                self._cond.wait(min(remaining, 0.25))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True

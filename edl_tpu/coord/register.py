@@ -1,0 +1,123 @@
+"""TTL-leased service registration with background heartbeat.
+
+Reference behavior (python/edl/discovery/register.py:59-96 and
+python/edl/utils/register.py): a server advertises itself under
+``<root>/<service>/nodes/<name>`` on a TTL lease; a daemon thread
+refreshes the lease at ttl/2; if the lease is lost (store restart,
+partition) it re-registers, giving up after a retry budget; optional
+liveness gating probes the advertised endpoint before registering.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from edl_tpu.coord.kv import KVStore
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlRegisterError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def service_key(root: str, service: str, name: str) -> str:
+    return f"{root}/{service}/nodes/{name}"
+
+
+class Register:
+    """Keep ``key=value`` alive in the store until ``stop()``.
+
+    ``on_lost`` (optional) fires if re-registration exhausts its budget —
+    the launcher uses this to fail the pod (reference launcher.py:205-213
+    checks ``is_stopped`` on its registers each supervisor tick).
+    """
+
+    def __init__(self, store: KVStore, key: str, value: bytes,
+                 ttl: float = constants.ETCD_TTL, max_reregister: int = 45,
+                 exclusive: bool = False):
+        self._store = store
+        self._key = key
+        self._value = value
+        self._ttl = ttl
+        self._max_reregister = max_reregister
+        self._exclusive = exclusive
+        self._stop = threading.Event()
+        self._stopped_with_error: Exception | None = None
+        self._lease_id = self._acquire()
+        self._thread = threading.Thread(target=self._heartbeat, daemon=True,
+                                        name=f"register:{key}")
+        self._thread.start()
+
+    def _acquire(self) -> int:
+        lease_id = self._store.lease_grant(self._ttl)
+        if self._exclusive:
+            if not self._store.put_if_absent(self._key, self._value, lease_id):
+                self._store.lease_revoke(lease_id)
+                raise EdlRegisterError(f"key {self._key} already held")
+        else:
+            self._store.put(self._key, self._value, lease_id)
+        return lease_id
+
+    def _heartbeat(self):
+        period = self._ttl * constants.TTL_REFRESH_FRACTION
+        failures = 0
+        while not self._stop.wait(period):
+            try:
+                if self._store.lease_keepalive(self._lease_id):
+                    failures = 0
+                    continue
+                if self._exclusive:
+                    # an exclusive seat whose lease lapsed may already belong
+                    # to someone else; a silent re-seize here would bypass the
+                    # owner's on-lose/on-become lifecycle (leader election), so
+                    # stop immediately and let the owner re-contend
+                    self._stopped_with_error = EdlRegisterError(
+                        f"exclusive key {self._key}: lease lost")
+                    self._stop.set()
+                    return
+                # plain advert: try a fresh registration
+                self._lease_id = self._acquire()
+                failures = 0
+                logger.info("re-registered %s after lost lease", self._key)
+            except EdlRegisterError as e:
+                self._stopped_with_error = e
+                self._stop.set()
+                return
+            except Exception as e:  # noqa: BLE001
+                failures += 1
+                logger.warning("heartbeat for %s failed (%d/%d): %s",
+                               self._key, failures, self._max_reregister, e)
+                if failures >= self._max_reregister:
+                    self._stopped_with_error = EdlRegisterError(
+                        f"lost registration {self._key}: {e}")
+                    self._stop.set()
+                    return
+
+    def update(self, value: bytes) -> None:
+        self._value = value
+        self._store.put(self._key, value, self._lease_id)
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def error(self) -> Exception | None:
+        return self._stopped_with_error
+
+    def stop(self, revoke: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if revoke:
+            try:
+                self._store.lease_revoke(self._lease_id)
+            except Exception:  # noqa: BLE001 — best effort on shutdown
+                pass
+
+    def stop_heartbeat_only(self) -> None:
+        """Test hook: stop refreshing but keep the lease until TTL expiry
+        (how the reference's leader-failover test kills a leader,
+        test_leader_pod.py:45-60)."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,94 @@
+"""Coordination server: MemoryKV exposed over the framed-msgpack RPC.
+
+Run standalone (``python -m edl_tpu.coord.server --port 2379``) the way
+the reference's tests booted a local etcd binary (etcd_test.sh), or
+embed via :func:`start_server`.  The native C++ daemon
+(native/coordd.cc) serves the identical method set/wire format and is a
+drop-in replacement for production.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from edl_tpu.coord.memory import MemoryKV
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.logger import configure, get_logger
+
+logger = get_logger(__name__)
+
+
+def _rec_to_wire(rec):
+    return None if rec is None else [rec.key, rec.value, rec.revision, rec.lease_id]
+
+
+class CoordService:
+    """RPC facade over a KVStore; method names are the wire protocol."""
+
+    def __init__(self, kv: MemoryKV):
+        self._kv = kv
+
+    def kv_put(self, key, value, lease_id=0):
+        return {"rev": self._kv.put(key, value, lease_id)}
+
+    def kv_get(self, key):
+        return {"rec": _rec_to_wire(self._kv.get(key))}
+
+    def kv_range(self, prefix):
+        recs, rev = self._kv.get_prefix(prefix)
+        return {"recs": [_rec_to_wire(r) for r in recs], "rev": rev}
+
+    def kv_del(self, key):
+        return {"deleted": self._kv.delete(key)}
+
+    def kv_del_range(self, prefix):
+        return {"n": self._kv.delete_prefix(prefix)}
+
+    def lease_grant(self, ttl):
+        return {"lease_id": self._kv.lease_grant(ttl)}
+
+    def lease_keepalive(self, lease_id):
+        return {"alive": self._kv.lease_keepalive(lease_id)}
+
+    def lease_revoke(self, lease_id):
+        self._kv.lease_revoke(lease_id)
+        return {}
+
+    def txn_put_if_absent(self, key, value, lease_id=0):
+        return {"succeeded": self._kv.put_if_absent(key, value, lease_id)}
+
+    def txn_put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
+        return {"succeeded": self._kv.put_if_equals(guard_key, guard_value, key, value, lease_id)}
+
+    def wait(self, prefix, since_revision, timeout):
+        res = self._kv.wait(prefix, since_revision, min(float(timeout), 60.0))
+        return {"events": [[e.type, _rec_to_wire(e.record)] for e in res.events],
+                "rev": res.revision}
+
+    def ping(self):
+        return {"pong": True}
+
+
+def start_server(host: str = "0.0.0.0", port: int = 0, kv: MemoryKV | None = None) -> RpcServer:
+    server = RpcServer(host, port)
+    server.register_instance(CoordService(kv or MemoryKV()))
+    return server.start()
+
+
+def main():
+    parser = argparse.ArgumentParser("edl_tpu coordination server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=2379)
+    args = parser.parse_args()
+    configure()
+    server = start_server(args.host, args.port)
+    logger.info("coordination server listening on %s", server.endpoint)
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""In-tree RPC: length-prefixed msgpack frames over TCP.
+
+The reference used three different RPC stacks (gRPC for pod/data/
+discovery servers, bRPC inside paddle-serving, and a hand-rolled epoll
+protocol for the redis balance server — SURVEY.md §5).  Here one small
+stack serves every control-plane and data-plane service; the wire format
+(``framing.py``) is simple enough that the native C++ coordination
+daemon (native/coordd.cc) speaks it too.
+"""
+
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+
+__all__ = ["RpcClient", "RpcServer"]

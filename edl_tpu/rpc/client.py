@@ -1,0 +1,71 @@
+"""RPC client: sync request/response over one pooled connection.
+
+Transport failures surface as :class:`EdlCoordError` (retryable) so
+callers can wrap calls in ``retry_until_timeout`` — the reference's
+pattern of decorating every client RPC with
+``handle_errors_until_timeout`` (python/edl/utils/data_server_client.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from edl_tpu.rpc import framing
+from edl_tpu.utils import exceptions
+from edl_tpu.utils.network import split_endpoint
+
+
+class RpcClient:
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        host, port = split_endpoint(self.endpoint)
+        sock = socket.create_connection((host or "127.0.0.1", port), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, _timeout: float | None = None, **kwargs):
+        """Invoke ``method`` remotely; returns the result payload.
+
+        Retries the transport once on a broken pooled connection, then
+        raises EdlCoordError for callers' retry loops.
+        """
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.settimeout(_timeout if _timeout is not None else self._timeout)
+                    framing.send_frame(self._sock, {"m": method, "a": kwargs})
+                    resp = framing.recv_frame(self._sock)
+                    break
+                except (OSError, framing.FramingError) as e:
+                    self._close_locked()
+                    if attempt == 1:
+                        raise exceptions.EdlCoordError(
+                            f"rpc {method} to {self.endpoint} failed: {e}") from e
+        exceptions.deserialize(resp["s"])
+        return resp["r"]
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,53 @@
+"""Wire format: ``b"EDL1" | u32_be length | msgpack payload``.
+
+Message caps default to 1 GiB, matching the reference's gRPC limits
+(python/edl/utils/pod_server.py:130-137).  This module is the protocol
+spec — the C++ daemon implements exactly this framing.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import msgpack
+
+MAGIC = b"EDL1"
+MAX_FRAME = 1 << 30
+_HEADER = struct.Struct(">4sI")
+
+
+class FramingError(ConnectionError):
+    pass
+
+
+def pack(obj) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    if len(body) > MAX_FRAME:
+        raise FramingError(f"frame too large: {len(body)}")
+    return _HEADER.pack(MAGIC, len(body)) + body
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    sock.sendall(pack(obj))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise FramingError("connection closed mid-frame" if buf else "connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket):
+    header = _recv_exact(sock, _HEADER.size)
+    magic, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FramingError(f"bad magic {magic!r}")
+    if length > MAX_FRAME:
+        raise FramingError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)

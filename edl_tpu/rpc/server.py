@@ -1,0 +1,97 @@
+"""Threaded RPC server.
+
+Request envelope:  ``{"m": method, "a": {kwargs}}``
+Response envelope: ``{"s": null|{"type","detail"}, "r": {result}}``
+
+Typed ``EdlError``s raised by handlers cross the wire and re-raise
+client-side (see edl_tpu/utils/exceptions.py, mirroring the reference's
+proto-Status error contract).  One thread per connection — every
+service here is control-plane (barriers, discovery, batch metadata), so
+connection counts are O(pods + teachers).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+from edl_tpu.rpc import framing
+from edl_tpu.utils import exceptions
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                msg = framing.recv_frame(self.request)
+            except (framing.FramingError, OSError):
+                return
+            try:
+                method = msg["m"]
+                fn = self.server.methods[method]  # type: ignore[attr-defined]
+            except KeyError:
+                framing.send_frame(self.request, {
+                    "s": {"type": "EdlInternalError", "detail": f"no such method {msg.get('m')!r}"},
+                    "r": None})
+                continue
+            try:
+                result = fn(**(msg.get("a") or {}))
+                resp = {"s": None, "r": result}
+            except Exception as e:  # noqa: BLE001 — serialize everything
+                if not isinstance(e, exceptions.EdlRetryableError):
+                    logger.warning("handler %s raised", method, exc_info=True)
+                resp = {"s": exceptions.serialize(e), "r": None}
+            try:
+                framing.send_frame(self.request, resp)
+            except OSError:
+                return
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RpcServer:
+    """Register methods, then ``start()``; ``endpoint`` gives ip:port."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._server = _TcpServer((host, port), _Handler)
+        self._server.methods = {}  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    def register(self, method: str, fn) -> None:
+        self._server.methods[method] = fn  # type: ignore[attr-defined]
+
+    def register_instance(self, obj) -> None:
+        """Expose every public method of ``obj``."""
+        for name in dir(obj):
+            if not name.startswith("_") and callable(getattr(obj, name)):
+                self.register(name, getattr(obj, name))
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        from edl_tpu.utils.network import local_ip
+        host = self._server.server_address[0]
+        if host in ("0.0.0.0", ""):
+            host = local_ip()
+        return f"{host}:{self.port}"
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name=f"rpc:{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,49 @@
+"""Coordination-store table names and timing constants.
+
+Reference: python/edl/utils/constants.py:15-39.  The table set is the
+same contract: a job's coordination state lives under
+``/edl_tpu/<job_id>/<table>/...``.
+"""
+
+# tables (key prefixes under the job root)
+ETCD_POD_RESOURCE = "resource"      # live pod adverts (TTL-leased)
+ETCD_POD_RANK = "rank"              # leader seat lives at rank/0
+ETCD_POD_STATUS = "pod_status"      # per-pod Status
+ETCD_JOB_STATUS = "job_status"      # singleton job flag
+ETCD_TRAIN_STATUS = "train_status"  # per-pod TrainStatus
+ETCD_CLUSTER = "cluster"            # the generated Cluster JSON
+ETCD_READER = "reader"              # distributed-reader registry
+ETCD_STATE = "state"                # train State (data checkpoint etc.)
+ETCD_DIST_READER = "dist_reader"
+
+ALL_TABLES = [
+    ETCD_POD_RESOURCE,
+    ETCD_POD_RANK,
+    ETCD_POD_STATUS,
+    ETCD_JOB_STATUS,
+    ETCD_TRAIN_STATUS,
+    ETCD_CLUSTER,
+    ETCD_READER,
+    ETCD_STATE,
+    ETCD_DIST_READER,
+]
+
+LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
+
+# timing (reference constants.py:26 + register.py:59-68); every value is
+# env-overridable so integration tests can run with sub-second TTLs the
+# way the reference's tests ran a dedicated fast etcd
+import os as _os
+
+
+def _f(env: str, default: float) -> float:
+    return float(_os.environ.get(env, default))
+
+
+ETCD_TTL = _f("EDL_TPU_TTL", 15)                  # registration lease TTL (s)
+TTL_REFRESH_FRACTION = 0.5                        # refresh at ttl/2
+GENERATOR_PERIOD = _f("EDL_TPU_GENERATOR_PERIOD", 3.0)
+WATCHER_PERIOD = _f("EDL_TPU_WATCHER_PERIOD", 3.0)
+SUPERVISOR_PERIOD = _f("EDL_TPU_SUPERVISOR_PERIOD", 3.0)
+BARRIER_TIMEOUT_INIT = _f("EDL_TPU_BARRIER_TIMEOUT", 600.0)    # launcher.py:175
+BARRIER_TIMEOUT_RESIZE = _f("EDL_TPU_RESIZE_BARRIER_TIMEOUT", 60.0)

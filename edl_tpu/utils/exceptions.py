@@ -1,0 +1,101 @@
+"""Typed error hierarchy that survives RPC boundaries.
+
+Reference behavior (python/edl/utils/exceptions.py:20-117): servers
+serialize the exception *class name* plus detail into the response
+status; clients re-raise the same typed exception.  We keep that
+contract — an error raised inside a remote servicer arrives at the
+caller as the same Python type — but serialize to a plain dict carried
+in the RPC envelope instead of a proto ``Status``.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class EdlError(Exception):
+    """Base class for all framework errors."""
+
+
+class EdlRetryableError(EdlError):
+    """Base for errors that callers may retry (transient cluster states)."""
+
+
+# -- coordination / cluster lifecycle ---------------------------------------
+class EdlCoordError(EdlRetryableError):
+    """Coordination-store communication failed."""
+
+
+class EdlBarrierError(EdlRetryableError):
+    """Barrier not yet complete (some stage members missing)."""
+
+
+class EdlLeaderChangedError(EdlRetryableError):
+    """The leader lost its seat mid-operation."""
+
+
+class EdlTableError(EdlRetryableError):
+    """A coordination-store table is missing or malformed."""
+
+
+class EdlRegisterError(EdlRetryableError):
+    """TTL-leased registration could not be established/refreshed."""
+
+
+class EdlStopIteration(EdlError):
+    """Remote signals end-of-data (maps to StopIteration client-side)."""
+
+
+# -- data plane -------------------------------------------------------------
+class EdlDataError(EdlRetryableError):
+    """Data-server state not ready (e.g. balanced metas not computed)."""
+
+
+class EdlFileListNotMatchError(EdlError):
+    """Pod's file-list slice doesn't match the checkpointed one."""
+
+
+# -- hard failures ----------------------------------------------------------
+class EdlInternalError(EdlError):
+    """Unexpected server-side failure (carries remote traceback)."""
+
+
+class EdlUnauthorizedError(EdlError):
+    """Token mismatch on a discovery register call."""
+
+
+_REGISTRY = {
+    cls.__name__: cls
+    for cls in (
+        EdlError,
+        EdlRetryableError,
+        EdlCoordError,
+        EdlBarrierError,
+        EdlLeaderChangedError,
+        EdlTableError,
+        EdlRegisterError,
+        EdlStopIteration,
+        EdlDataError,
+        EdlFileListNotMatchError,
+        EdlInternalError,
+        EdlUnauthorizedError,
+    )
+}
+
+
+def serialize(exc: BaseException) -> dict:
+    """Exception → wire dict (mirrors exceptions.py:95-106 serialize)."""
+    if isinstance(exc, EdlError):
+        return {"type": type(exc).__name__, "detail": str(exc)}
+    return {
+        "type": "EdlInternalError",
+        "detail": "".join(traceback.format_exception(exc)),
+    }
+
+
+def deserialize(status: dict | None) -> None:
+    """Wire dict → raise typed exception; no-op on OK (exceptions.py:108-117)."""
+    if not status:
+        return
+    cls = _REGISTRY.get(status.get("type", ""), EdlInternalError)
+    raise cls(status.get("detail", ""))

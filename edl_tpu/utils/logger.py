@@ -1,0 +1,39 @@
+"""Per-module loggers (reference: python/edl/utils/log_utils.py:20-32).
+
+Unlike the reference we never call ``logging.basicConfig`` at import time
+(that would hijack the root logger of embedding applications); each
+module asks for a namespaced logger and the CLI entry points install the
+handler.
+"""
+
+import logging
+import os
+import sys
+
+_FMT = "%(asctime)s %(levelname)s [%(name)s:%(lineno)d] %(message)s"
+
+
+def get_logger(name: str, level: int | str | None = None) -> logging.Logger:
+    logger = logging.getLogger(f"edl_tpu.{name}" if not name.startswith("edl_tpu") else name)
+    if level is not None:
+        logger.setLevel(level)
+    return logger
+
+
+def configure(level: str | None = None, log_dir: str | None = None, filename: str | None = None) -> None:
+    """Install a stderr (and optional file) handler on the edl_tpu root logger.
+
+    Called by CLI entry points (launcher, servers), never by library code.
+    """
+    level = level or os.environ.get("EDL_TPU_LOG_LEVEL", "INFO")
+    root = logging.getLogger("edl_tpu")
+    root.setLevel(level)
+    if not any(isinstance(h, logging.StreamHandler) for h in root.handlers):
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FMT))
+        root.addHandler(handler)
+    if log_dir and filename:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(log_dir, filename))
+        fh.setFormatter(logging.Formatter(_FMT))
+        root.addHandler(fh)

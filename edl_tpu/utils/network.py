@@ -1,0 +1,60 @@
+"""Socket helpers: free-port finder and TCP liveness probe.
+
+Reference: python/edl/utils/network_utils.py (free port) and
+python/edl/discovery/server_alive.py:19-34 (1.5 s connect probe).
+"""
+
+from __future__ import annotations
+
+import socket
+from contextlib import closing
+
+ALIVE_PROBE_TIMEOUT = 1.5
+
+
+def find_free_port() -> int:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def find_free_ports(n: int) -> list[int]:
+    """Reserve n distinct free ports (best effort; tiny race window)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        return ports
+    finally:
+        for s in socks:
+            s.close()
+
+
+def split_endpoint(endpoint: str) -> tuple[str, int]:
+    host, _, port = endpoint.rpartition(":")
+    return host, int(port)
+
+
+def is_server_alive(endpoint: str, timeout: float = ALIVE_PROBE_TIMEOUT) -> tuple[bool, str | None]:
+    """TCP-connect probe; returns (alive, local_ip_used_to_reach_it)."""
+    host, port = split_endpoint(endpoint)
+    try:
+        with closing(socket.create_connection((host, port), timeout=timeout)) as s:
+            return True, s.getsockname()[0]
+    except OSError:
+        return False, None
+
+
+def local_ip(probe_endpoint: str | None = None) -> str:
+    """Best-effort local IP (UDP-connect trick; no traffic sent)."""
+    try:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+            s.connect((probe_endpoint or "8.8.8.8", 53))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

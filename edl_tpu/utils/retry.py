@@ -1,0 +1,42 @@
+"""Retry-until-timeout decorator.
+
+Reference: python/edl/utils/error_utils.py:20-39
+(``handle_errors_until_timeout``).  Retryable framework errors are
+swallowed and retried on an interval until ``timeout`` seconds elapse,
+then the last error propagates.  Non-retryable errors propagate
+immediately.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+from edl_tpu.utils.exceptions import EdlRetryableError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def retry_until_timeout(func=None, *, interval: float = 1.0):
+    """Decorate ``func(..., timeout=N)`` to retry EdlRetryableError.
+
+    The wrapped function must accept a ``timeout`` keyword (seconds).
+    """
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, timeout: float = 60.0, **kwargs):
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    return f(*args, **kwargs)
+                except EdlRetryableError as e:
+                    if time.monotonic() >= deadline:
+                        raise
+                    logger.debug("retrying %s after %s: %s", f.__name__, type(e).__name__, e)
+                    time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+
+        return wrapper
+
+    return decorate(func) if func is not None else decorate

@@ -1,0 +1,39 @@
+"""Inert demo trainer for launcher integration tests.
+
+Reference: python/edl/tests/unittests/launch_demo.py — reads the env
+ABI, optionally sleeps, exits with an injected code
+(``EDL_TPU_DEMO_EXIT_CODE``).  Also appends one line per start to
+``EDL_TPU_DEMO_MARKER`` so tests can count restarts, and can sleep
+longer while solo (``EDL_TPU_DEMO_SLEEP_SOLO``) so elastic-resize tests
+get a stable join window.
+"""
+
+import os
+import sys
+import time
+
+from edl_tpu.cluster.env import TrainerEnv
+
+
+def main():
+    te = TrainerEnv()
+    marker = os.environ.get("EDL_TPU_DEMO_MARKER", "")
+    if marker:
+        with open(marker, "a") as f:
+            f.write(f"start world={te.world_size} rank={te.global_rank} "
+                    f"stage={te.cluster_stage}\n")
+    print(f"demo trainer rank={te.global_rank}/{te.world_size} "
+          f"pod={te.pod_id[:8]} stage={te.cluster_stage[:8]}", flush=True)
+
+    sleep = float(os.environ.get("EDL_TPU_DEMO_SLEEP", "1"))
+    if te.world_size <= 1:
+        sleep = float(os.environ.get("EDL_TPU_DEMO_SLEEP_SOLO", sleep))
+    time.sleep(sleep)
+
+    code = int(os.environ.get("EDL_TPU_DEMO_EXIT_CODE", "0"))
+    print(f"demo trainer rank={te.global_rank} exiting {code}", flush=True)
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Hash-ring balance and monotonicity (reference test_consistent_hash.py:21-80)."""
+
+from collections import Counter
+
+from edl_tpu.coord.consistent_hash import ConsistentHash
+
+
+def test_balance_and_monotonicity():
+    nodes = [f"10.0.0.{i}:900{i}" for i in range(3)]
+    ring = ConsistentHash(nodes)
+    keys = [f"service-{i}" for i in range(10000)]
+    owners = {k: ring.get_node(k) for k in keys}
+    counts = Counter(owners.values())
+    assert set(counts) == set(nodes)
+    # reference asserts >3000/10000 per node on a 3-node ring
+    assert min(counts.values()) > 2000
+
+    # removing a node only moves that node's keys
+    ring.remove_node(nodes[0])
+    for k, old in owners.items():
+        new = ring.get_node(k)
+        if old != nodes[0]:
+            assert new == old
+        else:
+            assert new in nodes[1:]
+
+    # re-adding restores the original assignment
+    ring.add_node(nodes[0])
+    assert all(ring.get_node(k) == owners[k] for k in keys)
+
+
+def test_empty_ring():
+    assert ConsistentHash().get_node("x") is None

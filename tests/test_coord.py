@@ -1,0 +1,138 @@
+"""Coordination store: KV semantics, leases, transactions, watches,
+TTL-leased registration — over both the in-process engine and the TCP
+server (the reference ran these against a real etcd; etcd_test.sh)."""
+
+import time
+
+import pytest
+
+from edl_tpu.coord.memory import MemoryKV
+from edl_tpu.coord.register import Register
+from edl_tpu.utils.exceptions import EdlRegisterError
+
+
+@pytest.fixture(params=["memory", "tcp"])
+def kv(request, memkv, coord_client):
+    return memkv if request.param == "memory" else coord_client
+
+
+def test_put_get_delete(kv):
+    rev1 = kv.put("/a/b", b"1")
+    rev2 = kv.put("/a/c", b"2")
+    assert rev2 > rev1
+    assert kv.get("/a/b").value == b"1"
+    assert kv.get("/missing") is None
+    recs, rev = kv.get_prefix("/a/")
+    assert [r.key for r in recs] == ["/a/b", "/a/c"]
+    assert rev >= rev2
+    assert kv.delete("/a/b") is True
+    assert kv.delete("/a/b") is False
+    assert kv.delete_prefix("/a/") == 1
+    assert kv.get_prefix("/a/")[0] == []
+
+
+def test_lease_expiry_removes_keys(kv):
+    lid = kv.lease_grant(0.4)
+    kv.put("/lease/k", b"v", lid)
+    assert kv.get("/lease/k") is not None
+    time.sleep(1.0)
+    assert kv.get("/lease/k") is None
+    assert kv.lease_keepalive(lid) is False
+
+
+def test_lease_keepalive_extends(kv):
+    lid = kv.lease_grant(0.6)
+    kv.put("/ka/k", b"v", lid)
+    for _ in range(4):
+        time.sleep(0.25)
+        assert kv.lease_keepalive(lid) is True
+    assert kv.get("/ka/k") is not None
+    kv.lease_revoke(lid)
+    assert kv.get("/ka/k") is None
+
+
+def test_put_if_absent_leader_semantics(kv):
+    l1 = kv.lease_grant(5)
+    l2 = kv.lease_grant(5)
+    assert kv.put_if_absent("/rank/0", b"pod-A", l1) is True
+    # loser
+    assert kv.put_if_absent("/rank/0", b"pod-B", l2) is False
+    # idempotent re-seize by the holder (same value, same lease)
+    assert kv.put_if_absent("/rank/0", b"pod-A", l1) is True
+    # holder dies -> seat free
+    kv.lease_revoke(l1)
+    assert kv.put_if_absent("/rank/0", b"pod-B", l2) is True
+
+
+def test_put_if_equals_guarded_write(kv):
+    kv.put("/rank/0", b"leader-A")
+    assert kv.put_if_equals("/rank/0", b"leader-A", "/cluster", b"c1") is True
+    assert kv.get("/cluster").value == b"c1"
+    assert kv.put_if_equals("/rank/0", b"leader-B", "/cluster", b"c2") is False
+    assert kv.get("/cluster").value == b"c1"
+
+
+def test_wait_sees_puts_and_deletes(kv):
+    _, rev = kv.get_prefix("/w/")
+    kv.put("/w/a", b"1")
+    kv.delete("/w/a")
+    res = kv.wait("/w/", rev, timeout=2.0)
+    assert [e.type for e in res.events] == ["put", "delete"]
+    # no further events -> timeout path returns empty
+    res2 = kv.wait("/w/", res.revision, timeout=0.2)
+    assert res2.events == []
+
+
+def test_watch_prefix_callback(kv):
+    seen = []
+    watcher = kv.watch_prefix("/svc/", lambda evs: seen.extend(evs), period=0.5)
+    time.sleep(0.2)
+    kv.put("/svc/n1", b"x")
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.05)
+    watcher.stop()
+    assert seen and seen[0].record.key == "/svc/n1"
+
+
+def test_register_keeps_key_alive_then_ttl_failover(kv):
+    reg = Register(kv, "/root/job/resource/p0", b"pod0", ttl=0.6)
+    time.sleep(1.5)  # several TTLs: heartbeat must keep it alive
+    assert kv.get("/root/job/resource/p0").value == b"pod0"
+    assert not reg.is_stopped
+    # simulate pod death the way the reference's leader test does:
+    # stop refreshing, lease expires, key vanishes
+    reg.stop_heartbeat_only()
+    time.sleep(1.2)
+    assert kv.get("/root/job/resource/p0") is None
+
+
+def test_exclusive_register_conflict(kv):
+    reg = Register(kv, "/x/rank/0", b"A", ttl=2.0, exclusive=True)
+    with pytest.raises(EdlRegisterError):
+        Register(kv, "/x/rank/0", b"B", ttl=2.0, exclusive=True)
+    reg.stop()
+    reg2 = Register(kv, "/x/rank/0", b"B", ttl=2.0, exclusive=True)
+    reg2.stop()
+
+
+def test_exclusive_register_stops_on_lost_seat(memkv):
+    """A deposed exclusive holder must stop immediately (leader election
+    depends on prompt on-lose), never silently re-seize."""
+    reg = Register(memkv, "/seat/0", b"A", ttl=0.6, exclusive=True)
+    memkv.lease_revoke(reg._lease_id)  # simulate expiry + takeover window
+    memkv.put("/seat/0", b"B")         # usurper
+    deadline = time.time() + 5
+    while not reg.is_stopped and time.time() < deadline:
+        time.sleep(0.05)
+    assert reg.is_stopped and reg.error is not None
+    assert memkv.get("/seat/0").value == b"B"  # usurper untouched
+
+
+def test_wait_compaction_snapshot(memkv):
+    # blow past the event-log capacity; an old revision must get a snapshot
+    memkv.put("/c/live", b"v")
+    for i in range(5000):
+        memkv.put("/junk/k", str(i).encode())
+    res = memkv.wait("/c/", 0, timeout=0.5)
+    assert any(e.record.key == "/c/live" for e in res.events)

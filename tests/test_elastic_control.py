@@ -1,0 +1,211 @@
+"""Elastic control plane, in-process: leader failover, cluster
+generation/scale-out/scale-in, barrier protocol.
+
+Mirrors reference tests test_leader_pod.py, test_cluster_generator.py,
+test_cluster_watcher.py — with MemoryKV standing in for the per-test
+etcd the reference booted.
+"""
+
+import time
+
+import pytest
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.status import Status, save_pod_status
+from edl_tpu.cluster.train_status import TrainStatus, save_train_status
+from edl_tpu.collective import pod_client
+from edl_tpu.collective.generator import ClusterGenerator
+from edl_tpu.collective.leader import LeaderElector, load_leader_pod
+from edl_tpu.collective.pod_server import start_pod_server
+from edl_tpu.collective.resource import load_resource_pods, register_pod
+from edl_tpu.collective.watcher import ClusterWatcher
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlBarrierError
+from tests.test_cluster_model import make_pod
+
+JOB = "job-x"
+
+
+def wait_for(pred, timeout=10.0, period=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_resource_registry_ttl(memkv):
+    pod = make_pod()
+    reg = register_pod(memkv, JOB, pod, ttl=0.6)
+    assert wait_for(lambda: pod.pod_id in load_resource_pods(memkv, JOB))
+    loaded = load_resource_pods(memkv, JOB)[pod.pod_id]
+    assert loaded == pod
+    reg.stop_heartbeat_only()
+    assert wait_for(lambda: pod.pod_id not in load_resource_pods(memkv, JOB), 5.0)
+
+
+def test_leader_failover_on_ttl_expiry(memkv):
+    pod_a, pod_b = make_pod("10.0.0.1"), make_pod("10.0.0.2")
+    rega = register_pod(memkv, JOB, pod_a, ttl=0.6)
+    regb = register_pod(memkv, JOB, pod_b, ttl=0.6)
+    ea = LeaderElector(memkv, JOB, pod_a.pod_id, ttl=0.6, retry_period=0.1)
+    time.sleep(0.05)
+    ea.start()
+    assert wait_for(lambda: ea.is_leader)
+    eb = LeaderElector(memkv, JOB, pod_b.pod_id, ttl=0.6, retry_period=0.1)
+    eb.start()
+    time.sleep(0.5)
+    assert not eb.is_leader
+    assert load_leader_pod(memkv, JOB).pod_id == pod_a.pod_id
+
+    # kill A the reference way: stop refreshing, let the lease lapse
+    ea._register.stop_heartbeat_only()
+    ea.stop()
+    assert wait_for(lambda: eb.is_leader, 10.0)
+    assert load_leader_pod(memkv, JOB).pod_id == pod_b.pod_id
+    eb.stop()
+    rega.stop()
+    regb.stop()
+
+
+@pytest.fixture
+def three_pods(memkv):
+    pods = [make_pod(f"10.0.0.{i}") for i in range(3)]
+    regs = [register_pod(memkv, JOB, p, ttl=0.8) for p in pods]
+    memkv.put(paths.key(JOB, constants.ETCD_POD_RANK, "0"), pods[0].pod_id.encode())
+    yield pods, regs
+    for r in regs:
+        r.stop()
+
+
+def test_generator_initial_scale_out_and_loss(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=2, max_nodes=3,
+                           period=0.1)
+    # initial build: leader first, all three members
+    c1 = gen.reconcile_once()
+    assert c1 is not None and c1.pods[0].pod_id == pods[0].pod_id
+    assert len(c1.pods) == 3 and c1.world_size == 6
+
+    # no change -> same stage (idempotent)
+    c2 = gen.reconcile_once()
+    assert c2.stage == c1.stage
+
+    # pod 2 dies (stop refresh, lease expires) -> rebuild without it
+    regs[2].stop_heartbeat_only()
+    assert wait_for(lambda: pods[2].pod_id not in load_resource_pods(memkv, JOB), 5.0)
+    c3 = gen.reconcile_once()
+    assert c3.stage != c1.stage
+    assert c3.pod_ids() == [pods[0].pod_id, pods[1].pod_id]
+    # surviving ranks renumbered contiguously
+    assert [p.rank for p in c3.pods] == [0, 1]
+    assert [t.global_rank for p in c3.pods for t in p.trainers] == [0, 1, 2, 3]
+
+    # new pod joins (INITIAL status implied by absence) -> scale out
+    pod_new = make_pod("10.0.0.9")
+    reg_new = register_pod(memkv, JOB, pod_new, ttl=0.8)
+    c4 = gen.reconcile_once()
+    assert c4.stage != c3.stage and pod_new.pod_id in c4.pod_ids()
+    # survivors keep their relative order
+    assert c4.pod_ids()[:2] == c3.pod_ids()
+    reg_new.stop()
+
+
+def test_generator_respects_neartheend_and_max_nodes(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=1, max_nodes=2,
+                           period=0.1)
+    c1 = gen.reconcile_once()
+    assert len(c1.pods) == 2  # max_nodes caps the initial build
+
+    # NEARTHEEND: a late joiner must NOT trigger a resize
+    save_train_status(memkv, JOB, pods[0].pod_id, TrainStatus.NEARTHEEND)
+    pod_new = make_pod("10.0.0.8")
+    reg_new = register_pod(memkv, JOB, pod_new, ttl=0.8)
+    c2 = gen.reconcile_once()
+    assert c2.stage == c1.stage and pod_new.pod_id not in c2.pod_ids()
+    reg_new.stop()
+
+
+def test_generator_below_min_nodes_keeps_old_cluster(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=3, max_nodes=3,
+                           period=0.1)
+    c1 = gen.reconcile_once()
+    assert len(c1.pods) == 3
+    regs[1].stop_heartbeat_only()
+    regs[2].stop_heartbeat_only()
+    assert wait_for(lambda: len(load_resource_pods(memkv, JOB)) == 1, 5.0)
+    c2 = gen.reconcile_once()  # below min: hold the old cluster, don't shrink
+    assert c2.stage == c1.stage and len(c2.pods) == 3
+
+
+def test_generator_deposed_leader_cannot_write(memkv, three_pods):
+    pods, _ = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=1, max_nodes=3)
+    gen.reconcile_once()
+    # usurper takes the seat
+    memkv.put(paths.key(JOB, constants.ETCD_POD_RANK, "0"), b"other-pod")
+    from edl_tpu.utils.exceptions import EdlTableError
+    save_pod_status(memkv, JOB, pods[1].pod_id, Status.FAILED)  # force a rewrite
+    with pytest.raises(EdlTableError):
+        gen.reconcile_once()
+
+
+def test_barrier_protocol(memkv):
+    pods = [make_pod("127.0.0.1") for _ in range(3)]
+    memkv.put(paths.key(JOB, constants.ETCD_POD_RANK, "0"), pods[0].pod_id.encode())
+    cluster = Cluster.from_pods(pods)
+    memkv.put(paths.key(JOB, constants.ETCD_CLUSTER, "cluster"),
+              cluster.to_json().encode())
+    server = start_pod_server(memkv, JOB, pods[0].pod_id)
+    pods[0].port = server.port
+    # re-advertise leader with live port so load_leader_pod finds the server
+    memkv.put(paths.key(JOB, constants.ETCD_POD_RESOURCE, pods[0].pod_id),
+              pods[0].to_json().encode())
+    try:
+        # a lone arrival times out (others missing)
+        with pytest.raises(EdlBarrierError, match="barrier timed out"):
+            pod_client.barrier(memkv, JOB, pods[0].pod_id, timeout=1.0, period=0.2)
+
+        # all three arrive concurrently -> everyone gets the cluster
+        import threading
+        results, errors = {}, []
+
+        def arrive(pid):
+            try:
+                results[pid] = pod_client.barrier(memkv, JOB, pid, timeout=10.0,
+                                                  period=0.1)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=arrive, args=(p.pod_id,)) for p in pods]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert all(c.stage == cluster.stage for c in results.values())
+
+        # outsider pod is rejected even when the stage is complete
+        with pytest.raises(EdlBarrierError):
+            pod_client.barrier(memkv, JOB, "stranger", timeout=1.0, period=0.3)
+    finally:
+        server.stop()
+
+
+def test_watcher_detects_stage_change(memkv, three_pods):
+    pods, _ = three_pods
+    c1 = Cluster.from_pods(pods)
+    memkv.put(paths.key(JOB, constants.ETCD_CLUSTER, "cluster"), c1.to_json().encode())
+    w = ClusterWatcher(memkv, JOB, c1, period=0.1)
+    w.start()
+    time.sleep(0.4)
+    assert not w.changed
+    c2 = Cluster.from_pods(pods[:2])
+    memkv.put(paths.key(JOB, constants.ETCD_CLUSTER, "cluster"), c2.to_json().encode())
+    assert wait_for(lambda: w.changed, 5.0)
+    assert w.latest.stage == c2.stage
+    w.stop()

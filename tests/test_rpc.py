@@ -1,0 +1,69 @@
+"""RPC layer: round-trip, typed errors across the wire, binary payloads,
+concurrent clients."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from edl_tpu.rpc import RpcClient, RpcServer
+from edl_tpu.utils.exceptions import EdlBarrierError, EdlInternalError
+
+
+@pytest.fixture
+def server():
+    s = RpcServer("127.0.0.1", 0)
+    s.register("echo", lambda **kw: kw)
+    s.register("add", lambda a, b: {"sum": a + b})
+
+    def barrier_not_ready():
+        raise EdlBarrierError("3 of 4 pods arrived")
+
+    def crash():
+        raise RuntimeError("unexpected")
+
+    s.register("nope", barrier_not_ready)
+    s.register("crash", crash)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_roundtrip_and_errors(server):
+    with RpcClient(f"127.0.0.1:{server.port}") as c:
+        assert c.call("add", a=2, b=3)["sum"] == 5
+        with pytest.raises(EdlBarrierError, match="3 of 4"):
+            c.call("nope")
+        with pytest.raises(EdlInternalError, match="unexpected"):
+            c.call("crash")
+        with pytest.raises(EdlInternalError, match="no such method"):
+            c.call("missing_method")
+        # connection still usable after typed errors
+        assert c.call("add", a=1, b=1)["sum"] == 2
+
+
+def test_binary_payload(server):
+    arr = np.arange(1 << 16, dtype=np.float32)
+    with RpcClient(f"127.0.0.1:{server.port}") as c:
+        out = c.call("echo", blob=arr.tobytes(), shape=list(arr.shape))
+    back = np.frombuffer(out["blob"], dtype=np.float32)
+    assert back.shape == (1 << 16,) and np.array_equal(back, arr)
+
+
+def test_concurrent_clients(server):
+    errs = []
+
+    def worker(i):
+        try:
+            with RpcClient(f"127.0.0.1:{server.port}") as c:
+                for j in range(20):
+                    assert c.call("add", a=i, b=j)["sum"] == i + j
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs

@@ -1,0 +1,79 @@
+"""Utils layer: serialization round-trips, typed-error wire contract, retry."""
+
+import time
+
+import pytest
+
+from edl_tpu.utils import exceptions
+from edl_tpu.utils.retry import retry_until_timeout
+from edl_tpu.utils.serialization import JsonSerializable, register_serializable
+
+
+@register_serializable
+class _Inner(JsonSerializable):
+    def __init__(self, x=0, tags=None):
+        self.x = x
+        self.tags = tags or []
+
+
+@register_serializable
+class _Outer(JsonSerializable):
+    def __init__(self):
+        self.name = "outer"
+        self.items = [_Inner(1, ["a"]), _Inner(2)]
+        self.child = _Inner(3)
+        self.meta = {"k": 1}
+
+
+def test_nested_roundtrip():
+    o = _Outer()
+    o2 = _Outer().from_json(o.to_json())
+    assert o == o2
+    assert isinstance(o2.items[0], _Inner)
+    assert o2.items[0].x == 1 and o2.child.x == 3
+    o2.child.x = 99
+    assert o != o2
+
+
+def test_exception_wire_roundtrip():
+    status = exceptions.serialize(exceptions.EdlBarrierError("not yet"))
+    with pytest.raises(exceptions.EdlBarrierError, match="not yet"):
+        exceptions.deserialize(status)
+    # unknown/untyped exceptions arrive as EdlInternalError with traceback
+    status = exceptions.serialize(ValueError("boom"))
+    with pytest.raises(exceptions.EdlInternalError, match="boom"):
+        exceptions.deserialize(status)
+    assert exceptions.deserialize(None) is None
+
+
+def test_retry_until_timeout_succeeds_then_gives_up():
+    calls = {"n": 0}
+
+    @retry_until_timeout(interval=0.01)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise exceptions.EdlBarrierError("wait")
+        return "ok"
+
+    assert flaky(timeout=5.0) == "ok"
+    assert calls["n"] == 3
+
+    @retry_until_timeout(interval=0.01)
+    def always_fails():
+        raise exceptions.EdlBarrierError("never")
+
+    t0 = time.monotonic()
+    with pytest.raises(exceptions.EdlBarrierError):
+        always_fails(timeout=0.1)
+    assert time.monotonic() - t0 < 2.0
+
+    @retry_until_timeout(interval=0.01)
+    def hard_error():
+        calls["n"] += 1
+        raise ValueError("no retry")
+
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        hard_error(timeout=1.0)
+    assert calls["n"] == 1
