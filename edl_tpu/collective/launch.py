@@ -48,6 +48,39 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+def clear_stale_job_tables(store, job_id: str) -> None:
+    """Purge leftover records when relaunching a previously FAILED job.
+
+    Pod/train statuses and the cluster record are written without
+    leases, so a FAILED run leaves them behind; an unleased
+    ``pod_status=SUCCEED`` from a dead run would permanently disable
+    scale-out (the generator's any_succeeded rule).
+
+    Race safety: only runs when a FAILED job flag exists (a fresh job
+    never cleans, so a normal simultaneous multi-host launch can't wipe
+    peers' records), claims cleanup by being the one launcher whose
+    ``delete`` of the flag returns nonzero, and never touches leased
+    tables (``resource``, ``rank``) — stale leased keys expire on their
+    own, and deleting live ones would disturb a running election.
+    ``state`` is kept too: it carries the data checkpoint used for
+    resume (reference state.py:186-200).
+    """
+    from edl_tpu.cluster import paths
+    from edl_tpu.collective.resource import load_resource_pods
+    from edl_tpu.utils import constants
+
+    if load_job_status(store, job_id) != Status.FAILED:
+        return
+    if load_resource_pods(store, job_id):
+        return  # live (elastically recovering) run; its leader will re-flag
+    if not store.delete(paths.key(job_id, constants.ETCD_JOB_STATUS, "job")):
+        return  # another relaunching pod claimed the cleanup
+    for table in (constants.ETCD_POD_STATUS, constants.ETCD_TRAIN_STATUS,
+                  constants.ETCD_CLUSTER, constants.ETCD_READER,
+                  constants.ETCD_DIST_READER):
+        store.delete_prefix(paths.table_prefix(job_id, table))
+
+
 def run(argv: list[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
     job_env = JobEnv(args)
@@ -57,6 +90,7 @@ def run(argv: list[str] | None = None) -> int:
     if load_job_status(store, job_env.job_id) == Status.SUCCEED:
         logger.info("job %s already SUCCEED; nothing to do", job_env.job_id)
         return 0
+    clear_stale_job_tables(store, job_env.job_id)
 
     pod = Pod(addr=local_ip(), device_ids=job_env.device_ids)
     pod.make_trainers(job_env.nproc_per_node,

@@ -105,11 +105,19 @@ class Launcher:
                                          timeout=self._resize_barrier_timeout)
 
     def _supervise(self, watcher: ClusterWatcher) -> Status | None:
-        """Returns final status, or None on membership change (resize)."""
+        """Returns final status, or None on membership change (resize).
+
+        A nonzero local trainer exit does not fail the job immediately:
+        when a *peer* pod dies, every survivor's trainer crashes (lost
+        jax.distributed coordinator / collective) seconds before the
+        membership change becomes visible (lease TTL + generator +
+        watcher).  So a local failure opens a grace window; if a
+        membership change arrives inside it, this is collateral damage
+        and we take the stop-resume path instead of declaring FAILED.
+        """
+        fail_deadline = None
         while True:
             local = train_process.watch_procs(self._procs)
-            if local == Status.FAILED:
-                return Status.FAILED
             if local == Status.SUCCEED:
                 return Status.SUCCEED
             if self._resource_register.is_stopped or self._elector.is_stopped:
@@ -117,7 +125,23 @@ class Launcher:
                 return Status.FAILED
             if watcher.changed:
                 return None
+            if local == Status.FAILED:
+                if fail_deadline is None:
+                    grace = self._fail_grace()
+                    logger.warning(
+                        "local trainer failed; waiting %.1fs for a membership "
+                        "change before failing the job", grace)
+                    fail_deadline = time.monotonic() + grace
+                elif time.monotonic() >= fail_deadline:
+                    return Status.FAILED
             time.sleep(self._period)
+
+    def _fail_grace(self) -> float:
+        """Long enough for a peer death to surface as a membership change:
+        lease expiry + a generator pass + a watcher pass, with slack."""
+        if constants.FAIL_GRACE >= 0:
+            return constants.FAIL_GRACE
+        return self._ttl + 2 * constants.GENERATOR_PERIOD + 2 * constants.WATCHER_PERIOD
 
     # -- helpers -------------------------------------------------------------
     def _sync_pod_from(self, cluster: Cluster) -> None:
@@ -169,24 +193,41 @@ class Launcher:
         if self._server:
             self._server.stop()
 
-    def _leader_final_verdict(self, timeout: float = 60.0) -> None:
+    def _leader_final_verdict(self, dead_grace: float = 60.0) -> None:
         """Leader exit path (reference launcher.py:100-130): wait for the
         *current cluster members* to finish, then write the job flag from
         their statuses alone — pods that failed and were since removed by
-        the generator don't count against a recovered job."""
+        the generator don't count against a recovered job.
+
+        A member that still holds a live resource lease is genuinely
+        running (e.g. writing its final checkpoint), so we wait for it
+        without a deadline — publishing SUCCEED early would make late
+        (re)launchers refuse to join a running job.  The ``dead_grace``
+        deadline only bounds the wait for members whose lease is gone
+        but whose terminal status never landed; those count as FAILED.
+        """
         job_id = self._job_env.job_id
         cluster = Cluster.load_from_store(self._store, job_id)
         members = set(cluster.pod_ids()) if cluster else {self._pod.pod_id}
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        members.discard(self._pod.pod_id)
+        dead_deadline = None
+        while True:
             statuses = load_pods_status(self._store, job_id)
             live = set(resource.load_resource_pods(self._store, job_id))
             pending = {pid for pid in members
-                       if statuses.get(pid) not in (Status.SUCCEED, Status.FAILED)
-                       and pid in live}
-            pending.discard(self._pod.pod_id)
+                       if statuses.get(pid) not in (Status.SUCCEED, Status.FAILED)}
             if not pending:
                 break
+            if pending & live:
+                dead_deadline = None  # someone is truly alive; keep waiting
+            else:
+                if dead_deadline is None:
+                    dead_deadline = time.monotonic() + dead_grace
+                elif time.monotonic() >= dead_deadline:
+                    logger.error("members %s died without a final status",
+                                 [p[:8] for p in pending])
+                    save_job_status(self._store, job_id, Status.FAILED)
+                    return
             time.sleep(1.0)
         statuses = load_pods_status(self._store, job_id)
         if any(statuses.get(pid) == Status.FAILED for pid in members):

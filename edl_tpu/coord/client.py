@@ -72,11 +72,17 @@ class CoordClient(KVStore):
             return False
 
     def watch_prefix(self, prefix, callback, period: float = 5.0):
-        # dedicated connection so long-polls don't block regular ops
-        return CoordClient(self.endpoint, self._timeout)._watch(prefix, callback, period)
-
-    def _watch(self, prefix, callback, period):
-        return KVStore.watch_prefix(self, prefix, callback, period)
+        # dedicated connection so long-polls don't block regular ops; the
+        # watcher owns it and closes it on stop()
+        from edl_tpu.coord.kv import PrefixWatcher
+        dedicated = CoordClient(self.endpoint, self._timeout)
+        try:
+            w = PrefixWatcher(dedicated, prefix, callback, period, close_store=True)
+        except BaseException:
+            dedicated.close()
+            raise
+        w.start()
+        return w
 
     def close(self):
         self._rpc.close()

@@ -105,12 +105,14 @@ class KVStore:
 
 
 class PrefixWatcher(threading.Thread):
-    def __init__(self, store: KVStore, prefix: str, callback, period: float):
+    def __init__(self, store: KVStore, prefix: str, callback, period: float,
+                 close_store: bool = False):
         super().__init__(daemon=True, name=f"watch:{prefix}")
         self._store = store
         self._prefix = prefix
         self._callback = callback
         self._period = period
+        self._close_store = close_store  # store is dedicated to this watcher
         self._halt = threading.Event()
         _, self._revision = store.get_prefix(prefix)
 
@@ -129,3 +131,5 @@ class PrefixWatcher(threading.Thread):
 
     def stop(self):
         self._halt.set()
+        if self._close_store:
+            self._store.close()

@@ -66,6 +66,18 @@ class Register:
             try:
                 if self._store.lease_keepalive(self._lease_id):
                     failures = 0
+                    # the lease is alive but the key may have been deleted
+                    # out from under us (e.g. a table sweep); self-heal like
+                    # the reference's transient-death re-register
+                    # (register.py:59-76)
+                    if self._store.get(self._key) is None:
+                        if self._exclusive:
+                            self._stopped_with_error = EdlRegisterError(
+                                f"exclusive key {self._key}: deleted")
+                            self._stop.set()
+                            return
+                        self._store.put(self._key, self._value, self._lease_id)
+                        logger.info("re-put deleted key %s", self._key)
                     continue
                 if self._exclusive:
                     # an exclusive seat whose lease lapsed may already belong

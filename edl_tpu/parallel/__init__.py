@@ -1,0 +1,25 @@
+"""Parallelism layer: device meshes, logical shardings, collective helpers.
+
+This is where the reference's NCCL/Fleet distribution model
+(example/collective/resnet50/train_with_fleet.py:38, graph-rewritten
+allreduce) is replaced by the TPU-native model: a ``jax.sharding.Mesh``
+over the job's devices, ``NamedSharding`` annotations derived from
+logical axis rules, and XLA-emitted collectives over ICI/DCN.  Nothing
+in this package rewrites graphs; parallelism is a property of array
+shardings, not of process topology.
+"""
+
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh, default_mesh
+from edl_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_sharding,
+    logical_constraint,
+    shard_init,
+    shard_host_batch,
+)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "default_mesh",
+    "ShardingRules", "logical_sharding", "logical_constraint",
+    "shard_init", "shard_host_batch",
+]

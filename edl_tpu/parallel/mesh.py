@@ -1,0 +1,111 @@
+"""Device-mesh construction.
+
+The reference scales by process topology: one NCCL ring over all
+trainer processes, configured with ``nccl_comm_num`` /
+``use_hierarchical_allreduce`` (train_with_fleet.py:92-93).  Here the
+equivalent object is a ``jax.sharding.Mesh`` with named axes; XLA emits
+the collectives.  Axis order encodes the network hierarchy: outer axes
+map to slower links (DCN between slices), inner axes to faster ones
+(ICI within a slice), which is what ``mesh_utils.create_device_mesh``
+optimises for on real TPU topologies.
+
+Canonical axis names (outermost → innermost):
+
+- ``dp``   pure data parallelism (params replicated)
+- ``fsdp`` data parallelism with parameter sharding (zero-style)
+- ``pp``   pipeline stages
+- ``sp``   sequence/context parallelism (ring attention)
+- ``tp``   tensor parallelism (megatron-style)
+- ``ep``   expert parallelism (MoE / sharded embedding tables)
+
+A model only pays for the axes it uses: unused axes have size 1 and
+vanish from the compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+# Outermost-first canonical order; see module docstring.
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A declarative mesh shape.  At most one axis may be -1 (absorb all
+    remaining devices); every other axis must divide the device count."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Fill in the -1 axis and validate divisibility."""
+        sizes = self.sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+    def build(self, devices=None) -> Mesh:
+        return build_mesh(self, devices)
+
+
+def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
+    """Build a ``Mesh`` from a spec over ``devices`` (default: all).
+
+    Uses ``mesh_utils.create_device_mesh`` so that on real TPU slices the
+    assignment respects the physical torus; on CPU/test platforms it
+    falls back to a plain reshape.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=np.asarray(devices))
+    except Exception as e:
+        if getattr(devices[0], "platform", "") == "tpu":
+            raise  # on real slices a mapping failure means a bad mesh shape
+        logger.warning("create_device_mesh failed (%s); plain reshape fallback", e)
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def default_mesh(devices=None) -> Mesh:
+    """All devices on the ``dp`` axis — the reference's only topology
+    (pure collective data parallelism, SURVEY.md §5 'Long-context')."""
+    return build_mesh(MeshSpec(), devices)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes over which the global batch is split (dp-like axes)."""
+    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1) or ("dp",)
+
+
+def batch_divisor(mesh: Mesh) -> int:
+    """Number of ways the batch dimension is split on this mesh."""
+    return math.prod(mesh.shape.get(a, 1) for a in ("dp", "fsdp"))

@@ -1,0 +1,127 @@
+"""Logical-axis sharding rules.
+
+Models annotate arrays with *logical* axis names ("batch", "embed",
+"heads", ...).  A ``ShardingRules`` table maps logical names to mesh
+axes, so the same model code runs pure-DP, FSDP, TP, or any mix by
+swapping the rules — the TPU-native analog of the reference switching
+Fleet DistributedStrategy knobs (train_with_fleet.py:85-111) without
+touching model code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Default logical→mesh table.  A logical name may map to a mesh axis, a
+# tuple of mesh axes (sharded over both), or None (replicated).
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": ("dp", "fsdp"),   # global batch split over all data axes
+    "seq": "sp",               # sequence/context parallelism
+    "embed": "fsdp",           # zero-style param sharding
+    "mlp": "tp",               # megatron column/row parallel
+    "heads": "tp",
+    "kv": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "expert_mlp": "tp",
+    "layers": None,            # scanned-layer leading dim
+    "stage": "pp",
+    "conv_out": None,
+    "table": "ep",             # CTR embedding tables (reference example/ctr)
+    "norm": None,
+}
+
+
+@dataclass
+class ShardingRules:
+    """Logical axis name → mesh axis (or tuple / None)."""
+
+    rules: dict[str, Any] = field(default_factory=lambda: dict(DEFAULT_RULES))
+
+    def updated(self, **kw) -> "ShardingRules":
+        r = dict(self.rules)
+        r.update(kw)
+        return ShardingRules(r)
+
+    def spec(self, logical_axes: tuple[str | None, ...], mesh: Mesh) -> P:
+        """Resolve logical axes to a PartitionSpec, dropping mesh axes of
+        size 1 and axes that do not divide nothing (validation is left to
+        jax)."""
+        out = []
+        used: set[str] = set()
+        for name in logical_axes:
+            axis = self.rules.get(name) if name else None
+            if axis is None:
+                out.append(None)
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            live = tuple(a for a in axes
+                         if mesh.shape.get(a, 1) > 1 and a not in used)
+            used.update(live)
+            if not live:
+                out.append(None)
+            elif len(live) == 1:
+                out.append(live[0])
+            else:
+                out.append(live)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+
+def logical_sharding(logical_axes: tuple[str | None, ...], mesh: Mesh,
+                     rules: ShardingRules | None = None) -> NamedSharding:
+    rules = rules or ShardingRules()
+    return NamedSharding(mesh, rules.spec(logical_axes, mesh))
+
+
+def logical_constraint(x, logical_axes: tuple[str | None, ...], mesh: Mesh,
+                       rules: ShardingRules | None = None):
+    """``with_sharding_constraint`` by logical names; no-op outside jit."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(logical_axes, mesh, rules))
+
+
+def tree_shardings(tree_logical, mesh: Mesh,
+                   rules: ShardingRules | None = None):
+    """Map a pytree of logical-axes tuples to NamedShardings."""
+    rules = rules or ShardingRules()
+    return jax.tree.map(
+        lambda ax: logical_sharding(ax, mesh, rules),
+        tree_logical,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_init(init_fn, tree_logical, mesh: Mesh,
+               rules: ShardingRules | None = None):
+    """Run ``init_fn`` under jit with output shardings so parameters are
+    born sharded (never materialised replicated on one host)."""
+    shardings = tree_shardings(tree_logical, mesh, rules)
+    return jax.jit(init_fn, out_shardings=shardings)()
+
+
+def shard_host_batch(batch, mesh: Mesh, rules: ShardingRules | None = None):
+    """Assemble per-host numpy batches into a global device array split
+    on the batch axes.  This is the host→device hand-off the reference
+    did via feed dicts (train_with_fleet.py:501-510); here each host
+    contributes its shard and XLA sees one global array.
+    """
+    rules = rules or ShardingRules()
+
+    def put(x):
+        x = np.asarray(x)
+        axes = ("batch",) + (None,) * (x.ndim - 1) if x.ndim else ()
+        sharding = logical_sharding(axes, mesh, rules)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, batch)

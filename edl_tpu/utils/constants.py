@@ -47,3 +47,7 @@ WATCHER_PERIOD = _f("EDL_TPU_WATCHER_PERIOD", 3.0)
 SUPERVISOR_PERIOD = _f("EDL_TPU_SUPERVISOR_PERIOD", 3.0)
 BARRIER_TIMEOUT_INIT = _f("EDL_TPU_BARRIER_TIMEOUT", 600.0)    # launcher.py:175
 BARRIER_TIMEOUT_RESIZE = _f("EDL_TPU_RESIZE_BARRIER_TIMEOUT", 60.0)
+# grace between a local trainer crash and failing the job, so collateral
+# crashes from a peer pod's death can resolve into a membership change
+# instead; -1 = auto (ttl + generator + watcher slack)
+FAIL_GRACE = _f("EDL_TPU_FAIL_GRACE", -1.0)

@@ -7,10 +7,16 @@ bench.py, not the unit suite.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the ambient axon/TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize imports jax at interpreter start, so the env
+# var alone is too late — force the platform through the config too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

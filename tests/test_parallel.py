@@ -1,0 +1,81 @@
+"""Mesh + logical sharding tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.parallel import (
+    MeshSpec, ShardingRules, build_mesh, default_mesh,
+    logical_sharding, logical_constraint, shard_host_batch,
+)
+from edl_tpu.parallel.mesh import batch_divisor
+
+
+def test_default_mesh_all_dp():
+    mesh = default_mesh()
+    assert mesh.shape["dp"] == 8
+    assert all(mesh.shape[a] == 1 for a in mesh.axis_names if a != "dp")
+
+
+def test_spec_resolve_wildcard():
+    assert MeshSpec(tp=2).resolve(8)["dp"] == 4
+    assert MeshSpec(dp=2, tp=2, sp=2).resolve(8)["dp"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=3).resolve(8)
+
+
+def test_build_mesh_multi_axis():
+    mesh = build_mesh(MeshSpec(dp=2, tp=2, sp=2))
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2 and mesh.shape["sp"] == 2
+    assert batch_divisor(mesh) == 2
+
+
+def test_logical_sharding_drops_size1_axes():
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    s = logical_sharding(("batch", "embed", "mlp"), mesh)
+    # fsdp has size 1 → batch maps to dp only; embed (fsdp) replicated.
+    assert s.spec == P("dp", None, "tp")
+
+
+def test_logical_sharding_tuple_axes():
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    s = logical_sharding(("batch", None, "mlp"), mesh)
+    assert s.spec == P(("dp", "fsdp"), None, "tp")
+
+
+def test_no_mesh_axis_reuse_within_spec():
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    rules = ShardingRules().updated(rows="tp", cols="tp")
+    s = logical_sharding(("rows", "cols"), mesh, rules)
+    # tp may appear only once per spec; second use is replicated.
+    assert s.spec == P("tp")
+
+
+def test_shard_host_batch_and_constraint():
+    mesh = build_mesh(MeshSpec(dp=8))
+    batch = {"x": np.ones((16, 4), np.float32), "y": np.arange(16)}
+    global_batch = shard_host_batch(batch, mesh)
+    assert global_batch["x"].sharding.spec == P("dp")
+
+    @jax.jit
+    def f(b):
+        h = logical_constraint(b["x"] * 2, ("batch", None), mesh)
+        return h.sum() + b["y"].sum()
+
+    assert float(f(global_batch)) == 16 * 4 * 2 + np.arange(16).sum()
+
+
+def test_matmul_psum_over_tp_mesh():
+    # A tp-sharded matmul must reduce over ICI: result matches single-device.
+    mesh = build_mesh(MeshSpec(dp=1, tp=8))
+    rules = ShardingRules()
+    x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16, 32)).astype(np.float32)
+    xs = jax.device_put(x, logical_sharding((None, "mlp"), mesh, rules))
+    ws = jax.device_put(w, logical_sharding(("mlp", None), mesh, rules))
+    out = jax.jit(lambda a, b: a @ b)(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-4, atol=1e-4)
