@@ -78,7 +78,11 @@ class ClusterGenerator(threading.Thread):
         # rebuilding would pointlessly restart the survivors mid-finish
         lost = any(statuses.get(p.pod_id) != Status.SUCCEED for p in gone)
 
-        any_succeeded = any(s == Status.SUCCEED for s in statuses.values())
+        # only *members'* SUCCEED blocks scale-out (job is finishing); a
+        # stale unleased SUCCEED left by a previous run of this job_id is
+        # not in the current cluster and must not freeze it forever
+        any_succeeded = any(statuses.get(p.pod_id) == Status.SUCCEED
+                            for p in current.pods)
         new_ids = [pid for pid in resource if current.get_pod(pid) is None
                    and statuses.get(pid, Status.INITIAL) == Status.INITIAL]
         joiners: list[Pod] = []

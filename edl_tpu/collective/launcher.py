@@ -201,17 +201,21 @@ class Launcher:
 
         A member that still holds a live resource lease is genuinely
         running (e.g. writing its final checkpoint), so we wait for it
-        without a deadline — publishing SUCCEED early would make late
+        patiently — publishing SUCCEED early would make late
         (re)launchers refuse to join a running job.  The ``dead_grace``
         deadline only bounds the wait for members whose lease is gone
         but whose terminal status never landed; those count as FAILED.
+        An overall cap (EDL_TPU_VERDICT_TIMEOUT) bounds the live wait so
+        a follower whose trainer hangs forever can't pin the leader
+        host; at the cap the verdict is written from statuses seen.
         """
         job_id = self._job_env.job_id
         cluster = Cluster.load_from_store(self._store, job_id)
         members = set(cluster.pod_ids()) if cluster else {self._pod.pod_id}
         members.discard(self._pod.pod_id)
         dead_deadline = None
-        while True:
+        overall_deadline = time.monotonic() + constants.VERDICT_TIMEOUT
+        while time.monotonic() < overall_deadline:
             statuses = load_pods_status(self._store, job_id)
             live = set(resource.load_resource_pods(self._store, job_id))
             pending = {pid for pid in members
@@ -229,6 +233,10 @@ class Launcher:
                     save_job_status(self._store, job_id, Status.FAILED)
                     return
             time.sleep(1.0)
+        else:
+            logger.error("final-verdict wait capped at %.0fs with members "
+                         "still unfinished; writing verdict from statuses seen",
+                         constants.VERDICT_TIMEOUT)
         statuses = load_pods_status(self._store, job_id)
         if any(statuses.get(pid) == Status.FAILED for pid in members):
             save_job_status(self._store, job_id, Status.FAILED)

@@ -1,0 +1,32 @@
+"""Model zoo: the reference's workloads (SURVEY.md §2.7) rebuilt
+TPU-natively in flax.linen — NHWC layouts, bf16 compute / f32 params,
+logical-axis annotations for mesh sharding — plus a flagship
+transformer LM (beyond-parity: TP/SP/FSDP + ring attention).
+
+Reference workloads covered:
+- ResNet50 / ResNet50_vd (example/collective/resnet50/models/resnet.py,
+  example/distill/resnet/models/resnet_vd.py)
+- VGG (models/vgg.py)
+- MNIST CNN (example/distill/mnist_distill/train_with_fleet.py)
+- linear regression (example/fit_a_line)
+- wide&deep CTR with sharded embeddings (example/ctr/ctr/train.py)
+- BOW / CNN text students + transformer teacher (example/distill/nlp)
+"""
+
+from edl_tpu.models.logical import logical_axes_from_paths
+from edl_tpu.models.linear import LinearRegression
+from edl_tpu.models.mnist import MnistCNN
+from edl_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet50vd
+from edl_tpu.models.vgg import VGG, VGG16
+from edl_tpu.models.wide_deep import WideDeep
+from edl_tpu.models.text import BowClassifier, CnnClassifier, TextTransformer
+from edl_tpu.models.transformer import TransformerLM, TransformerConfig
+
+__all__ = [
+    "logical_axes_from_paths",
+    "LinearRegression", "MnistCNN",
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet50vd",
+    "VGG", "VGG16", "WideDeep",
+    "BowClassifier", "CnnClassifier", "TextTransformer",
+    "TransformerLM", "TransformerConfig",
+]

@@ -1,0 +1,20 @@
+"""Linear regression — the fit_a_line smoke workload
+(reference example/fit_a_line/train_ft.py: a 13-feature UCI-housing
+regressor used to demo fault tolerance)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LinearRegression(nn.Module):
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features, name="fc")(x)
+
+
+def mse_loss(pred, target):
+    return jnp.mean((pred - target) ** 2)

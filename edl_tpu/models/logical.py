@@ -1,0 +1,49 @@
+"""Logical-axis trees for model parameters.
+
+Bridges flax param pytrees to :mod:`edl_tpu.parallel.sharding`: given
+regex rules over the param path (``"decoder/layers/attn/q/kernel"``),
+produce the tree of logical-axes tuples that
+``ElasticTrainer.create_state(param_logical=...)`` consumes.  Models in
+this package export a ``LOGICAL_RULES`` list; pure-DP training simply
+passes None and gets replicated params (the reference's only layout).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def logical_axes_from_paths(params, rules: list[tuple[str, tuple]],
+                            default: tuple | None = None):
+    """Map each param leaf to the axes of the first rule whose regex
+    matches its path; unmatched leaves get ``default`` (None → fully
+    replicated).  A rule's axes tuple must have one entry per array dim.
+    """
+    compiled = [(re.compile(pat), axes) for pat, axes in rules]
+
+    def pick(path, leaf):
+        s = _path_str(path)
+        for pat, axes in compiled:
+            if pat.search(s):
+                if len(axes) != leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern} gives {len(axes)} axes for "
+                        f"{s} with ndim {leaf.ndim}")
+                return axes
+        return default if default is not None else (None,) * leaf.ndim
+
+    return jax.tree_util.tree_map_with_path(pick, params)

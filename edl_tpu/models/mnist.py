@@ -1,0 +1,28 @@
+"""MNIST CNN (reference example/distill/mnist_distill/train_with_fleet.py:300
+— conv-pool ×2 + fc, the minimal distillation student)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv1")(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = nn.Conv(50, (5, 5), dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv2")(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc")(x)
+        return x.astype(jnp.float32)

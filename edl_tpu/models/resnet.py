@@ -1,0 +1,125 @@
+"""ResNet family, TPU-native.
+
+Behavioral parity with the reference's Paddle models
+(example/collective/resnet50/models/resnet.py:278 — ResNet18/34/50/101/152
+with bottleneck blocks; example/distill/resnet/models/resnet_vd.py:306 —
+the _vd variant: 3×3×3 deep stem and avg-pool downsample shortcuts),
+redesigned for the MXU: NHWC layout (TPU conv layout), bf16 compute with
+f32 params/batch-stats, and a fused-friendly structure XLA tiles onto
+the systolic array.  BatchNorm statistics live in the ``batch_stats``
+collection → ``TrainState.extra``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    vd: bool = False          # avg-pool shortcut (resnet_vd.py "vd" trick)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+
+        if residual.shape[-1] != self.filters * 4 or self.strides != 1:
+            if self.vd and self.strides != 1:
+                residual = nn.avg_pool(residual, (2, 2), strides=(2, 2))
+                residual = self.conv(self.filters * 4, (1, 1),
+                                     name="conv_shortcut")(residual)
+            else:
+                residual = self.conv(self.filters * 4, (1, 1),
+                                     strides=(self.strides,) * 2,
+                                     name="conv_shortcut")(residual)
+            residual = self.norm(name="bn_shortcut")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    vd: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                      name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+        if residual.shape[-1] != self.filters or self.strides != 1:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 name="conv_shortcut")(residual)
+            residual = self.norm(name="bn_shortcut")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: Callable = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    vd: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        if self.vd:
+            # deep stem: three 3x3 convs (resnet_vd.py conv1_1..conv1_3)
+            x = conv(self.width // 2, (3, 3), strides=(2, 2), name="stem1")(x)
+            x = nn.relu(norm(name="stem_bn1")(x))
+            x = conv(self.width // 2, (3, 3), name="stem2")(x)
+            x = nn.relu(norm(name="stem_bn2")(x))
+            x = conv(self.width, (3, 3), name="stem3")(x)
+            x = nn.relu(norm(name="stem_bn3")(x))
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2), name="stem")(x)
+            x = nn.relu(norm(name="stem_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2 ** i, strides, conv, norm,
+                               vd=self.vd, name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet50vd = partial(ResNet, stage_sizes=(3, 4, 6, 3), vd=True)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3))
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3))

@@ -1,0 +1,126 @@
+"""Text classifiers for the NLP distillation flow.
+
+Reference: example/distill/nlp/model.py:135 — BOW and CNN students
+distilled from a BERT teacher on ChnSentiCorp with KL-temperature loss
+(distill.py:208).  The teacher here is :class:`TextTransformer`, a
+compact encoder classifier served by the TPU teacher server instead of
+Paddle Serving.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BowClassifier(nn.Module):
+    """Bag-of-words student (model.py BOW)."""
+
+    vocab_size: int
+    embed_dim: int = 128
+    hidden: int = 128
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, mask=None, train: bool = True):
+        del train
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype, name="embed")(ids)
+        if mask is not None:
+            x = x * mask[..., None].astype(self.dtype)
+        x = x.sum(axis=1)
+        x = jnp.tanh(x)
+        x = jnp.tanh(nn.Dense(self.hidden, dtype=self.dtype,
+                              param_dtype=jnp.float32, name="fc1")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class CnnClassifier(nn.Module):
+    """1-D conv student (model.py CNN)."""
+
+    vocab_size: int
+    embed_dim: int = 128
+    filters: int = 128
+    kernel: int = 5
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, mask=None, train: bool = True):
+        del train
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype, name="embed")(ids)
+        if mask is not None:
+            x = x * mask[..., None].astype(self.dtype)
+        x = nn.Conv(self.filters, (self.kernel,), dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv")(x)
+        x = nn.relu(x).max(axis=1)
+        x = jnp.tanh(nn.Dense(96, dtype=self.dtype, param_dtype=jnp.float32,
+                              name="fc1")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class TextTransformer(nn.Module):
+    """Compact encoder classifier: the distillation teacher (standing in
+    for the reference's fine-tuned BERT, fine_tune.py:201)."""
+
+    vocab_size: int
+    num_layers: int = 4
+    embed_dim: int = 256
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    max_len: int = 512
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, mask=None, train: bool = True):
+        del train
+        B, L = ids.shape
+        x = nn.Embed(self.vocab_size, self.embed_dim, param_dtype=jnp.float32,
+                     dtype=self.dtype, name="tok_embed")(ids)
+        pos = nn.Embed(self.max_len, self.embed_dim, param_dtype=jnp.float32,
+                       dtype=self.dtype, name="pos_embed")(jnp.arange(L))
+        x = x + pos[None]
+        attn_mask = None
+        if mask is not None:
+            m = mask.astype(bool)
+            attn_mask = m[:, None, None, :] & m[:, None, :, None]
+        for i in range(self.num_layers):
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads, dtype=self.dtype,
+                param_dtype=jnp.float32, name=f"attn_{i}")(y, y, mask=attn_mask)
+            x = x + y
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=jnp.float32, name=f"mlp_in_{i}")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.embed_dim, dtype=self.dtype,
+                         param_dtype=jnp.float32, name=f"mlp_out_{i}")(y)
+            x = x + y
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        if mask is not None:
+            w = mask.astype(self.dtype)
+            x = (x * w[..., None]).sum(1) / jnp.maximum(w.sum(1, keepdims=True), 1)
+        else:
+            x = x.mean(axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def kl_distill_loss(student_logits, teacher_logits, temperature: float = 1.0):
+    """KL(teacher ∥ student) with temperature (reference distill.py KL loss)."""
+    t = temperature
+    p = jax.nn.log_softmax(teacher_logits / t, axis=-1)
+    q = jax.nn.log_softmax(student_logits / t, axis=-1)
+    return (jnp.exp(p) * (p - q)).sum(-1).mean() * t * t

@@ -1,0 +1,155 @@
+"""Flagship transformer LM — the beyond-parity workload.
+
+The reference tops out at data-parallel ResNet (SURVEY.md §5
+"Long-context: absent").  This decoder-only LM is designed for the
+mesh from day one:
+
+- logical axes on every weight (megatron TP on ``tp``, zero-style
+  ``fsdp``, sequence shards on ``sp``) — ``LOGICAL_RULES`` feeds
+  ``ElasticTrainer.create_state``;
+- activations constrained to ("batch", "seq", "embed") so XLA places
+  the collectives, not us;
+- ``lax.scan`` over stacked layer params (one compile for N layers) with
+  optional ``jax.checkpoint`` rematerialisation;
+- attention dispatch from :mod:`edl_tpu.ops.attention` (XLA dense /
+  pallas flash / ring sequence-parallel);
+- RoPE positions, RMSNorm, bf16 compute / f32 params.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.ops.attention import dot_product_attention
+
+# param-path regex → logical axes (ElasticTrainer.create_state consumes)
+LOGICAL_RULES = [
+    (r"tok_embed/embedding", ("vocab", "embed")),
+    (r"layers/attn_qkv/kernel", ("layers", "embed", "heads")),
+    (r"layers/attn_out/kernel", ("layers", "heads", "embed")),
+    (r"layers/mlp_in/kernel", ("layers", "embed", "mlp")),
+    (r"layers/mlp_gate/kernel", ("layers", "embed", "mlp")),
+    (r"layers/mlp_out/kernel", ("layers", "mlp", "embed")),
+    (r"layers/.*norm/scale", ("layers", "norm")),
+    (r"final_norm/scale", ("norm",)),
+    (r"lm_head/kernel", ("embed", "vocab")),
+]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"      # auto | dense | flash | ring
+    mesh: Any = None                  # required for attention_impl="ring"
+    remat: bool = True
+    rope_theta: float = 10_000.0
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def rope(x, positions, theta: float):
+    """Rotary position embedding over the last dim of [B, L, H, D]."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, D/2]
+    cos, sin = jnp.cos(angles)[:, :, None], jnp.sin(angles)[:, :, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + 1e-6)).astype(self.dtype) * scale
+
+
+class Block(nn.Module):
+    """One decoder layer; instances are stacked by ``nn.scan``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        H, Dh = cfg.num_heads, cfg.head_dim
+        y = RMSNorm(cfg.dtype, name="attn_norm")(x)
+        qkv = nn.DenseGeneral((3 * H * Dh,), use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="attn_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, L = x.shape[:2]
+        q = rope(q.reshape(B, L, H, Dh), positions, cfg.rope_theta)
+        k = rope(k.reshape(B, L, H, Dh), positions, cfg.rope_theta)
+        v = v.reshape(B, L, H, Dh)
+        attn = dot_product_attention(q, k, v, causal=True,
+                                     impl=cfg.attention_impl, mesh=cfg.mesh)
+        attn = attn.reshape(B, L, H * Dh)
+        x = x + nn.DenseGeneral(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                                param_dtype=jnp.float32, name="attn_out")(attn)
+        y = RMSNorm(cfg.dtype, name="mlp_norm")(x)
+        gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="mlp_gate")(y)
+        up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                      param_dtype=jnp.float32, name="mlp_in")(y)
+        y = nn.silu(gate) * up
+        x = x + nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="mlp_out")(y)
+        return x, None
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids, positions=None, train: bool = True):
+        cfg = self.cfg
+        del train
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, param_dtype=jnp.float32,
+                     dtype=cfg.dtype, name="tok_embed")(ids)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        Stack = nn.scan(block, variable_axes={"params": 0},
+                        split_rngs={"params": True}, length=cfg.num_layers,
+                        in_axes=nn.broadcast, metadata_params={})
+        x, _ = Stack(cfg, name="layers")(x, positions)
+        x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            embed = self.get_variable("params", "tok_embed")["embedding"]
+            logits = x @ embed.T.astype(cfg.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits, targets, mask=None):
+    """Next-token cross entropy; ``targets`` already shifted."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()

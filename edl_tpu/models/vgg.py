@@ -1,0 +1,49 @@
+"""VGG, TPU-native (reference example/collective/resnet50/models/vgg.py:133
+— VGG11/13/16/19 with batch norm)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        filters = (64, 128, 256, 512, 512)
+        for i, n_convs in enumerate(_CFG[self.depth]):
+            for j in range(n_convs):
+                x = nn.Conv(filters[i], (3, 3), dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name=f"conv{i}_{j}")(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, param_dtype=jnp.float32,
+                                 name=f"bn{i}_{j}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i, feats in enumerate((4096, 4096)):
+            x = nn.Dense(feats, dtype=self.dtype, param_dtype=jnp.float32,
+                         name=f"fc{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, depth=16)

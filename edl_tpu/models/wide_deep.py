@@ -1,0 +1,54 @@
+"""Wide&Deep CTR model with mesh-sharded embedding tables.
+
+Reference: example/ctr/ctr/train.py:288 — a wide (linear) part over
+sparse slots plus a deep MLP over slot embeddings, trained in
+parameter-server mode with tables on pservers (fluid
+DistributeTranspiler).  TPU-native redesign: the tables are ordinary
+parameters sharded over the ``ep`` mesh axis (logical axis "table"), so
+lookups become XLA gathers with compiler-inserted collectives — the
+PS-style async push/pull is replaced by synchronous sharded SGD
+(SURVEY.md §7 design mapping, CTR row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# param-path regex → logical axes, for ElasticTrainer(param_logical=...)
+LOGICAL_RULES = [
+    (r"embed_\d+/embedding", ("table", "embed")),
+    (r"wide_\d+/embedding", ("table", None)),
+]
+
+
+class WideDeep(nn.Module):
+    vocab_sizes: Sequence[int]          # one vocab per sparse slot
+    dense_features: int = 13
+    embed_dim: int = 16
+    hidden: Sequence[int] = (400, 400, 400)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, dense, sparse, train: bool = True):
+        """``dense``: [B, dense_features] float; ``sparse``: [B, n_slots] int."""
+        del train
+        deep_parts = [dense.astype(self.dtype)]
+        wide_logit = jnp.zeros((dense.shape[0], 1), self.dtype)
+        for i, vocab in enumerate(self.vocab_sizes):
+            ids = sparse[:, i]
+            emb = nn.Embed(vocab, self.embed_dim, param_dtype=jnp.float32,
+                           dtype=self.dtype, name=f"embed_{i}")(ids)
+            deep_parts.append(emb)
+            wide = nn.Embed(vocab, 1, param_dtype=jnp.float32,
+                            dtype=self.dtype, name=f"wide_{i}")(ids)
+            wide_logit = wide_logit + wide
+        x = jnp.concatenate(deep_parts, axis=-1)
+        for k, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, dtype=self.dtype,
+                                 param_dtype=jnp.float32, name=f"fc{k}")(x))
+        deep_logit = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32,
+                              name="deep_head")(x)
+        return (wide_logit + deep_logit).astype(jnp.float32).squeeze(-1)

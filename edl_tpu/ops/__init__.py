@@ -1,0 +1,13 @@
+"""TPU kernel layer: attention implementations (XLA dense, pallas
+flash, ring sequence-parallel) and fused ops.
+
+The reference had no kernels in-tree — its hot ops lived in Paddle's
+CUDA runtime (SURVEY.md §0).  Here the hot path is explicit: pallas
+kernels where XLA fusion isn't enough, ``shard_map`` + ``ppermute``
+ring collectives for long-context attention over the ``sp`` mesh axis.
+"""
+
+from edl_tpu.ops.attention import dense_attention, dot_product_attention
+from edl_tpu.ops.ring import ring_attention
+
+__all__ = ["dense_attention", "dot_product_attention", "ring_attention"]

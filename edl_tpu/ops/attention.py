@@ -1,0 +1,89 @@
+"""Attention implementations.
+
+``dot_product_attention(q, k, v)`` takes flax-convention ``[B, L, H, D]``
+tensors and dispatches:
+
+- ``dense``: XLA einsum attention, f32 softmax — always available, the
+  CPU-mesh test path;
+- ``flash``: the pallas TPU flash-attention kernel (tiled online
+  softmax; never materialises the [L, L] matrix in HBM) — the MXU path
+  for the transformer flagship;
+- ``auto``: flash on TPU when shapes are tileable, else dense.
+
+Ring sequence-parallel attention (the long-context path over the ``sp``
+mesh axis) lives in :mod:`edl_tpu.ops.ring` and composes with these as
+its per-shard inner kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def dense_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: float | None = None,
+                    mask=None):
+    """Plain XLA attention; softmax statistics in f32 regardless of the
+    input dtype (bf16-safe)."""
+    B, Lq, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Lk = k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def _flash(q, k, v, causal, sm_scale):
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+    # pallas kernel wants [B, H, L, D]
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    return out.swapaxes(1, 2)
+
+
+def _flash_ok(q, k) -> bool:
+    # the TPU kernel tiles over 128-multiples; head_dim must be MXU-wide
+    Lq, Lk, D = q.shape[1], k.shape[1], q.shape[3]
+    return Lq % 128 == 0 and Lk % 128 == 0 and D % 128 == 0
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          sm_scale: float | None = None,
+                          mask=None, impl: str = "auto",
+                          mesh=None, sp_axis: str = "sp"):
+    """[B, L, H, D] attention with implementation dispatch (see module
+    docstring).  ``mask`` (dense-only) broadcasts against [B, H, Lq, Lk];
+    ``impl="ring"`` requires ``mesh`` and shards the sequence over
+    ``sp_axis``."""
+    if impl == "auto":
+        impl = ("flash" if _on_tpu() and mask is None and _flash_ok(q, k)
+                else "dense")
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("impl='ring' needs the mesh")
+        from edl_tpu.ops.ring import ring_attention
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              sm_scale=sm_scale, sp_axis=sp_axis)
+    if impl == "flash":
+        scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+        return _flash(q, k, v, causal, scale)
+    if impl == "dense":
+        return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               mask=mask)
+    raise ValueError(f"unknown attention impl {impl!r}")

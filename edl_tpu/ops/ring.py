@@ -1,0 +1,82 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp``
+mesh axis.
+
+The long-context path the reference never had (SURVEY.md §5
+"Long-context: absent").  Queries stay put; key/value blocks rotate
+around the ring with ``ppermute`` while each shard folds every block
+into a numerically-stable online softmax (the flash-attention
+recurrence carried across devices).  Compute for block t overlaps the
+transfer of block t+1 on ICI — the standard TPU ring schedule
+(jax-ml.github.io/scaling-book; Liu et al., Ring Attention, 2023).
+
+Exactness: identical result to full attention (tested against the
+dense path), so it composes with causal masking by global positions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # large-but-finite: avoids inf-inf=nan in the recurrence
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
+                   sm_scale: float | None = None, sp_axis: str = "sp",
+                   batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
+    """[B, L, H, D] global arrays, L sharded over ``sp_axis`` — exact
+    attention without ever materialising a non-local [L, L] block pair.
+    Call under jit; shard_map is applied internally."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, sp_axis, head_axis if mesh.shape.get(head_axis, 1) > 1 else None, None)
+
+    local = functools.partial(_ring_local, axis=sp_axis,
+                              n_shards=mesh.shape[sp_axis],
+                              causal=causal, scale=scale)
+    f = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    return f(q, k, v)
+
+
+def _ring_local(ql, kl, vl, *, axis: str, n_shards: int, causal: bool,
+                scale: float):
+    """Per-shard body: fold each rotating k/v block into the online
+    softmax state (m: running max, l: running denominator, acc:
+    unnormalised numerator)."""
+    B, Lq, H, D = ql.shape
+    Lk = kl.shape[1]
+    my = jax.lax.axis_index(axis)
+    q_pos = my * Lq + jnp.arange(Lq)                     # global query rows
+
+    qf = ql.astype(jnp.float32) * scale
+    m = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Lq), jnp.float32)
+    acc = jnp.zeros((B, Lq, H, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    for step in range(n_shards):
+        src = (my - step) % n_shards                     # owner of this block
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kl.astype(jnp.float32))
+        if causal:
+            k_pos = src * Lk + jnp.arange(Lk)
+            mask = q_pos[:, None] >= k_pos[None, :]      # [Lq, Lk]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        block_max = logits.max(axis=-1)                  # [B, H, Lq]
+        m_new = jnp.maximum(m, block_max)
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vl.astype(jnp.float32))
+        m = m_new
+        if step + 1 < n_shards:                          # rotate k/v blocks
+            kl = jax.lax.ppermute(kl, axis, perm)
+            vl = jax.lax.ppermute(vl, axis, perm)
+
+    denom = l.transpose(0, 2, 1)[..., None]              # [B, Lq, H, 1]
+    out = acc / jnp.maximum(denom, 1e-30)
+    return out.astype(ql.dtype)

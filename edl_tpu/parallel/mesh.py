@@ -101,11 +101,6 @@ def default_mesh(devices=None) -> Mesh:
     return build_mesh(MeshSpec(), devices)
 
 
-def data_axes(mesh: Mesh) -> tuple[str, ...]:
-    """Axes over which the global batch is split (dp-like axes)."""
-    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1) or ("dp",)
-
-
 def batch_divisor(mesh: Mesh) -> int:
     """Number of ways the batch dimension is split on this mesh."""
     return math.prod(mesh.shape.get(a, 1) for a in ("dp", "fsdp"))

@@ -1,0 +1,24 @@
+"""Training engine: the TPU-native replacement for the reference's L4
+(PaddlePaddle Fleet — SURVEY.md §1).
+
+Where Fleet rewrote the graph to insert NCCL allreduce
+(train_with_fleet.py:326-327), here the train step is an ordinary jitted
+function over a ``Mesh``; gradient reduction is implied by shardings.
+Where Fleet saved checkpoints via ``fleet.save_check_point`` with a
+``TrainStatus`` (train_with_fleet.py:562-570), here Orbax saves the
+TrainState with a JSON meta sidecar.  Elasticity needs no engine
+support beyond checkpointing: the launcher restarts trainer processes
+and `fit` resumes from the last step (stop-resume,
+doc/edl_collective_design_doc.md:12).
+"""
+
+from edl_tpu.train.lr import cosine_warmup, piecewise_decay, scale_lr_for_batch
+from edl_tpu.train.state import EpochAttr, TrainMeta, TrainState
+from edl_tpu.train.checkpoint import CheckpointManager
+from edl_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+__all__ = [
+    "cosine_warmup", "piecewise_decay", "scale_lr_for_batch",
+    "EpochAttr", "TrainMeta", "TrainState",
+    "CheckpointManager", "ElasticTrainer", "TrainConfig",
+]

@@ -1,0 +1,55 @@
+"""Multi-host bootstrap: ``jax.distributed`` from the launcher env ABI.
+
+The reference launcher exported ``PADDLE_TRAINER_ID/_ENDPOINTS/
+_TRAINERS_NUM`` and Fleet's RoleMaker read them
+(train_with_fleet.py:376-377); NCCL bootstrapped its uniqueId over
+sockets (train_process.py:38-41).  Here the launcher exports
+``EDL_TPU_TRAINER_*`` (edl_tpu/cluster/env.py) and this module turns
+them into ``jax.distributed.initialize(coordinator, num_processes,
+process_id)`` — after which ``jax.devices()`` is the global device set
+and a Mesh over it spans the whole job.
+
+Elastic resizes never reshape a live world: the launcher restarts the
+trainer processes (stop-resume) and this runs again with the new env.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from edl_tpu.cluster.env import TrainerEnv
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
+    """Idempotently bootstrap the multi-process JAX runtime.  Single-host
+    (world_size <= 1) is a no-op so the same trainer script runs
+    standalone, under tests, and under the elastic launcher."""
+    global _initialized
+    tenv = tenv or TrainerEnv()
+    if tenv.world_size > 1 and not _initialized:
+        coordinator = tenv.coordinator or tenv.endpoints[0]
+        logger.info("jax.distributed.initialize(coordinator=%s, n=%d, rank=%d)",
+                    coordinator, tenv.world_size, tenv.global_rank)
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=tenv.world_size,
+            process_id=tenv.global_rank)
+        _initialized = True
+    return tenv
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def is_coordinator(tenv: TrainerEnv | None = None) -> bool:
+    tenv = tenv or TrainerEnv()
+    return tenv.global_rank == 0

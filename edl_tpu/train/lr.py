@@ -1,0 +1,50 @@
+"""Learning-rate schedules.
+
+Reference: example/collective/resnet50/utils/learning_rate.py (95) and
+optimizer_setting (train_with_fleet.py:114-225): piecewise decay or
+cosine decay with linear warmup, with the base LR scaled linearly by
+the global batch size — the rule that makes elastic resizes
+LR-consistent (doc: lr ∝ total_batch/base_batch).  Schedules are plain
+``optax`` schedules (step → lr) so they live inside the jitted update.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def scale_lr_for_batch(base_lr: float, global_batch: int,
+                       base_batch: int = 256) -> float:
+    """Linear-scaling rule (train_with_fleet.py:128-146): the reference
+    computes ``lr = base_lr * total_batch / 256`` so adding pods speeds
+    up training without retuning.  On resize, recompute with the new
+    global batch — this is the ``register_adjust_function`` analog
+    (reference state.py:142)."""
+    return base_lr * global_batch / base_batch
+
+
+def cosine_warmup(base_lr: float, total_steps: int, warmup_steps: int = 0,
+                  end_lr: float = 0.0) -> optax.Schedule:
+    """Cosine decay with linear warmup (learning_rate.py cosine variant)."""
+    if warmup_steps <= 0:
+        return optax.cosine_decay_schedule(base_lr, max(1, total_steps),
+                                           alpha=end_lr / max(base_lr, 1e-12))
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=base_lr, warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1), end_value=end_lr)
+
+
+def piecewise_decay(base_lr: float, boundaries: list[int],
+                    gamma: float = 0.1,
+                    warmup_steps: int = 0) -> optax.Schedule:
+    """Step decay at global-step ``boundaries`` (piecewise_decay in the
+    reference, train_with_fleet.py:150-164), optional linear warmup.
+    ``join_schedules`` re-zeroes the step for the post-warmup schedule,
+    so boundaries are pre-shifted to stay global."""
+    if warmup_steps <= 0:
+        return optax.piecewise_constant_schedule(
+            base_lr, {b: gamma for b in boundaries})
+    sched = optax.piecewise_constant_schedule(
+        base_lr, {max(1, b - warmup_steps): gamma for b in boundaries})
+    warm = optax.linear_schedule(0.0, base_lr, warmup_steps)
+    return optax.join_schedules([warm, sched], [warmup_steps])

@@ -1,0 +1,62 @@
+"""TrainState: the device-resident training pytree.
+
+The reference's trainer state was implicit in the Paddle executor's
+scope (program + optimizer vars, saved whole by
+``fleet.save_check_point`` — train_with_fleet.py:562-570).  Here it is
+an explicit, functional pytree: parameters, optimizer state, mutable
+model collections (batch stats), and the step counter — everything a
+step function needs, everything a checkpoint must capture.
+
+Step-level *resume metadata* (epoch history, data checkpoint, world
+size) is NOT here: that lives in :class:`edl_tpu.cluster.state.State`
+and rides along as the checkpoint's JSON sidecar.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+# Re-export the resume-metadata types so train code has one import home.
+from edl_tpu.cluster.state import (  # noqa: F401
+    AdjustRegistry, DataCheckpoint, EpochAttr, State,
+)
+
+
+class TrainState(struct.PyTreeNode):
+    """Functional train state; ``apply_gradients`` returns a new one."""
+
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    extra: Any = None            # mutable collections (e.g. batch_stats)
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation,
+               extra: Any = None) -> "TrainState":
+        import jax.numpy as jnp
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), extra=extra, tx=tx)
+
+    def apply_gradients(self, grads, extra: Any = None) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state,
+                            extra=self.extra if extra is None else extra)
+
+
+class TrainMeta(State):
+    """Alias kept for API clarity: the sidecar saved next to a TrainState."""
+
+
+def abstract_like(state: TrainState) -> TrainState:
+    """Shape/dtype/sharding skeleton for checkpoint restore."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding") else x,
+        state)

@@ -51,3 +51,6 @@ BARRIER_TIMEOUT_RESIZE = _f("EDL_TPU_RESIZE_BARRIER_TIMEOUT", 60.0)
 # crashes from a peer pod's death can resolve into a membership change
 # instead; -1 = auto (ttl + generator + watcher slack)
 FAIL_GRACE = _f("EDL_TPU_FAIL_GRACE", -1.0)
+# cap on the leader's wait for member pods' final statuses before it
+# writes the job flag from what it sees (launcher._leader_final_verdict)
+VERDICT_TIMEOUT = _f("EDL_TPU_VERDICT_TIMEOUT", 600.0)
