@@ -238,7 +238,10 @@ class Launcher:
                          "still unfinished; writing verdict from statuses seen",
                          constants.VERDICT_TIMEOUT)
         statuses = load_pods_status(self._store, job_id)
-        if any(statuses.get(pid) == Status.FAILED for pid in members):
-            save_job_status(self._store, job_id, Status.FAILED)
-        else:
+        # SUCCEED only when every member SUCCEEDed; a member with no
+        # terminal status (hung past the cap, died unreported) fails the
+        # job, consistently with the dead_grace path above
+        if all(statuses.get(pid) == Status.SUCCEED for pid in members):
             save_job_status(self._store, job_id, Status.SUCCEED)
+        else:
+            save_job_status(self._store, job_id, Status.FAILED)

@@ -1,0 +1,24 @@
+"""Distributed data service (SURVEY.md §2.4/§3.5 — the reference's WIP
+pillar, finished here).
+
+A leader-hosted :class:`DataService` splits the file list across pods
+and hands out produced batch ids exactly once; every pod runs a
+:class:`PodDataServer` that serves its locally-produced batches to
+peers; the trainer-side :class:`DistributedReader` produces, reports,
+pulls its balanced share (possibly from other pods' caches) and records
+:class:`~edl_tpu.cluster.state.DataCheckpoint` ranges for resume.
+
+Redesign notes vs the reference (python/edl/utils/data_server.py:431,
+python/edl/collective/distribute_reader.py:391 — broken as written,
+SURVEY.md §2.4): batch distribution is pull-based work stealing with an
+in-flight table (re-queued when a consumer pod dies) instead of the
+barrier-then-average push rebalance, which preserves the exactly-once
+id set across pod loss without a global barrier per round.
+"""
+
+from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
+from edl_tpu.data.data_server import DataService, PodDataServer
+from edl_tpu.data.distribute_reader import DistributedReader
+
+__all__ = ["FileSplitter", "TxtFileSplitter", "DataService",
+           "PodDataServer", "DistributedReader"]

@@ -1,0 +1,160 @@
+"""Leader data service + per-pod batch cache server.
+
+Reference protocol (data_server.proto:94-107): GetFileList,
+ReportBatchDataMeta, ReachDataEnd, GetBatchDataMeta, GetBatchData.
+The leader tracks production and hands out batch ids exactly once,
+work-stealing style (see package docstring for the redesign rationale);
+each pod serves raw batch bytes from its own cache so the leader never
+relays data (reference data_server.py:319-330).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.exceptions import EdlStopIteration, EdlTableError
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+
+class _ReaderState:
+    def __init__(self, pods: list[str], file_list: list[str]):
+        self.pods = list(pods)
+        self.file_list = list(file_list)
+        # round-robin file slices (reference PodsData, data_server.py:118-133)
+        self.slices = {pod: [(i, f) for i, f in enumerate(file_list)
+                             if i % len(pods) == pods.index(pod)]
+                       for pod in pods}
+        self.queue: deque = deque()          # (producer_pod, endpoint, batch_id)
+        self.inflight: dict[str, list] = {}  # consumer pod -> metas handed out
+        self.ended: set[str] = set()         # producers done
+        self.total_produced = 0
+        self.total_consumed = 0
+
+
+class DataService:
+    """Leader-hosted; registered on the leader pod's RPC server."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._readers: dict[str, _ReaderState] = {}
+
+    def create_reader(self, reader: str, pods: list[str],
+                      file_list: list[str]) -> dict:
+        with self._lock:
+            if reader not in self._readers:
+                self._readers[reader] = _ReaderState(pods, file_list)
+                logger.info("reader %s: %d files over pods %s", reader,
+                            len(file_list), [p[:8] for p in pods])
+        return {}
+
+    def _state(self, reader: str) -> _ReaderState:
+        st = self._readers.get(reader)
+        if st is None:
+            raise EdlTableError(f"unknown reader {reader!r}")
+        return st
+
+    def get_file_list(self, reader: str, pod_id: str) -> dict:
+        """This pod's (file_idx, path) slice."""
+        with self._lock:
+            st = self._state(reader)
+            if pod_id not in st.slices:
+                raise EdlTableError(f"pod {pod_id} not in reader {reader}")
+            return {"files": st.slices[pod_id]}
+
+    def report_batch_meta(self, reader: str, pod_id: str, endpoint: str,
+                          batch_ids: list[str]) -> dict:
+        with self._lock:
+            st = self._state(reader)
+            for bid in batch_ids:
+                st.queue.append((pod_id, endpoint, bid))
+            st.total_produced += len(batch_ids)
+        return {}
+
+    def reach_data_end(self, reader: str, pod_id: str) -> dict:
+        with self._lock:
+            st = self._state(reader)
+            st.ended.add(pod_id)
+        return {}
+
+    def get_batch_meta(self, reader: str, pod_id: str, n: int = 1,
+                       ack: int = 0) -> dict:
+        """Pop up to ``n`` balanced metas for this consumer; ``ack``
+        confirms that many previously handed-out metas were consumed
+        (freeing them from the in-flight table).  Raises
+        EdlStopIteration when production has ended and the queue is
+        drained."""
+        with self._lock:
+            st = self._state(reader)
+            held = st.inflight.setdefault(pod_id, [])
+            if ack:
+                st.total_consumed += min(ack, len(held))
+                del held[:ack]
+            metas = []
+            while st.queue and len(metas) < n:
+                metas.append(st.queue.popleft())
+            held.extend(metas)
+            if not metas and st.ended >= set(st.pods) and not st.queue:
+                raise EdlStopIteration(f"reader {reader} drained "
+                                      f"({st.total_produced} batches)")
+            return {"metas": metas}
+
+    def requeue_pod(self, reader: str, dead_pod: str) -> dict:
+        """Cluster resize: a consumer died — its unconsumed in-flight
+        metas return to the pool (the no-silent-drops guarantee the
+        reference lacked, SURVEY.md §7 hard parts)."""
+        with self._lock:
+            st = self._state(reader)
+            metas = st.inflight.pop(dead_pod, [])
+            for m in reversed(metas):
+                st.queue.appendleft(m)
+            if metas:
+                logger.info("requeued %d in-flight batches from dead pod %s",
+                            len(metas), dead_pod[:8])
+        return {}
+
+
+class PodDataServer:
+    """Every pod's batch cache + RPC surface.  The leader's instance
+    additionally carries the :class:`DataService`."""
+
+    def __init__(self, pod_id: str, is_leader: bool = False,
+                 host: str | None = None, port: int = 0,
+                 cache_cap: int = 256):
+        self.pod_id = pod_id
+        self._cache: OrderedDict[str, list] = OrderedDict()
+        self._cache_cap = cache_cap
+        self._lock = threading.Lock()
+        self._rpc = RpcServer(host="0.0.0.0", port=port)
+        self._rpc.register("get_batch_data", self.get_batch_data)
+        self.service = DataService() if is_leader else None
+        if self.service is not None:
+            self._rpc.register_instance(self.service)
+        self._rpc.start()
+        self.endpoint = f"{host or local_ip()}:{self._rpc.port}"
+
+    # -- local cache ---------------------------------------------------------
+    def put_batch(self, batch_id: str, records: list) -> None:
+        with self._lock:
+            self._cache[batch_id] = records
+            while len(self._cache) > self._cache_cap:
+                evicted, _ = self._cache.popitem(last=False)
+                logger.warning("cache full: evicted batch %s", evicted)
+
+    def pop_batch(self, batch_id: str):
+        with self._lock:
+            return self._cache.pop(batch_id, None)
+
+    def get_batch_data(self, batch_id: str) -> dict:
+        with self._lock:
+            records = self._cache.get(batch_id)
+        if records is None:
+            raise EdlTableError(f"batch {batch_id} not in cache of {self.pod_id}")
+        return {"records": records}
+
+    def stop(self) -> None:
+        self._rpc.stop()

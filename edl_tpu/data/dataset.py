@@ -1,0 +1,30 @@
+"""File splitters: files → numbered records.
+
+Reference: python/edl/collective/dataset.py (45) — ``FileSplitter``
+yielding ``(record_no, data)`` per record so processed ranges can be
+checkpointed by number (state.py DataCheckpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+class FileSplitter:
+    """Interface: iterate ``(record_no, record)`` over one file."""
+
+    def split(self, path: str) -> Iterator[tuple[int, object]]:
+        raise NotImplementedError
+
+
+class TxtFileSplitter(FileSplitter):
+    """One record per non-empty line (reference TxtFileSplitter)."""
+
+    def split(self, path: str) -> Iterator[tuple[int, str]]:
+        record_no = 0
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    yield record_no, line
+                    record_no += 1

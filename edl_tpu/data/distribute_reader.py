@@ -1,0 +1,139 @@
+"""Trainer-side distributed reader.
+
+Reference intent: python/edl/collective/distribute_reader.py (391,
+broken as written — SURVEY.md §2.4 documents the typos and dead
+modules; this is the working redesign).  Three roles in one object:
+
+- **produce** (thread): parse this pod's file slice into batches of
+  records, cache them in the local :class:`PodDataServer`, report the
+  ids to the leader;
+- **consume** (iterator): pull balanced metas from the leader
+  (ack-previous work-stealing), fetch batch bytes locally or from the
+  producing pod's data server, yield ``(batch_id, records)``;
+- **checkpoint**: every yielded batch marks its record ranges in a
+  :class:`DataCheckpoint` so a resumed job skips processed records
+  (reference data_filter.py stub, state.py:25-31 — finished here).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator
+
+from edl_tpu.cluster.state import DataCheckpoint
+from edl_tpu.data.data_server import PodDataServer
+from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils.exceptions import EdlStopIteration
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class DistributedReader:
+    def __init__(self, reader_name: str, pod_id: str,
+                 leader_endpoint: str, data_server: PodDataServer,
+                 batch_size: int = 32,
+                 splitter: FileSplitter | None = None,
+                 checkpoint: DataCheckpoint | None = None,
+                 meta_prefetch: int = 4):
+        self.name = reader_name
+        self.pod_id = pod_id
+        self._leader = RpcClient(leader_endpoint)
+        self._server = data_server
+        self._bs = batch_size
+        self._splitter = splitter or TxtFileSplitter()
+        self.checkpoint = checkpoint or DataCheckpoint(reader_name)
+        self._prefetch = meta_prefetch
+        self._produce_exc: BaseException | None = None
+        self._peer_clients: dict[str, RpcClient] = {}
+
+    # -- producer ------------------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            files = self._leader.call("get_file_list", reader=self.name,
+                                      pod_id=self.pod_id)["files"]
+            seq = 0
+            batch: list = []
+            spans: list[tuple[int, int, int]] = []  # (file_idx, begin, end)
+            for file_idx, path in files:
+                begin = None
+                for record_no, record in self._splitter.split(path):
+                    if self.checkpoint.is_processed(file_idx, record_no):
+                        continue  # resume: skip checkpointed records
+                    if begin is None:
+                        begin = record_no
+                    batch.append(record)
+                    if len(batch) == self._bs:
+                        spans.append((file_idx, begin, record_no + 1))
+                        seq = self._publish(seq, batch, spans)
+                        batch, spans, begin = [], [], None
+                if begin is not None:
+                    spans.append((file_idx, begin, record_no + 1))
+            if batch:
+                self._publish(seq, batch, spans)
+            self._leader.call("reach_data_end", reader=self.name,
+                              pod_id=self.pod_id)
+        except BaseException as e:  # noqa: BLE001 — surfaced by consumer
+            self._produce_exc = e
+            try:
+                self._leader.call("reach_data_end", reader=self.name,
+                                  pod_id=self.pod_id)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _publish(self, seq: int, batch: list, spans: list) -> int:
+        batch_id = f"{self.pod_id}:{seq}"
+        self._server.put_batch(batch_id, {"records": batch, "spans": spans})
+        self._leader.call("report_batch_meta", reader=self.name,
+                          pod_id=self.pod_id, endpoint=self._server.endpoint,
+                          batch_ids=[batch_id])
+        return seq + 1
+
+    # -- consumer ------------------------------------------------------------
+    def __iter__(self) -> Iterator[tuple[str, list]]:
+        producer = threading.Thread(target=self._produce, daemon=True,
+                                    name=f"produce:{self.name}")
+        producer.start()
+        ack = 0
+        try:
+            while True:
+                try:
+                    metas = self._leader.call(
+                        "get_batch_meta", reader=self.name,
+                        pod_id=self.pod_id, n=self._prefetch,
+                        ack=ack)["metas"]
+                except EdlStopIteration:
+                    break
+                ack = len(metas)
+                if not metas:
+                    if self._produce_exc is not None:
+                        raise self._produce_exc
+                    threading.Event().wait(0.05)
+                    continue
+                for producer_pod, endpoint, batch_id in metas:
+                    payload = self._fetch(producer_pod, endpoint, batch_id)
+                    for file_idx, begin, end in payload["spans"]:
+                        self.checkpoint.mark_processed(file_idx, begin, end)
+                    yield batch_id, payload["records"]
+            # the leader ends the epoch once ALL producers report done —
+            # including one that died mid-slice; surface that here rather
+            # than finish "successfully" with silently-dropped files
+            producer.join(timeout=5.0)
+            if self._produce_exc is not None:
+                raise self._produce_exc
+        finally:
+            producer.join(timeout=5.0)
+            for c in self._peer_clients.values():
+                c.close()
+            self._leader.close()
+
+    def _fetch(self, producer_pod: str, endpoint: str, batch_id: str) -> dict:
+        if producer_pod == self.pod_id:
+            local = self._server.pop_batch(batch_id)
+            if local is not None:
+                return local
+        client = self._peer_clients.get(endpoint)
+        if client is None:
+            client = self._peer_clients[endpoint] = RpcClient(endpoint)
+        return client.call("get_batch_data", batch_id=batch_id)["records"]

@@ -1,0 +1,27 @@
+"""Service distillation plane (SURVEY.md §2.5, L3b).
+
+Students stream minibatches to a fleet of discovered, load-balanced
+teacher inference servers and get teacher predictions back, appended to
+their own batch fields.  TPU-native redesign of the reference's
+``edl.distill``:
+
+- :class:`DistillReader` — the user API (ins/predicts, fixed or
+  dynamic teachers, teacher batch size), reference distill_reader.py;
+- :mod:`~edl_tpu.distill.predict_pool` — the concurrency core (task
+  slicing, per-teacher workers, poison-pill retry accounting,
+  reorder-by-task), reference distill_worker.py — threads instead of
+  multiprocessing (the workers are network-bound; no fork/logging
+  deadlocks to work around);
+- :mod:`~edl_tpu.distill.discovery` + :mod:`~edl_tpu.distill.balance`
+  — teacher registry and greedy client↔teacher rebalance sharded over
+  discovery servers by consistent hash, reference
+  discovery_server.py/balance_table.py;
+- :mod:`~edl_tpu.distill.teacher` — the TPU teacher server: a jitted
+  fixed-shape (pad-and-bucket) forward served over the EDL1 wire,
+  replacing Paddle Serving GPU teachers.
+"""
+
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.predict_client import NopPredictClient, TeacherClient
+
+__all__ = ["DistillReader", "TeacherClient", "NopPredictClient"]

@@ -1,0 +1,240 @@
+"""Teacher↔student balance table.
+
+Reference: balance_table.py (688).  Teachers register in the
+coordination store under ``/distill/<service>/nodes/<endpoint>``
+(TTL-leased, via edl_tpu.coord.register).  Each discovery server runs a
+BalanceTable that:
+
+- self-registers under the ``__balance__`` service and shards service
+  names across discovery servers with the consistent-hash ring
+  (:513-535) — a Register/HeartBeat for a service it doesn't own gets
+  REDIRECT + the owner's endpoint;
+- per service, watches the store for teacher changes and runs the
+  greedy bipartite rebalance (:242-338): ``server_max = ⌈clients/servers⌉``
+  connections per teacher, ``client_max = max(1, ⌊servers/clients⌋)``
+  capped by the client's require_num; over-limit links break, then
+  least-loaded clients link to least-loaded teachers;
+- versions each client's assignment (:340-347): HeartBeat returns the
+  server list only when the version advanced.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+
+from edl_tpu.coord.consistent_hash import ConsistentHash
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+DISTILL_ROOT = "/edl_tpu_distill"
+BALANCE_SERVICE = "__balance__"
+
+# discovery protocol codes (reference distill_discovery.proto:21-50)
+OK = "ok"
+NO_READY = "no_ready"
+REDIRECT = "redirect"
+UNREGISTERED = "unregistered"
+
+
+def service_prefix(service: str) -> str:
+    return f"{DISTILL_ROOT}/{service}/nodes/"
+
+
+def server_key(service: str, endpoint: str) -> str:
+    return f"{DISTILL_ROOT}/{service}/nodes/{endpoint}"
+
+
+@dataclass
+class _Client:
+    client_id: str
+    require_num: int
+    version: int = 0
+    servers: set[str] = field(default_factory=set)
+    last_seen: float = 0.0
+
+
+class Service:
+    """One service's clients + teachers + assignment."""
+
+    def __init__(self, name: str, store, period: float = 3.0):
+        self.name = name
+        self._store = store
+        self._lock = threading.Lock()
+        self._clients: dict[str, _Client] = {}
+        self._servers: set[str] = set()
+        self._watcher = store.watch_prefix(service_prefix(name),
+                                           self._on_change, period)
+        self._refresh_servers()
+
+    def close(self) -> None:
+        self._watcher.stop()
+
+    def _on_change(self, events) -> None:
+        del events
+        self._refresh_servers()
+
+    def _refresh_servers(self) -> None:
+        recs, _ = self._store.get_prefix(service_prefix(self.name))
+        prefix_len = len(service_prefix(self.name))
+        servers = {r.key[prefix_len:] for r in recs}
+        with self._lock:
+            if servers != self._servers:
+                logger.info("service %s teachers: %s", self.name, sorted(servers))
+                self._servers = servers
+                self._rebalance_locked()
+
+    # -- client API ----------------------------------------------------------
+    def add_client(self, client_id: str, require_num: int) -> None:
+        with self._lock:
+            if client_id not in self._clients:
+                self._clients[client_id] = _Client(client_id, max(1, require_num))
+                self._rebalance_locked()
+
+    def remove_client(self, client_id: str) -> None:
+        with self._lock:
+            if self._clients.pop(client_id, None) is not None:
+                self._rebalance_locked()
+
+    def get_servers(self, client_id: str,
+                    known_version: int) -> tuple[int, list[str] | None]:
+        """(version, servers) — servers None when nothing changed."""
+        with self._lock:
+            c = self._clients.get(client_id)
+            if c is None:
+                raise KeyError(client_id)
+            if c.version == known_version:
+                return c.version, None
+            return c.version, sorted(c.servers)
+
+    def is_registered(self, client_id: str) -> bool:
+        with self._lock:
+            return client_id in self._clients
+
+    # -- the greedy rebalance (call with lock held) --------------------------
+    def _rebalance_locked(self) -> None:
+        servers, clients = self._servers, list(self._clients.values())
+        if not clients:
+            return
+        if not servers:
+            for c in clients:
+                if c.servers:
+                    c.servers = set()
+                    c.version += 1
+            return
+        server_max = math.ceil(len(clients) / len(servers))
+        load: dict[str, int] = {s: 0 for s in servers}
+        changed: set[str] = set()
+        # break links to dead teachers, count surviving load
+        for c in clients:
+            kept = c.servers & servers
+            if kept != c.servers:
+                changed.add(c.client_id)
+            c.servers = kept
+            for s in kept:
+                load[s] += 1
+        # per-client cap, then break over-limit links (most-loaded first)
+        for c in clients:
+            cmax = min(c.require_num,
+                       max(1, len(servers) // max(1, len(clients))))
+            while len(c.servers) > cmax:
+                drop = max(c.servers, key=lambda s: load[s])
+                c.servers.discard(drop)
+                load[drop] -= 1
+                changed.add(c.client_id)
+        # break server overload (steal from clients with most conns)
+        for s in sorted(servers, key=lambda s: -load[s]):
+            while load[s] > server_max:
+                victims = [c for c in clients if s in c.servers]
+                victim = max(victims, key=lambda c: len(c.servers))
+                victim.servers.discard(s)
+                load[s] -= 1
+                changed.add(victim.client_id)
+        # greedy link: least-connected clients to least-loaded teachers
+        for c in sorted(clients, key=lambda c: len(c.servers)):
+            cmax = min(c.require_num,
+                       max(1, len(servers) // max(1, len(clients))))
+            candidates = sorted(servers - c.servers, key=lambda s: load[s])
+            for s in candidates:
+                if len(c.servers) >= cmax:
+                    break
+                if load[s] >= server_max and len(c.servers) > 0:
+                    continue
+                c.servers.add(s)
+                load[s] += 1
+                changed.add(c.client_id)
+        for c in clients:
+            if c.client_id in changed:
+                c.version += 1
+
+
+class BalanceTable:
+    """All services on one discovery server + the redirect ring."""
+
+    def __init__(self, store, my_endpoint: str, ring_period: float = 3.0):
+        self._store = store
+        self._endpoint = my_endpoint
+        self._services: dict[str, Service] = {}
+        self._lock = threading.Lock()
+        self._hash = ConsistentHash([my_endpoint])
+        self._ring_watcher = store.watch_prefix(
+            service_prefix(BALANCE_SERVICE), self._on_ring_change, ring_period)
+        self._refresh_ring()
+
+    def close(self) -> None:
+        self._ring_watcher.stop()
+        with self._lock:
+            services = list(self._services.values())
+            self._services = {}
+        for s in services:
+            s.close()
+
+    def _on_ring_change(self, events) -> None:
+        del events
+        self._refresh_ring()
+
+    def _refresh_ring(self) -> None:
+        recs, _ = self._store.get_prefix(service_prefix(BALANCE_SERVICE))
+        plen = len(service_prefix(BALANCE_SERVICE))
+        nodes = sorted({r.key[plen:] for r in recs} | {self._endpoint})
+        self._hash = ConsistentHash(nodes)
+
+    def owner_of(self, service: str) -> str:
+        return self._hash.get_node(service)
+
+    def service(self, name: str) -> Service:
+        with self._lock:
+            svc = self._services.get(name)
+            if svc is None:
+                svc = self._services[name] = Service(name, self._store)
+            return svc
+
+    # -- RPC handlers (wired by DiscoveryServer) -----------------------------
+    def register_client(self, client_id: str, service: str,
+                        require_num: int = 1) -> dict:
+        owner = self.owner_of(service)
+        if owner != self._endpoint:
+            return {"code": REDIRECT, "discovery_servers": [owner]}
+        self.service(service).add_client(client_id, require_num)
+        return {"code": OK}
+
+    def heartbeat(self, client_id: str, service: str, version: int = -1) -> dict:
+        owner = self.owner_of(service)
+        if owner != self._endpoint:
+            return {"code": REDIRECT, "discovery_servers": [owner]}
+        svc = self.service(service)
+        if not svc.is_registered(client_id):
+            return {"code": UNREGISTERED}
+        new_version, servers = svc.get_servers(client_id, version)
+        if not servers and new_version == 0:
+            return {"code": NO_READY, "version": 0}
+        return {"code": OK, "version": new_version, "servers": servers}
+
+    def unregister_client(self, client_id: str, service: str) -> dict:
+        with self._lock:
+            svc = self._services.get(service)
+        if svc is not None:
+            svc.remove_client(client_id)
+        return {"code": OK}

@@ -1,0 +1,76 @@
+"""Teacher RPC client: feed arrays in, prediction arrays out.
+
+Replaces ``paddle_serving_client.Client.predict(feed, fetch)``
+(reference distill_worker.py:197-321) with the EDL1 wire.  Arrays cross
+as ``{"d": dtype, "s": shape, "b": bytes}``; ``predict`` retries 3
+times like the reference (:288-299) before the pool declares the
+teacher dead and requeues the task.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def encode_array(a) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"d": a.dtype.str, "s": list(a.shape), "b": a.tobytes()}
+
+
+def decode_array(d: dict) -> np.ndarray:
+    return np.frombuffer(d["b"], dtype=np.dtype(d["d"])).reshape(d["s"])
+
+
+class TeacherClient:
+    """One connection to one teacher server."""
+
+    def __init__(self, endpoint: str, fetch: list[str],
+                 timeout: float = 30.0, retries: int = 3):
+        self.endpoint = endpoint
+        self._fetch = list(fetch)
+        self._retries = retries
+        self._rpc = RpcClient(endpoint, timeout)
+
+    def predict(self, feed: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        wire = {k: encode_array(v) for k, v in feed.items()}
+        last: Exception | None = None
+        for attempt in range(self._retries):
+            try:
+                r = self._rpc.call("predict", feed=wire, fetch=self._fetch)
+                return {k: decode_array(v) for k, v in r["out"].items()}
+            except Exception as e:  # noqa: BLE001
+                last = e
+                logger.warning("predict on %s failed (%d/%d): %s",
+                               self.endpoint, attempt + 1, self._retries, e)
+        raise ConnectionError(f"teacher {self.endpoint} failed: {last}")
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+class NopPredictClient:
+    """Test fake (reference _TestNopPaddlePredictServer,
+    distill_worker.py:324-333): returns zeros shaped [n, 1] per fetch
+    so the whole pool machinery runs with no server."""
+
+    def __init__(self, endpoint: str = "nop", fetch: list[str] | None = None,
+                 fail_every: int = 0):
+        self.endpoint = endpoint
+        self._fetch = list(fetch or ["prediction"])
+        self._fail_every = fail_every
+        self._calls = 0
+
+    def predict(self, feed: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        self._calls += 1
+        if self._fail_every and self._calls % self._fail_every == 0:
+            raise ConnectionError(f"injected failure on call {self._calls}")
+        n = len(next(iter(feed.values())))
+        return {name: np.zeros((n, 1), np.float32) for name in self._fetch}
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,115 @@
+"""TPU teacher server: jitted fixed-shape inference behind the EDL1 wire.
+
+Replaces the reference's Paddle Serving GPU teachers (bRPC,
+distill_worker.py:197-321; deployment README.md:51-64).  XLA compiles
+one program per batch bucket, so incoming batches are padded up to the
+nearest bucket and results sliced back — the fixed-shape constraint
+SURVEY.md §7 calls out as the TPU-specific hard part.  Teachers
+register under their service in the coordination store (TTL-leased)
+exactly like reference teachers registered in etcd
+(edl.discovery.register, register.py:78-96).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from edl_tpu.coord.register import Register
+from edl_tpu.distill.balance import server_key
+from edl_tpu.distill.predict_client import decode_array, encode_array
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+class TeacherServer:
+    """Serve ``predict_fn(feed_dict) -> fetch_dict`` (a jitted model
+    forward); pad/bucket handled here so predict_fn always sees one of
+    ``buckets`` batch sizes."""
+
+    def __init__(self, predict_fn: Callable[[dict], dict],
+                 host: str | None = None, port: int = 0,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+        self._predict_fn = predict_fn
+        self._buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()  # jax dispatch from rpc threads
+        self._rpc = RpcServer(host="0.0.0.0", port=port)
+        self._rpc.register("predict", self._predict)
+        self._rpc.register("ping", lambda: {"pong": True})
+        self._rpc.start()
+        self.endpoint = f"{host or local_ip()}:{self._rpc.port}"
+        self._register: Register | None = None
+        logger.info("teacher server on %s (buckets %s)", self.endpoint,
+                    self._buckets)
+
+    # -- registration --------------------------------------------------------
+    def register(self, store, service: str, ttl: float | None = None
+                 ) -> "TeacherServer":
+        kw = {"ttl": ttl} if ttl else {}
+        self._register = Register(store, server_key(service, self.endpoint),
+                                  self.endpoint.encode(), **kw)
+        return self
+
+    # -- serving -------------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _predict(self, feed: dict, fetch: list[str]) -> dict:
+        arrays = {k: decode_array(v) for k, v in feed.items()}
+        n = len(next(iter(arrays.values())))
+        out: dict[str, list[np.ndarray]] = {name: [] for name in fetch}
+        done = 0
+        while done < n:
+            take = min(n - done, self._buckets[-1])
+            bucket = self._bucket(take)
+            chunk = {k: _pad_to(a[done:done + take], bucket)
+                     for k, a in arrays.items()}
+            with self._lock:
+                preds = self._predict_fn(chunk)
+            for name in fetch:
+                if name not in preds:
+                    raise KeyError(f"teacher fetch {name!r} not produced "
+                                   f"(has {sorted(preds)})")
+                out[name].append(np.asarray(preds[name])[:take])
+            done += take
+        return {"out": {name: encode_array(np.concatenate(parts))
+                        for name, parts in out.items()}}
+
+    def stop(self) -> None:
+        if self._register is not None:
+            self._register.stop()
+        self._rpc.stop()
+
+
+def _pad_to(a: np.ndarray, n: int) -> np.ndarray:
+    if len(a) == n:
+        return a
+    pad = np.zeros((n - len(a),) + a.shape[1:], a.dtype)
+    return np.concatenate([a, pad])
+
+
+def jit_teacher(model_apply, variables, fetch_name: str = "logits",
+                **apply_kw) -> Callable[[dict], dict]:
+    """Wrap a flax apply into a jitted single-input predict_fn: feeds
+    named in the feed dict are passed positionally in sorted key order."""
+    import jax
+
+    @jax.jit
+    def fwd(*args):
+        return model_apply(variables, *args, **apply_kw)
+
+    def predict(feed: dict) -> dict:
+        args = [feed[k] for k in sorted(feed)]
+        return {fetch_name: np.asarray(fwd(*args))}
+
+    return predict

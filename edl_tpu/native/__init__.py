@@ -1,0 +1,17 @@
+"""Native (C++) runtime components and their Python bindings.
+
+The reference's native capabilities were all external (NCCL, DALI,
+bRPC, etcd — SURVEY.md §0).  This package holds the in-tree native
+layer: ``csrc/`` C++ built on demand with g++ (no pybind11 in the
+image; bindings are ctypes over a C ABI), with pure-Python fallbacks so
+every feature works unbuilt and the formats stay bit-identical between
+the two implementations.
+"""
+
+from edl_tpu.native.build import ensure_built, native_available
+from edl_tpu.native.recordio import (
+    RecordReader, RecordWriter, ShuffleReader, write_records,
+)
+
+__all__ = ["ensure_built", "native_available", "RecordReader",
+           "RecordWriter", "ShuffleReader", "write_records"]

@@ -1,0 +1,71 @@
+"""On-demand g++ build of the native library.
+
+Replaces the reference's cmake-driven native deps (CMakeLists.txt,
+scripts/build.sh) with a zero-config build: first use compiles
+``csrc/*.cc`` into ``build/libedl_native.so``; failures degrade to the
+pure-Python fallbacks rather than breaking the import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_ROOT, "csrc")
+_OUT = os.path.join(_ROOT, "build", "libedl_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _sources() -> list[str]:
+    if not os.path.isdir(_SRC_DIR):
+        return []
+    return sorted(os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+                  if f.endswith(".cc"))
+
+
+def _stale(sources: list[str]) -> bool:
+    if not os.path.exists(_OUT):
+        return True
+    out_mtime = os.path.getmtime(_OUT)
+    return any(os.path.getmtime(s) > out_mtime for s in sources)
+
+
+def ensure_built() -> ctypes.CDLL | None:
+    """Compile (if stale) and dlopen the native library; None if the
+    toolchain or sources are unavailable."""
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        sources = _sources()
+        if not sources:
+            _failed = True
+            return None
+        try:
+            if _stale(sources):
+                os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", "-o", _OUT, *sources]
+                logger.info("building native lib: %s", " ".join(cmd))
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            _lib = ctypes.CDLL(_OUT)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("native build unavailable (%s); using Python "
+                           "fallbacks", detail.strip()[:500])
+            _failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return ensure_built() is not None

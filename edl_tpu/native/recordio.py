@@ -1,0 +1,200 @@
+"""Record IO bindings: CRC-checked record files + shuffle reader.
+
+C++ implementation in csrc/recordio.cc; the pure-Python classes here
+implement the identical on-disk format (zlib.crc32 == the C++ IEEE
+crc32), so files are interchangeable and the test suite cross-checks
+both.  ``use_native=None`` auto-selects.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import random
+import struct
+import threading
+import zlib
+from collections import deque
+from typing import Iterator
+
+from edl_tpu.native.build import ensure_built
+
+MAGIC = b"EDLR"
+VERSION = 1
+_HDR = struct.Struct("<II")  # len, crc
+
+
+def _want_native(use_native: bool | None) -> ctypes.CDLL | None:
+    if use_native is False:
+        return None
+    lib = ensure_built()
+    if lib is None and use_native is True:
+        raise RuntimeError("native recordio requested but unavailable")
+    return lib
+
+
+# -- writer ------------------------------------------------------------------
+class RecordWriter:
+    def __init__(self, path: str, use_native: bool | None = None):
+        self._lib = _want_native(use_native)
+        if self._lib is not None:
+            self._lib.edl_recordio_writer_open.restype = ctypes.c_void_p
+            self._h = self._lib.edl_recordio_writer_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open {path}")
+            self._f = None
+        else:
+            self._f = open(path, "wb")
+            self._f.write(MAGIC + struct.pack("<I", VERSION))
+
+    def write(self, payload: bytes) -> None:
+        if self._f is None:
+            rc = self._lib.edl_recordio_write(
+                ctypes.c_void_p(self._h),
+                (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload),
+                len(payload))
+            if rc != 0:
+                raise OSError("native record write failed")
+        else:
+            self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+            self._f.write(payload)
+
+    def close(self) -> None:
+        if self._f is None:
+            self._lib.edl_recordio_writer_close(ctypes.c_void_p(self._h))
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path: str, records: list[bytes],
+                  use_native: bool | None = None) -> None:
+    with RecordWriter(path, use_native) as w:
+        for r in records:
+            w.write(r)
+
+
+# -- sequential reader -------------------------------------------------------
+class RecordReader:
+    def __init__(self, path: str, use_native: bool | None = None):
+        self._lib = _want_native(use_native)
+        self._path = path
+        if self._lib is not None:
+            self._lib.edl_recordio_reader_open.restype = ctypes.c_void_p
+            self._lib.edl_recordio_read.restype = ctypes.c_int64
+            self._h = self._lib.edl_recordio_reader_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open/parse {path}")
+            self._f = None
+        else:
+            self._f = open(path, "rb")
+            head = self._f.read(8)
+            if head[:4] != MAGIC:
+                self._f.close()
+                raise OSError(f"bad magic in {path}")
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._f is None:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                n = self._lib.edl_recordio_read(ctypes.c_void_p(self._h),
+                                                ctypes.byref(out))
+                if n == -1:
+                    return
+                if n < 0:
+                    raise OSError(f"corrupt record file {self._path}")
+                yield ctypes.string_at(out, n)
+        else:
+            while True:
+                hdr = self._f.read(_HDR.size)
+                if not hdr:
+                    return
+                length, crc = _HDR.unpack(hdr)
+                payload = self._f.read(length)
+                if len(payload) != length or zlib.crc32(payload) != crc:
+                    raise OSError(f"corrupt record file {self._path}")
+                yield payload
+
+    def close(self) -> None:
+        if self._f is None:
+            self._lib.edl_recordio_reader_close(ctypes.c_void_p(self._h))
+        else:
+            self._f.close()
+
+
+# -- shuffle reader ----------------------------------------------------------
+class ShuffleReader:
+    """Uniform sampling from a bounded look-ahead window over many
+    record files; the native version reads and CRC-checks on a C++
+    thread (no GIL in the hot loop)."""
+
+    def __init__(self, paths: list[str], buffer_size: int = 1024,
+                 seed: int = 0, use_native: bool | None = None):
+        self._lib = _want_native(use_native)
+        self._paths = list(paths)
+        self._buffer_size = buffer_size
+        self._seed = seed
+        if self._lib is not None:
+            arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+            self._lib.edl_shuffle_reader_open.restype = ctypes.c_void_p
+            self._lib.edl_shuffle_reader_next.restype = ctypes.c_int64
+            self._lib.edl_shuffle_reader_peek_len.restype = ctypes.c_uint64
+            self._lib.edl_shuffle_reader_error.restype = ctypes.c_char_p
+            self._h = self._lib.edl_shuffle_reader_open(
+                arr, len(paths), buffer_size, seed)
+            self._cap = 1 << 16
+            self._buf = ctypes.create_string_buffer(self._cap)
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._lib is not None:
+            yield from self._iter_native()
+        else:
+            yield from self._iter_python()
+
+    def _iter_native(self) -> Iterator[bytes]:
+        while True:
+            n = self._lib.edl_shuffle_reader_next(
+                ctypes.c_void_p(self._h), ctypes.cast(
+                    self._buf, ctypes.POINTER(ctypes.c_uint8)), self._cap)
+            if n == -3:  # grow to the largest buffered record
+                need = self._lib.edl_shuffle_reader_peek_len(
+                    ctypes.c_void_p(self._h))
+                self._cap = max(self._cap * 2, int(need) + 1)
+                self._buf = ctypes.create_string_buffer(self._cap)
+                continue
+            if n == -1:
+                return
+            if n == -2:
+                err = self._lib.edl_shuffle_reader_error(
+                    ctypes.c_void_p(self._h)).decode()
+                raise OSError(f"shuffle reader failed: {err}")
+            yield ctypes.string_at(self._buf, n)  # copies n bytes, not _cap
+
+    def _iter_python(self) -> Iterator[bytes]:
+        rng = random.Random(self._seed)
+        window: deque[bytes] = deque()
+        for path in self._paths:
+            reader = RecordReader(path, use_native=False)
+            try:
+                for rec in reader:
+                    window.append(rec)
+                    if len(window) >= self._buffer_size:
+                        idx = rng.randrange(len(window))
+                        window[idx], window[-1] = window[-1], window[idx]
+                        yield window.pop()
+            finally:
+                reader.close()
+        while window:
+            idx = rng.randrange(len(window))
+            window[idx], window[-1] = window[-1], window[idx]
+            yield window.pop()
+
+    def close(self) -> None:
+        if self._lib is not None and self._h:
+            self._lib.edl_shuffle_reader_close(ctypes.c_void_p(self._h))
+            self._h = None

@@ -72,16 +72,7 @@ class ElasticTrainer:
         self._step_fn = None
 
     # -- state construction --------------------------------------------------
-    def create_state(self, init_fn: Callable[[], tuple[Any, Any]],
-                     tx, param_logical=None) -> TrainState:
-        """Build a TrainState with parameters born sharded.
-
-        ``init_fn() -> (params, extra)``; ``param_logical`` is a pytree of
-        logical-axes tuples matching params (None → fully replicated, the
-        reference's DP layout).  Sharding is constrained *inside* the
-        jitted init so ``tx.init`` inherits it and the optimizer state
-        (momenta) comes out sharded like its parameters — the FSDP
-        memory win falls out of propagation, not bookkeeping."""
+    def _build_fn(self, init_fn, tx, param_logical):
         mesh, rules = self.mesh, self.rules
 
         def constrain(params):
@@ -106,24 +97,54 @@ class ElasticTrainer:
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state, tx=tx, extra=extra)
 
-        return jax.jit(build)()
+        return build
+
+    def create_state(self, init_fn: Callable[[], tuple[Any, Any]],
+                     tx, param_logical=None) -> TrainState:
+        """Build a TrainState with parameters born sharded.
+
+        ``init_fn() -> (params, extra)``; ``param_logical`` is a pytree of
+        logical-axes tuples matching params (None → fully replicated, the
+        reference's DP layout).  Sharding is constrained *inside* the
+        jitted init so ``tx.init`` inherits it and the optimizer state
+        (momenta) comes out sharded like its parameters — the FSDP
+        memory win falls out of propagation, not bookkeeping."""
+        return jax.jit(self._build_fn(init_fn, tx, param_logical))()
+
+    def _abstract_state(self, init_fn, tx, param_logical) -> TrainState:
+        """Shape/dtype/sharding skeleton WITHOUT materialising arrays, so
+        a restore never pays init memory (AOT-compile the init to learn
+        the output shardings); falls back to materialise-and-discard."""
+        build = self._build_fn(init_fn, tx, param_logical)
+        try:
+            compiled = jax.jit(build).lower().compile()
+            shardings = compiled.output_shardings
+            shapes = jax.eval_shape(build)
+            return jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                shapes, shardings)
+        except Exception:  # noqa: BLE001 — AOT introspection unavailable
+            logger.exception("AOT abstract state failed; materialising init")
+            return abstract_like(jax.jit(build)())
 
     def restore_or_create(self, init_fn, tx, param_logical=None,
                           ) -> tuple[TrainState, State]:
-        state = self.create_state(init_fn, tx, param_logical)
         meta = State(total_batch_size=self.cfg.global_batch_size)
-        if self.ckpt is not None:
-            restored = self.ckpt.restore(abstract_like(state))
-            if restored is not None:
-                state, saved_meta = restored
-                if saved_meta is not None:
-                    meta = saved_meta
-                old_world = _last_world(meta)
-                new_world = self.world_size
-                if old_world and old_world != new_world:
-                    logger.info("world size %d -> %d; running adjust functions",
-                                old_world, new_world)
-                    self.adjust.run(old_world, new_world, meta)
+        if self.ckpt is None or self.ckpt.latest_step() is None:
+            return self.create_state(init_fn, tx, param_logical), meta
+        restored = self.ckpt.restore(
+            self._abstract_state(init_fn, tx, param_logical))
+        assert restored is not None
+        state, saved_meta = restored
+        if saved_meta is not None:
+            meta = saved_meta
+        old_world = _last_world(meta)
+        new_world = self.world_size
+        if old_world and old_world != new_world:
+            logger.info("world size %d -> %d; running adjust functions",
+                        old_world, new_world)
+            self.adjust.run(old_world, new_world, meta)
         return state, meta
 
     # -- the step ------------------------------------------------------------

@@ -1,0 +1,164 @@
+"""Distributed data service: exactly-once delivery, work stealing,
+resume-by-checkpoint, dead-consumer requeue."""
+
+import threading
+
+import pytest
+
+from edl_tpu.cluster.state import DataCheckpoint
+from edl_tpu.data import DistributedReader, PodDataServer
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils.exceptions import EdlStopIteration
+
+
+@pytest.fixture
+def files(tmp_path):
+    paths = []
+    for f in range(4):
+        p = tmp_path / f"part-{f}.txt"
+        p.write_text("".join(f"f{f}r{r}\n" for r in range(10)))
+        paths.append(str(p))
+    return paths
+
+
+def make_pod(pod_id, leader=False):
+    return PodDataServer(pod_id, is_leader=leader)
+
+
+def test_two_pods_exactly_once(files):
+    a = make_pod("podA", leader=True)
+    b = make_pod("podB")
+    a.service.create_reader("r1", ["podA", "podB"], files)
+    try:
+        ra = DistributedReader("r1", "podA", a.endpoint, a, batch_size=4)
+        rb = DistributedReader("r1", "podB", a.endpoint, b, batch_size=4)
+        got = {"podA": [], "podB": []}
+
+        def consume(r, key):
+            for _, records in r:
+                got[key].extend(records)
+
+        ta = threading.Thread(target=consume, args=(ra, "podA"))
+        tb = threading.Thread(target=consume, args=(rb, "podB"))
+        ta.start(); tb.start(); ta.join(20); tb.join(20)
+        assert not ta.is_alive() and not tb.is_alive()
+        all_records = got["podA"] + got["podB"]
+        # exactly-once across both consumers, whatever the steal split
+        assert sorted(all_records) == sorted(
+            f"f{f}r{r}" for f in range(4) for r in range(10))
+    finally:
+        a.stop(); b.stop()
+
+
+def test_remote_fetch_of_peer_batches(files):
+    """podB only produces; podA consumes everything — podB's batches
+    must arrive over podB's data-server RPC."""
+    a = make_pod("podA", leader=True)
+    b = make_pod("podB")
+    a.service.create_reader("rr", ["podA", "podB"], files)
+    try:
+        ra = DistributedReader("rr", "podA", a.endpoint, a, batch_size=4)
+        rb = DistributedReader("rr", "podB", a.endpoint, b, batch_size=4)
+        tb = threading.Thread(target=rb._produce)
+        tb.start()
+        got = []
+        for _, records in ra:
+            got.extend(records)
+        tb.join(10)
+        assert sorted(got) == sorted(
+            f"f{f}r{r}" for f in range(4) for r in range(10))
+    finally:
+        a.stop(); b.stop()
+
+
+def test_checkpoint_resume_skips_processed(files):
+    a = make_pod("podA", leader=True)
+    a.service.create_reader("r2", ["podA"], files)
+    try:
+        ra = DistributedReader("r2", "podA", a.endpoint, a, batch_size=4)
+        consumed = []
+        for _, records in ra:
+            consumed.extend(records)
+            if len(consumed) >= 12:
+                break
+        ckpt_json = ra.checkpoint.to_json()
+    finally:
+        a.stop()
+
+    # resume with the checkpoint: only unprocessed records appear
+    a2 = make_pod("podA", leader=True)
+    a2.service.create_reader("r2", ["podA"], files)
+    try:
+        ckpt = DataCheckpoint().from_json(ckpt_json)
+        ra2 = DistributedReader("r2", "podA", a2.endpoint, a2, batch_size=4,
+                                checkpoint=ckpt)
+        rest = []
+        for _, records in ra2:
+            rest.extend(records)
+        assert not (set(consumed) & set(rest))
+        assert sorted(consumed + rest) == sorted(
+            f"f{f}r{r}" for f in range(4) for r in range(10))
+    finally:
+        a2.stop()
+
+
+def test_requeue_dead_consumer(files):
+    a = make_pod("podA", leader=True)
+    a.service.create_reader("r3", ["podA"], files[:1])
+    try:
+        svc = a.service
+        svc.report_batch_meta("r3", "podA", a.endpoint, ["podA:0", "podA:1"])
+        # podB grabs both batches then dies without consuming
+        svc.get_batch_meta("r3", "podB", n=2)
+        assert svc.get_batch_meta("r3", "podA", n=2)["metas"] == []
+        svc.requeue_pod("r3", "podB")
+        metas = svc.get_batch_meta("r3", "podA", n=2)["metas"]
+        assert [m[2] for m in metas] == ["podA:0", "podA:1"]
+    finally:
+        a.stop()
+
+
+def test_spans_correct_across_file_boundaries(files):
+    """A batch spanning a file boundary must checkpoint per-file spans
+    with per-file offsets (regression: begin must reset per file)."""
+    a = make_pod("podA", leader=True)
+    # batch_size 16 over 10-record files forces every batch to span files
+    a.service.create_reader("rs", ["podA"], files)
+    try:
+        ra = DistributedReader("rs", "podA", a.endpoint, a, batch_size=16)
+        for _, _records in ra:
+            pass
+        ckpt = ra.checkpoint
+        for f in range(4):
+            for r in range(10):
+                assert ckpt.is_processed(f, r), (f, r, ckpt.to_dict())
+        for pr in ckpt.processed:
+            assert 0 <= pr.begin < pr.end <= 10
+    finally:
+        a.stop()
+
+
+def test_producer_error_surfaces_to_consumer(files, tmp_path):
+    a = make_pod("podA", leader=True)
+    missing = str(tmp_path / "nope.txt")
+    a.service.create_reader("re", ["podA"], files[:1] + [missing])
+    try:
+        ra = DistributedReader("re", "podA", a.endpoint, a, batch_size=4)
+        with pytest.raises(FileNotFoundError):
+            for _ in ra:
+                pass
+    finally:
+        a.stop()
+
+
+def test_data_end_raises_typed_error(files):
+    a = make_pod("podA", leader=True)
+    a.service.create_reader("r4", ["podA"], files[:1])
+    try:
+        client = RpcClient(a.endpoint)
+        a.service.reach_data_end("r4", "podA")
+        with pytest.raises(EdlStopIteration):
+            client.call("get_batch_meta", reader="r4", pod_id="podA", n=1)
+        client.close()
+    finally:
+        a.stop()
