@@ -1,0 +1,103 @@
+"""fit_a_line, elastic (reference example/fit_a_line/train_ft.py).
+
+The minimum end-to-end slice (SURVEY.md §7 build order step 3): run
+under the elastic launcher on every host,
+
+    python -m edl_tpu.collective.launch --job_id lin --nodes_range 1:4 \
+        --checkpoint_dir /tmp/lin-ckpt examples/collective/train_linear.py \
+        -- --epochs 4 --steps_per_epoch 8
+
+it reads the ``EDL_TPU_*`` env ABI, bootstraps jax.distributed when the
+world is >1 host, trains a linear regressor data-parallel with per-epoch
+Orbax checkpoints, and resumes from the last epoch whenever the
+launcher restarts it (elastic stop-resume).  The adjust hook rescales
+the LR linearly on world-size change (reference state.py:142).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--steps_per_epoch", type=int, default=8)
+    p.add_argument("--batch_size", type=int, default=16, help="per host")
+    p.add_argument("--base_lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.cluster.state import State
+    from edl_tpu.coord.client import connect
+    from edl_tpu.parallel import MeshSpec
+    from edl_tpu.train import ElasticTrainer, TrainConfig, scale_lr_for_batch
+    from edl_tpu.train.distributed import initialize_from_env
+
+    tenv = initialize_from_env(TrainerEnv())
+    store = None
+    if tenv.coord_endpoints:
+        try:
+            store = connect(tenv.coord_endpoints)
+        except Exception:  # noqa: BLE001 — standalone run
+            store = None
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(13, 1)).astype(np.float32)
+
+    step_sleep = float(os.environ.get("EDL_TPU_DEMO_STEP_SLEEP", "0"))
+
+    def data_fn(epoch: int):
+        erng = np.random.default_rng(1000 + epoch * 100 + tenv.pod_rank)
+        for _ in range(args.steps_per_epoch):
+            if step_sleep:  # integration tests pace the run to force joins
+                import time
+                time.sleep(step_sleep)
+            x = erng.normal(size=(args.batch_size, 13)).astype(np.float32)
+            yield {"x": x, "y": x @ w_true}
+
+    def loss_fn(params, extra, batch, rng_):
+        pred = batch["x"] @ params["w"] + params["b"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, (extra, {"mse": loss})
+
+    global_batch = args.batch_size * max(1, tenv.world_size)
+    lr = scale_lr_for_batch(args.base_lr, global_batch, base_batch=16)
+
+    cfg = TrainConfig(mesh_spec=MeshSpec(),
+                      checkpoint_dir=tenv.checkpoint_dir or "/tmp/edl-lin-ckpt",
+                      global_batch_size=global_batch, log_every=0)
+    trainer = ElasticTrainer(loss_fn, cfg, store=store, tenv=tenv)
+    # LR rescale on resize: record for observability (the lr above is
+    # already recomputed from the new world size on restart)
+    trainer.adjust.register(
+        lambda old, new, st: print(f"[adjust] world {old} -> {new}",
+                                   flush=True))
+
+    def init():
+        return {"w": jnp.zeros((13, 1)), "b": jnp.zeros((1,))}, None
+
+    state, meta = trainer.restore_or_create(init, optax.sgd(lr))
+    print(f"[train_linear] rank={tenv.global_rank}/{tenv.world_size} "
+          f"resume_epoch={meta.next_epoch} lr={lr:.4f}", flush=True)
+    state, meta = trainer.fit(state, meta, data_fn, epochs=args.epochs)
+    final = float(np.mean((np.asarray(state.params["w"]) - w_true) ** 2))
+    print(f"[train_linear] done: epochs={sorted(e.epoch_no for e in meta.epochs)} "
+          f"w_err={final:.5f}", flush=True)
+    marker = os.environ.get("EDL_TPU_DEMO_MARKER")
+    if marker:
+        with open(marker, "a") as f:
+            f.write(f"done rank={tenv.global_rank} world={tenv.world_size} "
+                    f"epochs={sorted(e.epoch_no for e in meta.epochs)} "
+                    f"w_err={final:.5f}\n")
+
+
+if __name__ == "__main__":
+    main()
