@@ -13,8 +13,9 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import psutil
 
@@ -27,12 +28,49 @@ logger = get_logger(__name__)
 _PROXY_VARS = ("http_proxy", "https_proxy", "HTTP_PROXY", "HTTPS_PROXY")
 
 
+class _LogTail(threading.Thread):
+    """Follow a workerlog and echo new bytes to the launcher's stdout —
+    the reference tailed pod-local rank 0's log through the launcher
+    (train_process.py:115-127) so a user watching the launcher sees
+    training progress without hunting for workerlog files."""
+
+    def __init__(self, path: str, start_offset: int, period: float = 0.5):
+        super().__init__(daemon=True, name=f"logtail:{os.path.basename(path)}")
+        self._path = path
+        self._offset = start_offset
+        self._period = period
+        # NB: not named _stop — threading.Thread uses that name internally
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self._period):
+            self._drain()
+        self._drain()  # final flush so exit-time lines are not lost
+
+    def _drain(self) -> None:
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except OSError:
+            return
+        if chunk:
+            self._offset += len(chunk)
+            sys.stdout.write(chunk.decode(errors="replace"))
+            sys.stdout.flush()
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=2.0)
+
+
 @dataclass
 class TrainerProc:
     proc: subprocess.Popen
     global_rank: int
     rank_in_pod: int
     log_path: str
+    tail: _LogTail | None = field(default=None, repr=False)
 
 
 def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
@@ -46,12 +84,18 @@ def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
         env.update(trainer_env_vars(job_env, pod, trainer, cluster))
         log_path = os.path.join(log_dir, f"workerlog.{trainer.rank_in_pod}")
         logf = open(log_path, "ab", buffering=0)
+        offset = logf.tell()
         cmd = [sys.executable, "-u", training_script] + list(script_args)
         proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT)
         logf.close()  # child holds its own fd
         logger.info("spawned trainer global_rank=%d pid=%d log=%s",
                     trainer.global_rank, proc.pid, log_path)
-        procs.append(TrainerProc(proc, trainer.global_rank, trainer.rank_in_pod, log_path))
+        tail = None
+        if trainer.rank_in_pod == 0:
+            tail = _LogTail(log_path, offset)
+            tail.start()
+        procs.append(TrainerProc(proc, trainer.global_rank, trainer.rank_in_pod,
+                                 log_path, tail))
     return procs
 
 
@@ -97,6 +141,8 @@ def terminate_procs(procs: list[TrainerProc], grace: float = 3.0) -> None:
             tp.proc.wait(timeout=grace)
         except subprocess.TimeoutExpired:  # pragma: no cover - kill-resistant child
             logger.warning("trainer pid %d did not die", tp.proc.pid)
+        if tp.tail is not None:
+            tp.tail.stop()
 
 
 def _tail(path: str, n: int = 30) -> str:

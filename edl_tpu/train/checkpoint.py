@@ -34,6 +34,11 @@ class CheckpointManager:
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
             save_interval_steps=max(1, save_interval_steps) if save_interval_steps else 1,
+            # Elastic stop-resume can SIGKILL a trainer mid-async-save;
+            # without this the stale <step>.orbax-checkpoint-tmp poisons
+            # the restarted run's save of the same step (FileExistsError
+            # on primary, rename ENOENT on peers).
+            cleanup_tmp_directories=True,
         )
         self._mngr = ocp.CheckpointManager(self._dir, options=opts)
 

@@ -15,6 +15,8 @@ trainer processes (stop-resume) and this runs again with the new env.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 from edl_tpu.cluster.env import TrainerEnv
@@ -25,21 +27,56 @@ logger = get_logger(__name__)
 _initialized = False
 
 
+def force_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative over plugin side effects.
+
+    Some images pre-register an accelerator PJRT plugin from
+    ``sitecustomize`` and override the platform config at import time;
+    a trainer spawned with ``JAX_PLATFORMS=cpu`` then silently gets the
+    plugin platform anyway, and ``jax.distributed.initialize`` becomes
+    a no-op (``process_count()`` stays 1 with no error — two trainers
+    each believe they are a single-host world and race each other's
+    checkpoints).  Re-asserting the env var through the config restores
+    the launcher↔trainer ABI: the environment decides the platform."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats and jax.config.jax_platforms != plats:
+        jax.config.update("jax_platforms", plats)
+
+
 def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
     """Idempotently bootstrap the multi-process JAX runtime.  Single-host
     (world_size <= 1) is a no-op so the same trainer script runs
-    standalone, under tests, and under the elastic launcher."""
+    standalone, under tests, and under the elastic launcher.
+
+    After initialize, verifies the world actually formed
+    (``jax.process_count() == world_size``) — a half-formed world must
+    fail loudly here, not corrupt shared checkpoints later."""
     global _initialized
     tenv = tenv or TrainerEnv()
+    force_platform_from_env()
     if tenv.world_size > 1 and not _initialized:
-        coordinator = tenv.coordinator or tenv.endpoints[0]
+        coordinator = tenv.coordinator or (
+            tenv.trainer_endpoints[0] if tenv.trainer_endpoints else "")
+        if not coordinator:
+            raise RuntimeError(
+                "world_size > 1 but no coordinator address: set "
+                "EDL_TPU_COORDINATOR or EDL_TPU_TRAINER_ENDPOINTS")
+        timeout = int(os.environ.get("EDL_TPU_DIST_INIT_TIMEOUT", "120"))
         logger.info("jax.distributed.initialize(coordinator=%s, n=%d, rank=%d)",
                     coordinator, tenv.world_size, tenv.global_rank)
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=tenv.world_size,
-            process_id=tenv.global_rank)
+            process_id=tenv.global_rank,
+            initialization_timeout=timeout)
         _initialized = True
+        formed = jax.process_count()
+        if formed != tenv.world_size:
+            raise RuntimeError(
+                f"jax.distributed world did not form: process_count()="
+                f"{formed}, expected {tenv.world_size} (coordinator "
+                f"{coordinator}; platform "
+                f"{jax.devices()[0].platform if jax.devices() else '?'})")
     return tenv
 
 

@@ -215,6 +215,13 @@ class ElasticTrainer:
         meta.epoch_no = epoch
         if self.ckpt is not None:
             self.ckpt.save(int(state.step), state, meta, force=True)
+            # Under the elastic launcher a membership change SIGTERMs the
+            # trainer between epochs; drain the async save so the resize
+            # never lands before any checkpoint committed (a killed
+            # pending save would cold-start the resized job, losing all
+            # progress).  Standalone runs keep saves fully async.
+            if self.tenv is not None and self.tenv.pod_id:
+                self.ckpt.wait()
         logger.info("epoch %d done: %d steps in %.1fs", epoch, n_steps, dt)
         return state, meta
 

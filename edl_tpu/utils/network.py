@@ -50,11 +50,48 @@ def is_server_alive(endpoint: str, timeout: float = ALIVE_PROBE_TIMEOUT) -> tupl
         return False, None
 
 
+_local_ip_cache: dict[str | None, str] = {}
+
+
+def _self_connectable(ip: str, timeout: float = 0.5) -> bool:
+    """Can a TCP listener bound on ``ip`` be reached at that address?
+    A sandboxed environment may route egress through an interface whose
+    address (e.g. TEST-NET 192.0.2.x) accepts no inbound connections —
+    advertising it would give peers an unreachable endpoint."""
+    try:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as srv:
+            srv.bind((ip, 0))
+            srv.listen(1)
+            port = srv.getsockname()[1]
+            with closing(socket.create_connection((ip, port), timeout=timeout)):
+                return True
+    except OSError:
+        return False
+
+
 def local_ip(probe_endpoint: str | None = None) -> str:
-    """Best-effort local IP (UDP-connect trick; no traffic sent)."""
+    """Local IP that peers can actually connect to.
+
+    Order: ``EDL_TPU_HOST_IP`` env override → UDP-connect trick
+    (no traffic sent) validated by a self-connect probe → loopback.
+    The probe matters: the UDP trick returns the egress interface's
+    address, which in NATed/sandboxed environments may be unroutable
+    for inbound TCP (the jax.distributed coordinator, RPC servers)."""
+    import os
+    override = os.environ.get("EDL_TPU_HOST_IP", "")
+    if override:
+        return override
+    if probe_endpoint in _local_ip_cache:
+        return _local_ip_cache[probe_endpoint]
     try:
         with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
             s.connect((probe_endpoint or "8.8.8.8", 53))
-            return s.getsockname()[0]
+            candidate = s.getsockname()[0]
+        if _self_connectable(candidate):
+            # only successful probes are cached — a transient failure
+            # (NIC not up yet) must not pin loopback for the process life
+            _local_ip_cache[probe_endpoint] = candidate
+            return candidate
     except OSError:
-        return "127.0.0.1"
+        pass
+    return "127.0.0.1"
