@@ -70,6 +70,7 @@ class ElasticTrainer:
                                        self.cfg.max_to_keep)
                      if self.cfg.checkpoint_dir else None)
         self._step_fn = None
+        self._eval_cache: dict[int, Any] = {}
 
     # -- state construction --------------------------------------------------
     def _build_fn(self, init_fn, tx, param_logical):
@@ -176,9 +177,14 @@ class ElasticTrainer:
     # -- the loop ------------------------------------------------------------
     def fit(self, state: TrainState, meta: State,
             data_fn: Callable[[int], Iterable[Any]], epochs: int,
-            rng: jax.Array | None = None) -> tuple[TrainState, State]:
+            rng: jax.Array | None = None,
+            on_epoch_end: Callable[[int, TrainState, State], None] | None = None,
+            ) -> tuple[TrainState, State]:
         """Run epochs ``meta.next_epoch .. epochs-1``; each ``data_fn(e)``
-        yields host-local numpy batches.  Returns the final state."""
+        yields host-local numpy batches.  ``on_epoch_end`` runs after the
+        epoch checkpoint (eval pass, benchmark dump — the reference's
+        per-epoch test hook, train_with_fleet.py:642-658).  Returns the
+        final state."""
         rng = jax.random.key(0) if rng is None else rng
         self._report(TrainStatus.RUNNING)
         for epoch in range(meta.next_epoch, epochs):
@@ -187,6 +193,8 @@ class ElasticTrainer:
             # per-epoch fold so dropout/augmentation differ across epochs
             state, meta = self._run_epoch(state, meta, data_fn, epoch,
                                           jax.random.fold_in(rng, epoch))
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, state, meta)
         if self.ckpt is not None:
             self.ckpt.wait()
         self._report(TrainStatus.SUCCEED)
@@ -227,8 +235,53 @@ class ElasticTrainer:
 
     # -- eval ----------------------------------------------------------------
     def make_eval_step(self, metric_fn):
-        """``metric_fn(params, extra, batch) -> dict`` jitted on the mesh."""
-        return jax.jit(metric_fn)
+        """Masked-sum eval step for ``metric_fn(params, extra, batch) ->
+        {name: (B,) per-example values}``, jitted once per metric_fn and
+        cached (a fresh jit per epoch would recompile the eval graph
+        every time)."""
+        key = id(metric_fn)
+        if key not in self._eval_cache:
+            def step(params, extra, batch, mask):
+                vals = metric_fn(params, extra, batch)
+                return ({k: (v * mask).sum() for k, v in vals.items()},
+                        mask.sum())
+            self._eval_cache[key] = jax.jit(step)
+        return self._eval_cache[key]
+
+    def evaluate(self, state: TrainState, batches: Iterable[Any],
+                 metric_fn) -> dict[str, float]:
+        """Sample-weighted means of per-example metrics — the per-epoch
+        test pass of the reference (train_with_fleet.py:642-658).
+
+        ``metric_fn(params, extra, batch) -> {name: (B,) array}`` — one
+        value per example, so ragged final batches can be zero-padded to
+        the mesh's batch divisor and masked out exactly.
+
+        Multi-host contract: every process must yield the SAME NUMBER of
+        batches (each feeds its shard of the global batch; a host with
+        extra batches would issue an unmatched collective and hang the
+        job).  Feeding identical files on every host is always safe."""
+        jitted = self.make_eval_step(metric_fn)
+        div = batch_divisor(self.mesh)
+        totals: dict[str, float] = {}
+        count = 0.0
+        for batch in batches:
+            n = len(next(iter(jax.tree.leaves(batch))))
+            pad = (-n) % div
+            if pad:
+                batch = jax.tree.map(
+                    lambda x: np.concatenate(
+                        [x, np.zeros((pad,) + np.asarray(x).shape[1:],
+                                     np.asarray(x).dtype)]), batch)
+            mask = np.concatenate([np.ones(n, np.float32),
+                                   np.zeros(pad, np.float32)])
+            g = shard_host_batch({"batch": batch, "mask": mask},
+                                 self.mesh, self.rules)
+            sums, m = jitted(state.params, state.extra, g["batch"], g["mask"])
+            for k, v in sums.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            count += float(m)
+        return {k: v / max(1.0, count) for k, v in totals.items()}
 
     # -- train-status reporting ---------------------------------------------
     def _report(self, status: TrainStatus) -> None:

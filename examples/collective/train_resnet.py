@@ -1,0 +1,265 @@
+"""Elastic collective ResNet training — the headline workload.
+
+TPU-native counterpart of the reference's
+``example/collective/resnet50/train_with_fleet.py:278-658``: model +
+loss build, cosine-warmup LR scaled by the global batch (:128-146),
+checkpoint resume (:426-434), per-epoch eval + benchmark JSON dump
+(:642-658) — with bf16 in place of fp16 AMP (no loss scaling needed on
+TPU), ``jax.checkpoint`` remat in place of Fleet recompute, and the
+recordio image pipeline (edl_tpu/data/images.py) in place of DALI.
+
+Run under the elastic launcher on every host::
+
+    python -m edl_tpu.collective.launch --job_id rn50 --nodes_range 1:8 \
+        --checkpoint_dir /ckpt/rn50 examples/collective/train_resnet.py \
+        -- --data_dir /data/imagenet-rec --epochs 90 --batch_size 256
+
+With ``--synthetic N`` it generates a learnable toy dataset first (CI
+and smoke tests; no ImageNet required).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", type=str, default="")
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="generate a toy dataset with N classes instead of "
+                        "reading --data_dir")
+    p.add_argument("--synthetic_per_file", type=int, default=64)
+    p.add_argument("--synthetic_files", type=int, default=4)
+    p.add_argument("--model", type=str, default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50", "resnet50vd",
+                            "resnet101", "resnet152"])
+    p.add_argument("--width", type=int, default=64)
+    p.add_argument("--num_classes", type=int, default=1000)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--batch_size", type=int, default=256, help="per host")
+    p.add_argument("--base_lr", type=float, default=0.1)
+    p.add_argument("--warmup_epochs", type=float, default=5.0)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight_decay", type=float, default=1e-4)
+    p.add_argument("--label_smoothing", type=float, default=0.1)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize the backward (Fleet recompute analog)")
+    p.add_argument("--steps_per_epoch", type=int, default=0,
+                   help="cap steps per epoch (0 = full dataset)")
+    p.add_argument("--eval", action="store_true", default=True)
+    p.add_argument("--no-eval", dest="eval", action="store_false")
+    p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--bench_dump", type=str, default="",
+                   help="write per-epoch benchmark JSON here "
+                        "(train_with_fleet.py:642-658)")
+    return p.parse_args()
+
+
+MODELS = {
+    "resnet18": "ResNet18", "resnet34": "ResNet34", "resnet50": "ResNet50",
+    "resnet50vd": "ResNet50vd", "resnet101": "ResNet101",
+    "resnet152": "ResNet152",
+}
+
+
+def _generate_synthetic_once(images, data_dir: str, args) -> None:
+    """Exactly one process (of possibly many pods sharing a host dir)
+    generates the toy dataset; the rest wait for its completion marker."""
+    os.makedirs(data_dir, exist_ok=True)
+    done = os.path.join(data_dir, ".synth-done")
+    lock = os.path.join(data_dir, ".synth-lock")
+    while not os.path.exists(done):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except FileExistsError:
+            # wait for the lock holder; if it was killed (elastic restart)
+            # steal the stale lock and generate ourselves
+            deadline = time.monotonic() + 60
+            while not os.path.exists(done) and time.monotonic() < deadline:
+                time.sleep(0.25)
+            if not os.path.exists(done):
+                try:
+                    os.unlink(lock)
+                except FileNotFoundError:
+                    pass
+            continue
+        try:
+            images.write_synthetic_imagenet(
+                data_dir, n_files=args.synthetic_files,
+                per_file=args.synthetic_per_file, size=args.image_size,
+                classes=args.synthetic, prefix="train")
+            images.write_synthetic_imagenet(
+                data_dir, n_files=1, per_file=args.synthetic_per_file,
+                size=args.image_size, classes=args.synthetic, seed=99,
+                prefix="val")
+            with open(done, "w") as f:
+                f.write("ok")
+        finally:
+            try:
+                os.unlink(lock)
+            except FileNotFoundError:
+                pass
+
+
+def main() -> None:
+    args = parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.coord.client import connect
+    from edl_tpu.data import images
+    from edl_tpu.models import resnet as resnet_mod
+    from edl_tpu.parallel import MeshSpec
+    from edl_tpu.train import (
+        ElasticTrainer, TrainConfig, cosine_warmup, scale_lr_for_batch,
+    )
+    from edl_tpu.train.distributed import initialize_from_env
+
+    tenv = initialize_from_env(TrainerEnv())
+    store = None
+    if tenv.coord_endpoints and tenv.pod_id:
+        try:
+            store = connect(tenv.coord_endpoints)
+        except Exception:  # noqa: BLE001 — standalone run
+            store = None
+
+    world = max(1, tenv.world_size)
+    rank = tenv.global_rank
+
+    # -- data -----------------------------------------------------------------
+    if args.synthetic:
+        data_dir = args.data_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "edl-synth")
+        _generate_synthetic_once(images, data_dir, args)
+        args.num_classes = args.synthetic
+    else:
+        data_dir = args.data_dir
+        assert data_dir, "--data_dir or --synthetic required"
+    train_files = sorted(glob.glob(os.path.join(data_dir, "train-*.rec")))
+    val_files = sorted(glob.glob(os.path.join(data_dir, "val-*.rec")))
+    assert train_files, f"no train-*.rec under {data_dir}"
+    my_files = images.shard_files(train_files, rank, world)
+
+    # -- model + optimizer ----------------------------------------------------
+    model_cls = getattr(resnet_mod, MODELS[args.model])
+    model = model_cls(num_classes=args.num_classes, width=args.width)
+
+    global_batch = args.batch_size * world
+    lr = scale_lr_for_batch(args.base_lr, global_batch, base_batch=256)
+    per_file = args.synthetic_per_file if args.synthetic else 1281167 // max(1, len(train_files))
+    steps_per_epoch = (args.steps_per_epoch
+                       or max(1, len(my_files) * per_file // args.batch_size))
+    schedule = cosine_warmup(lr, total_steps=args.epochs * steps_per_epoch,
+                             warmup_steps=int(args.warmup_epochs * steps_per_epoch))
+    tx = optax.chain(
+        optax.add_decayed_weights(args.weight_decay),
+        optax.sgd(schedule, momentum=args.momentum, nesterov=True),
+    )
+
+    def apply_train(params, batch_stats, image):
+        fwd = lambda p, bs, x: model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"])
+        if args.remat:
+            fwd = jax.checkpoint(fwd)
+        return fwd(params, batch_stats, image)
+
+    def loss_fn(params, extra, batch, rng):
+        logits, mutated = apply_train(params, extra, batch["image"])
+        labels = optax.smooth_labels(
+            jax.nn.one_hot(batch["label"], args.num_classes),
+            args.label_smoothing)
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        top1 = (logits.argmax(-1) == batch["label"]).mean()
+        return loss, (mutated["batch_stats"], {"top1": top1})
+
+    def metric_fn(params, extra, batch):
+        # per-example values: ElasticTrainer.evaluate masks padding exactly
+        logits = model.apply({"params": params, "batch_stats": extra},
+                             batch["image"], train=False)
+        labels = jax.nn.one_hot(batch["label"], args.num_classes)
+        return {
+            "val_loss": optax.softmax_cross_entropy(logits, labels),
+            "val_top1": (logits.argmax(-1) == batch["label"]).astype(
+                jnp.float32),
+        }
+
+    cfg = TrainConfig(mesh_spec=MeshSpec(),
+                      checkpoint_dir=tenv.checkpoint_dir,
+                      global_batch_size=global_batch, log_every=50)
+    trainer = ElasticTrainer(loss_fn, cfg, store=store, tenv=tenv)
+    trainer.adjust.register(
+        lambda old, new, st: print(f"[adjust] world {old} -> {new}; "
+                                   f"lr now {lr:.4f}", flush=True))
+
+    def init():
+        x = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        return variables["params"], variables["batch_stats"]
+
+    state, meta = trainer.restore_or_create(init, tx)
+    print(f"[train_resnet] {args.model} rank={rank}/{world} "
+          f"resume_epoch={meta.next_epoch} lr={lr:.4f} "
+          f"steps/epoch={steps_per_epoch} files={len(my_files)}", flush=True)
+
+    def data_fn(epoch: int):
+        it = iter(images.ImageBatches(
+            my_files, args.batch_size, image_size=args.image_size,
+            train=True, seed=1000 * epoch + rank,
+            num_workers=args.num_workers))
+        for i, batch in enumerate(it):
+            if args.steps_per_epoch and i >= args.steps_per_epoch:
+                break
+            yield batch
+
+    def on_epoch_end(epoch, st, meta_):
+        attr = meta_.epoch_attr(epoch)
+        n_img = (attr.step_num if attr else 0) * global_batch
+        sec = (attr.step_num * attr.avg_step_time) if attr else 0.0
+        record = {"epoch": epoch, "sec": round(sec, 2),
+                  "img_s": round(n_img / max(sec, 1e-9), 1)}
+        if args.eval and val_files:
+            record.update({k: round(v, 4) for k, v in trainer.evaluate(
+                st,
+                images.ImageBatches(val_files, args.batch_size,
+                                    image_size=args.image_size, train=False,
+                                    num_workers=args.num_workers,
+                                    drop_remainder=False),
+                metric_fn).items()})
+        # persist in the State sidecar so an elastic restart keeps the
+        # records of pre-restart epochs in the final bench dump
+        records = meta_.user_defined.setdefault("bench", [])
+        records[:] = [r for r in records if r["epoch"] != epoch] + [record]
+        print(f"[train_resnet] {json.dumps(record)}", flush=True)
+
+    state, meta = trainer.fit(state, meta, data_fn, epochs=args.epochs,
+                              on_epoch_end=on_epoch_end)
+    bench = sorted(meta.user_defined.get("bench", []),
+                   key=lambda r: r["epoch"])
+    total = sum(r["sec"] for r in bench)
+    if args.bench_dump and rank == 0:
+        with open(args.bench_dump, "w") as f:
+            json.dump({"model": args.model, "global_batch": global_batch,
+                       "world": world, "total_sec": round(total, 2),
+                       "epochs": bench}, f, indent=1)
+    marker = os.environ.get("EDL_TPU_DEMO_MARKER")
+    if marker:
+        with open(marker, "a") as f:
+            f.write(f"done rank={rank} world={world} "
+                    f"epochs={sorted(e.epoch_no for e in meta.epochs)} "
+                    f"last={json.dumps(bench[-1] if bench else {})}\n")
+
+
+if __name__ == "__main__":
+    main()

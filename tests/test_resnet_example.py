@@ -1,0 +1,83 @@
+"""The headline workload under the elastic launcher: 2-pod collective
+ResNet training on the synthetic image dataset with per-epoch eval,
+benchmark dump, and a real 2-process jax.distributed world.
+
+Parity target: example/collective/resnet50/train_with_fleet.py run by
+the reference launcher (test_launch.sh two-pod strategy, SURVEY.md §4).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.cluster.status import Status, load_job_status
+from edl_tpu.coord.client import CoordClient
+from tests.test_launch_integration import FAST, finish
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "examples", "collective", "train_resnet.py")
+
+
+def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None):
+    env = dict(os.environ)
+    env.update(FAST)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["EDL_TPU_DEMO_MARKER"] = os.path.join(tmp, f"marker-{name}")
+    env.update(extra_env or {})
+    log = open(os.path.join(tmp, f"launcher-{name}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", job_id, "--coord_endpoints", coord_ep,
+         "--nodes_range", "2:2", "--nproc_per_node", "1",
+         "--log_dir", os.path.join(tmp, f"log-{name}"), TRAIN, "--",
+         "--synthetic", "4", "--synthetic_per_file", "48",
+         "--synthetic_files", "2", "--data_dir", data_dir,
+         "--model", "resnet18", "--width", "16", "--image_size", "32",
+         "--epochs", "2", "--batch_size", "8", "--steps_per_epoch", "4",
+         "--base_lr", "0.05", "--warmup_epochs", "0",
+         "--num_workers", "2", "--bench_dump", bench],
+        env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    return proc
+
+
+@pytest.mark.slow
+def test_two_pod_resnet_collective(coord_server, tmp_path):
+    ep = f"127.0.0.1:{coord_server.port}"
+    tmp = str(tmp_path)
+    data = os.path.join(tmp, "data")
+    bench = os.path.join(tmp, "bench.json")
+    pa = spawn("rn-e2e", ep, tmp, "a", data, bench)
+    pb = spawn("rn-e2e", ep, tmp, "b", data, bench)
+    assert finish(pa, 420) == 0, _logs(tmp)
+    assert finish(pb, 420) == 0, _logs(tmp)
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "rn-e2e") == Status.SUCCEED
+    client.close()
+
+    # both ranks trained in one world and recorded both epochs
+    for name in ("a", "b"):
+        marker = (tmp_path / f"marker-{name}").read_text()
+        assert "world=2" in marker and "epochs=[0, 1]" in marker, marker
+
+    # rank 0 dumped the per-epoch benchmark with eval metrics
+    dump = json.load(open(bench))
+    assert dump["world"] == 2 and dump["global_batch"] == 16
+    assert len(dump["epochs"]) == 2
+    assert all("val_top1" in e and "img_s" in e for e in dump["epochs"])
+
+
+def _logs(tmp):
+    out = []
+    for root, _, files in os.walk(tmp):
+        for f in files:
+            if f.endswith(".log") or f.startswith("workerlog"):
+                p = os.path.join(root, f)
+                out.append(f"==== {p} ====\n" + open(p, errors="replace").read())
+    return "\n".join(out)

@@ -132,3 +132,23 @@ def test_lr_schedules():
     assert float(p(5)) == pytest.approx(0.4)
     assert float(p(31)) == pytest.approx(0.04)
     assert float(p(61)) == pytest.approx(0.004)
+
+
+def test_evaluate_masks_ragged_batches():
+    """Per-example metrics over batches not divisible by the 8-way mesh:
+    padding must be masked out exactly and jit compiled once."""
+    tr = make_trainer()
+    state = tr.create_state(init_linear, optax.sgd(0.1))
+
+    def metric_fn(params, extra, batch):
+        return {"v": batch["x"][:, 0]}
+
+    vals = [np.arange(10, dtype=np.float32), np.arange(3, dtype=np.float32)]
+    batches = [{"x": np.stack([v] * 13, axis=1)} for v in vals]
+    out = tr.evaluate(state, batches, metric_fn)
+    expect = float(np.concatenate(vals).mean())
+    assert abs(out["v"] - expect) < 1e-6
+    # second call reuses the cached jitted step (no retrace)
+    out2 = tr.evaluate(state, batches, metric_fn)
+    assert out2 == out
+    assert len(tr._eval_cache) == 1
