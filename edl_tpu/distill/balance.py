@@ -21,13 +21,20 @@ BalanceTable that:
 from __future__ import annotations
 
 import math
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 
 from edl_tpu.coord.consistent_hash import ConsistentHash
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# Dead students are expired after this long without a heartbeat so their
+# teacher assignments return to the pool (reference timing-wheel GC,
+# balance_table.py:384-388, :466-493).
+DEFAULT_CLIENT_TTL = float(os.environ.get("EDL_TPU_DISTILL_CLIENT_TTL", "30"))
 
 DISTILL_ROOT = "/edl_tpu_distill"
 BALANCE_SERVICE = "__balance__"
@@ -59,18 +66,35 @@ class _Client:
 class Service:
     """One service's clients + teachers + assignment."""
 
-    def __init__(self, name: str, store, period: float = 3.0):
+    def __init__(self, name: str, store, period: float = 3.0,
+                 client_ttl: float = DEFAULT_CLIENT_TTL):
         self.name = name
         self._store = store
         self._lock = threading.Lock()
         self._clients: dict[str, _Client] = {}
         self._servers: set[str] = set()
+        self._ttl = client_ttl
         self._watcher = store.watch_prefix(service_prefix(name),
                                            self._on_change, period)
         self._refresh_servers()
 
     def close(self) -> None:
         self._watcher.stop()
+
+    def gc_expired(self) -> None:
+        """Expire clients whose heartbeats stopped: a silently-dead
+        student must not hold teacher assignments forever, starving the
+        survivors (reference balance_table.py:466-493).  Driven by the
+        BalanceTable's single sweeper thread."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [cid for cid, c in self._clients.items()
+                    if now - c.last_seen > self._ttl]
+            for cid in dead:
+                del self._clients[cid]
+            if dead:
+                logger.info("service %s: expired clients %s", self.name, dead)
+                self._rebalance_locked()
 
     def _on_change(self, events) -> None:
         del events
@@ -90,8 +114,12 @@ class Service:
     def add_client(self, client_id: str, require_num: int) -> None:
         with self._lock:
             if client_id not in self._clients:
-                self._clients[client_id] = _Client(client_id, max(1, require_num))
+                self._clients[client_id] = _Client(
+                    client_id, max(1, require_num),
+                    last_seen=time.monotonic())
                 self._rebalance_locked()
+            else:
+                self._clients[client_id].last_seen = time.monotonic()
 
     def remove_client(self, client_id: str) -> None:
         with self._lock:
@@ -100,11 +128,13 @@ class Service:
 
     def get_servers(self, client_id: str,
                     known_version: int) -> tuple[int, list[str] | None]:
-        """(version, servers) — servers None when nothing changed."""
+        """(version, servers) — servers None when nothing changed.
+        Counts as a heartbeat for client GC."""
         with self._lock:
             c = self._clients.get(client_id)
             if c is None:
                 raise KeyError(client_id)
+            c.last_seen = time.monotonic()
             if c.version == known_version:
                 return c.version, None
             return c.version, sorted(c.servers)
@@ -173,18 +203,35 @@ class Service:
 class BalanceTable:
     """All services on one discovery server + the redirect ring."""
 
-    def __init__(self, store, my_endpoint: str, ring_period: float = 3.0):
+    def __init__(self, store, my_endpoint: str, ring_period: float = 3.0,
+                 client_ttl: float = DEFAULT_CLIENT_TTL):
         self._store = store
         self._endpoint = my_endpoint
+        self._client_ttl = client_ttl
         self._services: dict[str, Service] = {}
         self._lock = threading.Lock()
         self._hash = ConsistentHash([my_endpoint])
         self._ring_watcher = store.watch_prefix(
             service_prefix(BALANCE_SERVICE), self._on_ring_change, ring_period)
         self._refresh_ring()
+        # one sweeper for all services (thread count must not scale with
+        # client-supplied service-name cardinality)
+        self._gc_halt = threading.Event()
+        self._gc = threading.Thread(target=self._gc_loop, daemon=True,
+                                    name="balance-client-gc")
+        self._gc.start()
+
+    def _gc_loop(self) -> None:
+        while not self._gc_halt.wait(max(0.2, self._client_ttl / 3)):
+            with self._lock:
+                services = list(self._services.values())
+            for svc in services:
+                svc.gc_expired()
 
     def close(self) -> None:
+        self._gc_halt.set()
         self._ring_watcher.stop()
+        self._gc.join(timeout=2.0)
         with self._lock:
             services = list(self._services.values())
             self._services = {}
@@ -208,7 +255,8 @@ class BalanceTable:
         with self._lock:
             svc = self._services.get(name)
             if svc is None:
-                svc = self._services[name] = Service(name, self._store)
+                svc = self._services[name] = Service(
+                    name, self._store, client_ttl=self._client_ttl)
             return svc
 
     # -- RPC handlers (wired by DiscoveryServer) -----------------------------
@@ -225,9 +273,12 @@ class BalanceTable:
         if owner != self._endpoint:
             return {"code": REDIRECT, "discovery_servers": [owner]}
         svc = self.service(service)
-        if not svc.is_registered(client_id):
+        try:
+            new_version, servers = svc.get_servers(client_id, version)
+        except KeyError:
+            # not registered, or expired by GC between check and read —
+            # the client re-registers on this code
             return {"code": UNREGISTERED}
-        new_version, servers = svc.get_servers(client_id, version)
         if not servers and new_version == 0:
             return {"code": NO_READY, "version": 0}
         return {"code": OK, "version": new_version, "servers": servers}

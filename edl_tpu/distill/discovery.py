@@ -31,11 +31,12 @@ class DiscoveryServer:
     """``python -m edl_tpu.distill.discovery --coord_endpoints ...``"""
 
     def __init__(self, store, host: str | None = None, port: int = 0,
-                 ttl: float | None = None):
+                 ttl: float | None = None, client_ttl: float | None = None):
         host = host or local_ip()
         self._rpc = RpcServer(host="0.0.0.0", port=port)
         self.endpoint = f"{host}:{self._rpc.port}"
-        self._table = BalanceTable(store, self.endpoint)
+        table_kw = {"client_ttl": client_ttl} if client_ttl else {}
+        self._table = BalanceTable(store, self.endpoint, **table_kw)
         self._rpc.register("register", self._table.register_client)
         self._rpc.register("heartbeat", self._table.heartbeat)
         self._rpc.register("unregister", self._table.unregister_client)

@@ -34,6 +34,7 @@ from typing import Callable, Iterable, Iterator
 import numpy as np
 
 from edl_tpu.distill.tasks import BatchBuilder, Task
+from edl_tpu.distill.timeline import timeline
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -68,7 +69,10 @@ class _Worker(threading.Thread):
                 pool._in_queue.put(task)  # hand back; we're retiring
                 break
             try:
-                preds = self.client.predict(pool._feed_of(task))
+                with timeline().span("predict", teacher=self.endpoint,
+                                     task=task.task_id,
+                                     n=len(task.samples)):
+                    preds = self.client.predict(pool._feed_of(task))
             except Exception as e:  # noqa: BLE001 — teacher died
                 logger.warning("worker %s failed on task %d: %s",
                                self.endpoint, task.task_id, e)
@@ -242,14 +246,16 @@ class PredictPool:
                 starved_since = None
                 task, preds = a, b
                 done_tasks += 1
-                per_sample = _split_predicts(preds, fetch, len(task.samples))
-                for (batch_id, slot), sample, pred in zip(
-                        task.tags, task.samples, per_sample):
-                    builder = builders.get(batch_id)
-                    if builder is None:
-                        builder = builders[batch_id] = BatchBuilder(
-                            batch_id, batch_sizes[batch_id])
-                    builder.add(slot, sample, pred)
+                with timeline().span("reorder", task=task.task_id):
+                    per_sample = _split_predicts(preds, fetch,
+                                                 len(task.samples))
+                    for (batch_id, slot), sample, pred in zip(
+                            task.tags, task.samples, per_sample):
+                        builder = builders.get(batch_id)
+                        if builder is None:
+                            builder = builders[batch_id] = BatchBuilder(
+                                batch_id, batch_sizes[batch_id])
+                        builder.add(slot, sample, pred)
                 self._sem.release()
                 while next_batch in builders and builders[next_batch].complete:
                     yield builders.pop(next_batch).stack()

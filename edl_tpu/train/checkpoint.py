@@ -65,10 +65,17 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        restored = self._mngr.restore(
-            step, args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state),
-                meta=ocp.args.JsonRestore()))
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    meta=ocp.args.JsonRestore()))
+        except KeyError:
+            # checkpoint written without a State sidecar (e.g. a served
+            # model exported by save(step, state) alone)
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state)))
         meta = None
         if restored.get("meta") is not None:
             meta = State().from_dict(restored["meta"])

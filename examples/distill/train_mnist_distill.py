@@ -1,0 +1,322 @@
+"""Service distillation end-to-end — the minimal real-model flow.
+
+Reference: example/distill/mnist_distill/train_with_fleet.py:1-300 (the
+documented minimal distill example: teacher served behind the wire,
+student adds a ``soft_label`` input and distills against the teacher's
+softmax) plus example/distill/README.md:11-31.
+
+Roles::
+
+    # 1. train the teacher and checkpoint it
+    python train_mnist_distill.py --role teacher_train --teacher_dir /ckpt/t
+
+    # 2. serve it on a TPU host, registered for discovery
+    python -m edl_tpu.distill.discovery --coord_endpoints $COORD &
+    python train_mnist_distill.py --role serve --teacher_dir /ckpt/t \
+        --coord_endpoints $COORD --service mnist-teacher
+
+    # 3. train the student through the discovery-balanced teacher fleet
+    python train_mnist_distill.py --role student \
+        --discovery $DISCOVERY_EP --service mnist-teacher
+
+    # all-in-one smoke (CI): trains teacher, serves, distills, compares
+    python train_mnist_distill.py --role local
+
+The synthetic digit task has label noise on the student's training set;
+the teacher (trained on clean labels) transfers its clean knowledge
+through soft labels, so the distilled student measurably beats the
+no-distill baseline — the README.md:83-85 effect at toy scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", default="local",
+                   choices=["teacher_train", "serve", "student", "local"])
+    p.add_argument("--teacher_dir", default="/tmp/edl-mnist-teacher")
+    p.add_argument("--coord_endpoints", default="")
+    p.add_argument("--service", default="mnist-teacher")
+    p.add_argument("--discovery", default="",
+                   help="discovery server endpoint(s) for the student")
+    p.add_argument("--teachers", default="",
+                   help="fixed teacher endpoints (skip discovery)")
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--image_size", type=int, default=16)
+    p.add_argument("--train_n", type=int, default=512)
+    p.add_argument("--test_n", type=int, default=256)
+    p.add_argument("--label_noise", type=float, default=0.4)
+    p.add_argument("--teacher_epochs", type=int, default=30)
+    p.add_argument("--student_epochs", type=int, default=12)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--teacher_batch_size", type=int, default=16)
+    p.add_argument("--alpha", type=float, default=0.1,
+                   help="hard-label weight; 1-alpha goes to the teacher")
+    p.add_argument("--temperature", type=float, default=2.0)
+    p.add_argument("--out", default="", help="write summary JSON here")
+    return p.parse_args(argv)
+
+
+# -- synthetic digit task ----------------------------------------------------
+def make_digits(n, classes, size, seed, label_noise=0.0):
+    """Class-conditional stripe+blob patterns, learnable by a small CNN."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    x = np.zeros((n, size, size, 1), np.float32)
+    y = rng.integers(0, classes, n).astype(np.int32)
+    for i, c in enumerate(y):
+        period = 2 + int(c)
+        stripes = ((np.arange(size) // period) % 2).astype(np.float32)
+        img = np.outer(stripes, np.ones(size)) if c % 2 == 0 else \
+            np.outer(np.ones(size), stripes)
+        cx = (c * size // classes + size // 4) % size
+        img[:, cx:min(size, cx + 2)] += 0.8
+        x[i, :, :, 0] = img + rng.normal(0, 0.35, (size, size))
+    y_noisy = y.copy()
+    if label_noise > 0:
+        flip = rng.random(n) < label_noise
+        y_noisy[flip] = rng.integers(0, classes, flip.sum())
+    return x, y, y_noisy
+
+
+def batches(x, y, bs, seed, extra=None):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(x))
+    for i in range(0, len(x) - bs + 1, bs):
+        idx = order[i:i + bs]
+        b = {"image": x[idx], "label": y[idx]}
+        if extra is not None:
+            b["teacher_logits"] = extra[idx]
+        yield b
+
+
+# -- teacher -----------------------------------------------------------------
+def train_teacher(args, x, y_clean):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models.mnist import MnistCNN
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    model = MnistCNN(num_classes=args.classes, dtype=jnp.float32)
+
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, (extra, {})
+
+    tr = ElasticTrainer(loss_fn, TrainConfig(log_every=0))
+    state = tr.create_state(
+        lambda: (model.init(jax.random.key(0), x[:1])["params"], None),
+        optax.adam(2e-3))
+    from edl_tpu.cluster.state import State
+    state, _ = tr.fit(state, State(), lambda e: batches(x, y_clean,
+                                                        args.batch_size, e),
+                      epochs=args.teacher_epochs)
+    return model, jax.device_get(state.params)
+
+
+def save_teacher(args, params):
+    from edl_tpu.train.checkpoint import CheckpointManager
+    m = CheckpointManager(args.teacher_dir, max_to_keep=1)
+    m.save(0, {"params": params}, force=True)
+    m.close()
+
+
+def load_teacher(args):
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models.mnist import MnistCNN
+    from edl_tpu.train.checkpoint import CheckpointManager
+
+    model = MnistCNN(num_classes=args.classes, dtype=jnp.float32)
+    x0 = jnp.zeros((1, args.image_size, args.image_size, 1), jnp.float32)
+    shape = jax.eval_shape(
+        lambda: {"params": model.init(jax.random.key(0), x0)["params"]})
+    m = CheckpointManager(args.teacher_dir, max_to_keep=1)
+    restored = m.restore(shape)
+    m.close()
+    assert restored is not None, f"no teacher checkpoint in {args.teacher_dir}"
+    return model, restored[0]["params"]
+
+
+def serve_teacher(args, store, model=None, params=None, block=True):
+    from edl_tpu.distill.teacher import TeacherServer, jit_teacher
+
+    if model is None:
+        model, params = load_teacher(args)
+    predict = jit_teacher(model.apply, {"params": params},
+                          fetch_name="logits", train=False)
+    server = TeacherServer(predict).register(store, args.service)
+    if block:  # pragma: no cover - CLI path
+        ev = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: ev.set())
+        try:
+            ev.wait()
+        finally:
+            server.stop()
+    return server
+
+
+# -- student -----------------------------------------------------------------
+def train_student(args, x, y_noisy, distill_source=None, seed=1):
+    """``distill_source``: None (no distill), or a configured
+    DistillReader factory adding teacher_logits to every batch."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models.mnist import MnistCNN
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    model = MnistCNN(num_classes=args.classes, dtype=jnp.float32)
+    T = args.temperature
+
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        hard = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        if "teacher_logits" in batch:
+            soft = optax.softmax_cross_entropy(
+                logits / T, jax.nn.softmax(batch["teacher_logits"] / T)
+            ).mean() * (T * T)
+            loss = args.alpha * hard + (1 - args.alpha) * soft
+        else:
+            loss = hard
+        return loss, (extra, {})
+
+    tr = ElasticTrainer(loss_fn, TrainConfig(log_every=0))
+    state = tr.create_state(
+        lambda: (model.init(jax.random.key(seed), x[:1])["params"], None),
+        optax.adam(2e-3))
+
+    def data_fn(epoch):
+        if distill_source is None:
+            yield from batches(x, y_noisy, args.batch_size, 100 + epoch)
+            return
+        yield from distill_source(epoch)
+
+    from edl_tpu.cluster.state import State
+    state, _ = tr.fit(state, State(), data_fn, epochs=args.student_epochs)
+    return model, state
+
+
+def make_distill_source(args, x, y_noisy):
+    """DistillReader over the noisy training set: yields batches with the
+    teacher's logits appended (the ``predicts`` fields)."""
+    import numpy as np
+
+    from edl_tpu.distill.reader import DistillReader
+
+    def build(epoch):
+        dr = DistillReader(ins=["image", "label"], predicts=["logits"],
+                           feeds=["image"],
+                           teacher_batch_size=args.teacher_batch_size)
+        if args.teachers:
+            dr.set_fixed_teacher(*args.teachers.split(","))
+        else:
+            dr.set_dynamic_teacher(args.discovery, args.service)
+
+        def gen():
+            for b in batches(x, y_noisy, args.batch_size, 100 + epoch):
+                yield b["image"], b["label"]
+        dr.set_batch_generator(gen)
+        for image, label, logits in dr:
+            yield {"image": np.asarray(image),
+                   "label": np.asarray(label),
+                   "teacher_logits": np.asarray(logits)}
+    return build
+
+
+def accuracy(model, params, x, y, bs=64):
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def fwd(p, xb):
+        return model.apply({"params": p}, xb).argmax(-1)
+
+    hits = sum(int((fwd(params, x[i:i + bs]) == y[i:i + bs]).sum())
+               for i in range(0, len(x), bs))
+    return hits / len(x)
+
+
+# -- roles -------------------------------------------------------------------
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+
+    from edl_tpu.coord.client import connect
+    store = connect(args.coord_endpoints) if args.coord_endpoints else None
+
+    xt, yt, _ = make_digits(args.train_n, args.classes, args.image_size,
+                            seed=0)
+    xs, ys, ys_noisy = make_digits(args.train_n, args.classes,
+                                   args.image_size, seed=1,
+                                   label_noise=args.label_noise)
+    xe, ye, _ = make_digits(args.test_n, args.classes, args.image_size,
+                            seed=2)
+
+    if args.role == "teacher_train":
+        model, params = train_teacher(args, xt, yt)
+        save_teacher(args, params)
+        acc = accuracy(model, params, xe, ye)
+        print(f"[distill] teacher trained: test_acc={acc:.3f}", flush=True)
+        return {"teacher_acc": acc}
+
+    if args.role == "serve":
+        assert store is not None, "--coord_endpoints required"
+        serve_teacher(args, store, block=True)
+        return {}
+
+    if args.role == "student":
+        src = make_distill_source(args, xs, ys_noisy)
+        model, state = train_student(args, xs, ys_noisy, src)
+        acc = accuracy(model, state.params, xe, ye)
+        print(f"[distill] student trained: test_acc={acc:.3f}", flush=True)
+        return {"student_acc": acc}
+
+    # -- local: the whole flow in one process (CI smoke) ---------------------
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.distill.discovery import DiscoveryServer
+
+    store = store or MemoryKV(sweep_period=0.2)
+    tmodel, tparams = train_teacher(args, xt, yt)
+    teacher_acc = accuracy(tmodel, tparams, xe, ye)
+
+    disc = DiscoveryServer(store, host="127.0.0.1")
+    server = serve_teacher(args, store, model=tmodel, params=tparams,
+                           block=False)
+    args.discovery = disc.endpoint
+    try:
+        smodel, sstate = train_student(
+            args, xs, ys_noisy, make_distill_source(args, xs, ys_noisy))
+        distill_acc = accuracy(smodel, sstate.params, xe, ye)
+        bmodel, bstate = train_student(args, xs, ys_noisy, None)
+        baseline_acc = accuracy(bmodel, bstate.params, xe, ye)
+    finally:
+        server.stop()
+        disc.stop()
+    summary = {"teacher_acc": round(teacher_acc, 4),
+               "distill_acc": round(distill_acc, 4),
+               "baseline_acc": round(baseline_acc, 4),
+               "gain": round(distill_acc - baseline_acc, 4)}
+    print(f"[distill] {json.dumps(summary)}", flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

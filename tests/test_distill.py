@@ -205,3 +205,51 @@ def test_live_teacher_discovery_end_to_end(memkv, monkeypatch):
     finally:
         teacher.stop()
         disc.stop()
+
+
+def test_client_gc_reassigns_dead_students_teachers(memkv):
+    """A student that dies silently (no unregister) is expired after the
+    client TTL and its teachers are rebalanced to the survivors
+    (reference balance_table.py:466-493 timing-wheel GC).  Driven
+    through the BalanceTable RPC surface, including the
+    expired-mid-heartbeat UNREGISTERED path."""
+    table = BalanceTable(memkv, "ep-gc", client_ttl=1.5)
+    try:
+        for t in ("t1", "t2"):
+            memkv.put(server_key("svc-gc", t), t.encode())
+        assert table.register_client("alive", "svc-gc", require_num=2)["code"] == OK
+        assert table.register_client("dead", "svc-gc", require_num=2)["code"] == OK
+        table.service("svc-gc")._refresh_servers()
+        # 2 clients / 2 teachers: one teacher each
+        r = table.heartbeat("alive", "svc-gc", -1)
+        assert r["code"] == OK and len(r["servers"]) == 1, r
+        # "alive" heartbeats every 100ms (TTL/15); "dead" goes silent
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            r = table.heartbeat("alive", "svc-gc", -1)
+            if r["code"] == OK and len(r.get("servers") or []) == 2:
+                break
+            time.sleep(0.1)
+        assert r["code"] == OK and len(r["servers"]) == 2, r
+        # the dead client's next heartbeat is told to re-register
+        assert table.heartbeat("dead", "svc-gc", -1)["code"] == UNREGISTERED
+    finally:
+        table.close()
+
+
+def test_timeline_profiler_env_gated(monkeypatch, capsys):
+    from edl_tpu.distill import timeline as tl
+
+    monkeypatch.setattr(tl, "_instance", None)
+    monkeypatch.delenv("EDL_TPU_DISTILL_PROFILE", raising=False)
+    assert not tl.timeline().enabled
+
+    monkeypatch.setattr(tl, "_instance", None)
+    monkeypatch.setenv("EDL_TPU_DISTILL_PROFILE", "1")
+    t = tl.timeline()
+    assert t.enabled
+    with t.span("predict", teacher="t1", n=4):
+        pass
+    err = capsys.readouterr().err
+    assert "[timeline] op=predict" in err and "teacher=t1" in err
+    monkeypatch.setattr(tl, "_instance", None)
