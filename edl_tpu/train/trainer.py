@@ -20,6 +20,7 @@ records in etcd.  ElasticTrainer packages that contract TPU-natively:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
@@ -70,7 +71,10 @@ class ElasticTrainer:
                                        self.cfg.max_to_keep)
                      if self.cfg.checkpoint_dir else None)
         self._step_fn = None
-        self._eval_cache: dict[int, Any] = {}
+        # id -> (metric_fn, jitted): holding metric_fn pins its id so a
+        # recycled id can never alias a different function; bounded so
+        # fresh closures per call can't leak jitted executables forever
+        self._eval_cache: OrderedDict[int, tuple[Any, Any]] = OrderedDict()
 
     # -- state construction --------------------------------------------------
     def _build_fn(self, init_fn, tx, param_logical):
@@ -182,9 +186,10 @@ class ElasticTrainer:
             ) -> tuple[TrainState, State]:
         """Run epochs ``meta.next_epoch .. epochs-1``; each ``data_fn(e)``
         yields host-local numpy batches.  ``on_epoch_end`` runs after the
-        epoch checkpoint (eval pass, benchmark dump — the reference's
-        per-epoch test hook, train_with_fleet.py:642-658).  Returns the
-        final state."""
+        epoch checkpoint commits (eval pass, benchmark dump — the
+        reference's per-epoch test hook, train_with_fleet.py:642-658);
+        anything it writes into ``meta`` is patched into that same
+        epoch's committed sidecar afterwards.  Returns the final state."""
         rng = jax.random.key(0) if rng is None else rng
         self._report(TrainStatus.RUNNING)
         for epoch in range(meta.next_epoch, epochs):
@@ -192,15 +197,14 @@ class ElasticTrainer:
                 self._report(TrainStatus.NEARTHEEND)
             # per-epoch fold so dropout/augmentation differ across epochs
             state, meta = self._run_epoch(state, meta, data_fn, epoch,
-                                          jax.random.fold_in(rng, epoch))
-            if on_epoch_end is not None:
-                on_epoch_end(epoch, state, meta)
+                                          jax.random.fold_in(rng, epoch),
+                                          on_epoch_end)
         if self.ckpt is not None:
             self.ckpt.wait()
         self._report(TrainStatus.SUCCEED)
         return state, meta
 
-    def _run_epoch(self, state, meta, data_fn, epoch, rng):
+    def _run_epoch(self, state, meta, data_fn, epoch, rng, on_epoch_end=None):
         t_epoch, n_steps = time.monotonic(), 0
         start_step = int(state.step)  # one sync per epoch, not per step
         for batch in data_fn(epoch):
@@ -230,6 +234,15 @@ class ElasticTrainer:
             # progress).  Standalone runs keep saves fully async.
             if self.tenv is not None and self.tenv.pod_id:
                 self.ckpt.wait()
+        if on_epoch_end is not None:
+            # The epoch checkpoint is committed FIRST so a SIGTERM during
+            # the hook (a long eval pass) can't lose the epoch's training;
+            # hook mutations of ``meta`` (bench/eval records) are then
+            # patched into the committed sidecar, cheap vs re-saving arrays.
+            before = meta.to_json()
+            on_epoch_end(epoch, state, meta)
+            if self.ckpt is not None and meta.to_json() != before:
+                self.ckpt.save_meta(int(state.step), meta)
         logger.info("epoch %d done: %d steps in %.1fs", epoch, n_steps, dt)
         return state, meta
 
@@ -240,13 +253,19 @@ class ElasticTrainer:
         cached (a fresh jit per epoch would recompile the eval graph
         every time)."""
         key = id(metric_fn)
-        if key not in self._eval_cache:
+        cached = self._eval_cache.get(key)
+        if cached is None:
             def step(params, extra, batch, mask):
                 vals = metric_fn(params, extra, batch)
                 return ({k: (v * mask).sum() for k, v in vals.items()},
                         mask.sum())
-            self._eval_cache[key] = jax.jit(step)
-        return self._eval_cache[key]
+            cached = (metric_fn, jax.jit(step))
+            self._eval_cache[key] = cached
+            while len(self._eval_cache) > 8:  # LRU-ish bound (advisor r2)
+                self._eval_cache.popitem(last=False)
+        else:
+            self._eval_cache.move_to_end(key)
+        return cached[1]
 
     def evaluate(self, state: TrainState, batches: Iterable[Any],
                  metric_fn) -> dict[str, float]:

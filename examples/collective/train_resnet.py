@@ -68,44 +68,61 @@ MODELS = {
 }
 
 
-def _generate_synthetic_once(images, data_dir: str, args) -> None:
-    """Exactly one process (of possibly many pods sharing a host dir)
-    generates the toy dataset; the rest wait for its completion marker."""
+def _generate_synthetic_once(images, data_dir: str, args) -> str:
+    """Generate the toy dataset into ``data_dir/synth`` exactly once
+    across any number of racing processes (pods sharing a host dir,
+    elastic restarts killing a generator mid-write).
+
+    Correctness comes from idempotence + one atomic publish: each
+    generator writes into its own unique tmp dir, then ``os.rename``\\ s
+    it to the final path — exactly one rename wins, losers discard
+    their tmp.  No lock stealing, no pid liveness probes (both are
+    unsound across pid recycling / shared filesystems).  An advisory
+    O_EXCL lock only *reduces* duplicate work: waiters poll for the
+    final dir for a while, then generate anyway and let the rename
+    decide."""
+    import shutil
+
     os.makedirs(data_dir, exist_ok=True)
-    done = os.path.join(data_dir, ".synth-done")
+    final = os.path.join(data_dir, "synth")
     lock = os.path.join(data_dir, ".synth-lock")
-    while not os.path.exists(done):
+    if not os.path.isdir(final):
+        got_lock = False
         try:
             fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             os.close(fd)
+            got_lock = True
         except FileExistsError:
-            # wait for the lock holder; if it was killed (elastic restart)
-            # steal the stale lock and generate ourselves
             deadline = time.monotonic() + 60
-            while not os.path.exists(done) and time.monotonic() < deadline:
+            while not os.path.isdir(final) and time.monotonic() < deadline:
                 time.sleep(0.25)
-            if not os.path.exists(done):
-                try:
-                    os.unlink(lock)
-                except FileNotFoundError:
-                    pass
-            continue
-        try:
+        if not os.path.isdir(final):
+            tmp = os.path.join(
+                data_dir, f".synth-tmp-{os.getpid()}-{time.monotonic_ns()}")
             images.write_synthetic_imagenet(
-                data_dir, n_files=args.synthetic_files,
+                tmp, n_files=args.synthetic_files,
                 per_file=args.synthetic_per_file, size=args.image_size,
                 classes=args.synthetic, prefix="train")
             images.write_synthetic_imagenet(
-                data_dir, n_files=1, per_file=args.synthetic_per_file,
+                tmp, n_files=1, per_file=args.synthetic_per_file,
                 size=args.image_size, classes=args.synthetic, seed=99,
                 prefix="val")
-            with open(done, "w") as f:
-                f.write("ok")
-        finally:
             try:
-                os.unlink(lock)
-            except FileNotFoundError:
-                pass
+                os.rename(tmp, final)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                if not os.path.isdir(final):
+                    raise  # not a lost race — surface the real error
+    if os.path.isdir(final):
+        # once published, the advisory lock is garbage: any process clears
+        # it (not just its creator), so a lock orphaned by a killed holder
+        # can't stall a later cold start for the full deadline (safe:
+        # acquirers re-check isdir(final) before generating)
+        try:
+            os.unlink(lock)
+        except FileNotFoundError:
+            pass
+    return final
 
 
 def main() -> None:
@@ -141,7 +158,7 @@ def main() -> None:
     if args.synthetic:
         data_dir = args.data_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "edl-synth")
-        _generate_synthetic_once(images, data_dir, args)
+        data_dir = _generate_synthetic_once(images, data_dir, args)
         args.num_classes = args.synthetic
     else:
         data_dir = args.data_dir
