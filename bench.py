@@ -1,80 +1,7 @@
-"""Headline benchmark: ResNet50 ImageNet-shape training throughput.
+"""Driver entry: delegates to the packaged benchmark (edl_tpu/bench.py,
+also installed as the `edl-bench` console script)."""
 
-Mirrors the reference's headline number (README.md:83 — ResNet50_vd
-1828 img/s on 8×V100 ≈ 228.5 img/s per chip; BASELINE.md) measured as
-img/s per chip on the real TPU, synthetic NHWC 224×224 data, bf16
-compute, SGD momentum — the same workload shape as
-example/collective/resnet50/train_with_fleet.py.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-"""
-
-from __future__ import annotations
-
-import json
-import time
-
-import numpy as np
-
-BASELINE_IMG_S_PER_CHIP = 1828 / 8  # README.md:83, 8×V100
-
-
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from edl_tpu.models import ResNet50
-    from edl_tpu.train.state import TrainState
-
-    n_dev = len(jax.devices())
-    per_dev_bs = 128
-    bs = per_dev_bs * n_dev
-    model = ResNet50(num_classes=1000)
-
-    rng = jax.random.key(0)
-    images = jnp.asarray(np.random.default_rng(0).normal(
-        size=(bs, 224, 224, 3)), jnp.bfloat16)
-    labels = jnp.asarray(np.random.default_rng(1).integers(0, 1000, (bs,)))
-
-    variables = model.init(rng, images[:2], train=False)
-    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
-    state = TrainState.create(variables["params"], tx,
-                              extra=variables["batch_stats"])
-
-    @jax.jit
-    def step(state, images, labels):
-        def lf(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": state.extra}, images,
-                train=True, mutable=["batch_stats"])
-            onehot = jax.nn.one_hot(labels, 1000)
-            loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
-            return loss, mutated["batch_stats"]
-        (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(state.params)
-        return state.apply_gradients(grads, new_stats), loss
-
-    # warmup / compile; float() is the hard sync — block_until_ready does
-    # not reliably drain the axon remote-execution tunnel
-    state, loss = step(state, images, labels)
-    float(loss)
-
-    n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, loss = step(state, images, labels)
-    float(loss)  # sync: the state chain forces all steps to have run
-    dt = time.perf_counter() - t0
-
-    img_s = bs * n_steps / dt
-    img_s_per_chip = img_s / n_dev
-    print(json.dumps({
-        "metric": "resnet50_train_img_s_per_chip",
-        "value": round(img_s_per_chip, 1),
-        "unit": "img/s/chip (bf16, bs 128/chip, synthetic 224x224)",
-        "vs_baseline": round(img_s_per_chip / BASELINE_IMG_S_PER_CHIP, 3),
-    }))
-
+from edl_tpu.bench import main
 
 if __name__ == "__main__":
     main()

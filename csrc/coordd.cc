@@ -1,0 +1,651 @@
+// coordd — native coordination daemon.
+//
+// Drop-in replacement for the Python coordination server
+// (edl_tpu/coord/server.py): identical EDL1 framed-msgpack wire
+// (edl_tpu/rpc/framing.py is the spec: b"EDL1" | u32_be len | msgpack
+// {"m": method, "a": {kwargs}} -> {"s": status|nil, "r": result}),
+// identical method set and semantics as MemoryKV
+// (edl_tpu/coord/memory.py): TTL leases swept in the background,
+// monotonically increasing revisions, tombstone delete events, a
+// bounded event log with snapshot fallback on compaction, and the
+// idempotent-reseize put_if_absent the leader election depends on.
+//
+// The reference deployed etcd (a Go binary) for this role
+// (python/edl/discovery/etcd_client.py:15, scripts/build.sh:67-74
+// booted one per test run); coordd is the in-tree native equivalent.
+// The Python test-suite runs its coordination tests against this
+// daemon as a second backend (tests/test_coordd_native.py), proving
+// the KVStore interface is genuinely pluggable.
+//
+// Build:  g++ -O2 -std=c++17 -pthread -o coordd coordd.cc
+// Run:    ./coordd --host 0.0.0.0 --port 2379   (port 0 = ephemeral;
+//         prints "COORDD LISTENING <port>" once bound)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------- msgpack --
+// Minimal msgpack for the subset the wire uses: nil/bool/int/float/str/
+// bin/array/map.  Matches what Python's msgpack emits with
+// use_bin_type=True and decodes with raw=False.
+struct Value {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                         // STR and BIN payloads
+  std::vector<Value> arr;
+  std::vector<std::pair<Value, Value>> map;
+
+  static Value nil() { return Value{}; }
+  static Value boolean(bool v) { Value x; x.kind = BOOL; x.b = v; return x; }
+  static Value integer(int64_t v) { Value x; x.kind = INT; x.i = v; return x; }
+  static Value number(double v) { Value x; x.kind = FLOAT; x.f = v; return x; }
+  static Value str(std::string v) { Value x; x.kind = STR; x.s = std::move(v); return x; }
+  static Value bin(std::string v) { Value x; x.kind = BIN; x.s = std::move(v); return x; }
+  static Value array() { Value x; x.kind = ARR; return x; }
+  static Value object() { Value x; x.kind = MAP; return x; }
+
+  bool is_nil() const { return kind == NIL; }
+  int64_t as_int() const {
+    if (kind == INT) return i;
+    if (kind == FLOAT) return static_cast<int64_t>(f);
+    if (kind == BOOL) return b ? 1 : 0;
+    throw std::runtime_error("msgpack: expected int");
+  }
+  double as_double() const {
+    if (kind == FLOAT) return f;
+    if (kind == INT) return static_cast<double>(i);
+    throw std::runtime_error("msgpack: expected number");
+  }
+  const std::string& as_str() const {
+    if (kind != STR) throw std::runtime_error("msgpack: expected str");
+    return s;
+  }
+  const std::string& as_bytes() const {
+    if (kind != BIN && kind != STR)
+      throw std::runtime_error("msgpack: expected bin");
+    return s;
+  }
+  const Value* find(const std::string& key) const {
+    if (kind != MAP) return nullptr;
+    for (const auto& kv : map)
+      if (kv.first.kind == STR && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+static void pack(const Value& v, std::string& out) {
+  auto put = [&](char c) { out.push_back(c); };
+  auto put_be = [&](uint64_t x, int n) {
+    for (int k = n - 1; k >= 0; --k) put(static_cast<char>((x >> (8 * k)) & 0xff));
+  };
+  switch (v.kind) {
+    case Value::NIL: put(static_cast<char>(0xc0)); break;
+    case Value::BOOL: put(static_cast<char>(v.b ? 0xc3 : 0xc2)); break;
+    case Value::INT: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) put(static_cast<char>(x));
+      else if (x < 0 && x >= -32) put(static_cast<char>(x));
+      else { put(static_cast<char>(0xd3)); put_be(static_cast<uint64_t>(x), 8); }
+      break;
+    }
+    case Value::FLOAT: {
+      put(static_cast<char>(0xcb));
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "double size");
+      std::memcpy(&bits, &v.f, 8);
+      put_be(bits, 8);
+      break;
+    }
+    case Value::STR: {
+      size_t n = v.s.size();
+      if (n < 32) put(static_cast<char>(0xa0 | n));
+      else if (n < 256) { put(static_cast<char>(0xd9)); put_be(n, 1); }
+      else if (n < 65536) { put(static_cast<char>(0xda)); put_be(n, 2); }
+      else { put(static_cast<char>(0xdb)); put_be(n, 4); }
+      out.append(v.s);
+      break;
+    }
+    case Value::BIN: {
+      size_t n = v.s.size();
+      if (n < 256) { put(static_cast<char>(0xc4)); put_be(n, 1); }
+      else if (n < 65536) { put(static_cast<char>(0xc5)); put_be(n, 2); }
+      else { put(static_cast<char>(0xc6)); put_be(n, 4); }
+      out.append(v.s);
+      break;
+    }
+    case Value::ARR: {
+      size_t n = v.arr.size();
+      if (n < 16) put(static_cast<char>(0x90 | n));
+      else if (n < 65536) { put(static_cast<char>(0xdc)); put_be(n, 2); }
+      else { put(static_cast<char>(0xdd)); put_be(n, 4); }
+      for (const auto& e : v.arr) pack(e, out);
+      break;
+    }
+    case Value::MAP: {
+      size_t n = v.map.size();
+      if (n < 16) put(static_cast<char>(0x80 | n));
+      else if (n < 65536) { put(static_cast<char>(0xde)); put_be(n, 2); }
+      else { put(static_cast<char>(0xdf)); put_be(n, 4); }
+      for (const auto& kv : v.map) { pack(kv.first, out); pack(kv.second, out); }
+      break;
+    }
+  }
+}
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint8_t u8() {
+    if (p >= end) throw std::runtime_error("msgpack: truncated");
+    return *p++;
+  }
+  uint64_t be(int n) {
+    uint64_t x = 0;
+    for (int k = 0; k < n; ++k) x = (x << 8) | u8();
+    return x;
+  }
+  std::string raw(size_t n) {
+    if (p + n > end) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+static Value unpack(Cursor& c) {
+  uint8_t t = c.u8();
+  if (t < 0x80) return Value::integer(t);                       // pos fixint
+  if (t >= 0xe0) return Value::integer(static_cast<int8_t>(t)); // neg fixint
+  if ((t & 0xf0) == 0x80) {                                     // fixmap
+    Value v = Value::object();
+    for (int n = t & 0x0f; n > 0; --n) {
+      Value k = unpack(c); Value val = unpack(c);
+      v.map.emplace_back(std::move(k), std::move(val));
+    }
+    return v;
+  }
+  if ((t & 0xf0) == 0x90) {                                     // fixarray
+    Value v = Value::array();
+    for (int n = t & 0x0f; n > 0; --n) v.arr.push_back(unpack(c));
+    return v;
+  }
+  if ((t & 0xe0) == 0xa0) return Value::str(c.raw(t & 0x1f));   // fixstr
+  switch (t) {
+    case 0xc0: return Value::nil();
+    case 0xc2: return Value::boolean(false);
+    case 0xc3: return Value::boolean(true);
+    case 0xc4: return Value::bin(c.raw(c.be(1)));
+    case 0xc5: return Value::bin(c.raw(c.be(2)));
+    case 0xc6: return Value::bin(c.raw(c.be(4)));
+    case 0xca: { uint32_t b = static_cast<uint32_t>(c.be(4)); float f;
+                 std::memcpy(&f, &b, 4); return Value::number(f); }
+    case 0xcb: { uint64_t b = c.be(8); double d; std::memcpy(&d, &b, 8);
+                 return Value::number(d); }
+    case 0xcc: return Value::integer(static_cast<int64_t>(c.be(1)));
+    case 0xcd: return Value::integer(static_cast<int64_t>(c.be(2)));
+    case 0xce: return Value::integer(static_cast<int64_t>(c.be(4)));
+    case 0xcf: return Value::integer(static_cast<int64_t>(c.be(8)));
+    case 0xd0: return Value::integer(static_cast<int8_t>(c.be(1)));
+    case 0xd1: return Value::integer(static_cast<int16_t>(c.be(2)));
+    case 0xd2: return Value::integer(static_cast<int32_t>(c.be(4)));
+    case 0xd3: return Value::integer(static_cast<int64_t>(c.be(8)));
+    case 0xd9: return Value::str(c.raw(c.be(1)));
+    case 0xda: return Value::str(c.raw(c.be(2)));
+    case 0xdb: return Value::str(c.raw(c.be(4)));
+    case 0xdc: { Value v = Value::array();
+                 for (uint64_t n = c.be(2); n > 0; --n) v.arr.push_back(unpack(c));
+                 return v; }
+    case 0xdd: { Value v = Value::array();
+                 for (uint64_t n = c.be(4); n > 0; --n) v.arr.push_back(unpack(c));
+                 return v; }
+    case 0xde: { Value v = Value::object();
+                 for (uint64_t n = c.be(2); n > 0; --n) {
+                   Value k = unpack(c); Value val = unpack(c);
+                   v.map.emplace_back(std::move(k), std::move(val)); }
+                 return v; }
+    case 0xdf: { Value v = Value::object();
+                 for (uint64_t n = c.be(4); n > 0; --n) {
+                   Value k = unpack(c); Value val = unpack(c);
+                   v.map.emplace_back(std::move(k), std::move(val)); }
+                 return v; }
+  }
+  throw std::runtime_error("msgpack: unsupported type byte");
+}
+
+// --------------------------------------------------------------- KV engine --
+// Semantics mirror edl_tpu/coord/memory.py exactly (revision per
+// mutation, delete tombstones, lease-key ownership transfer on re-put,
+// event-log compaction fallback).
+using Clock = std::chrono::steady_clock;
+
+struct Rec {
+  std::string key, value;
+  int64_t revision = 0, lease = 0;
+};
+
+struct Event {
+  std::string type;  // "put" | "delete"
+  Rec rec;
+};
+
+struct Lease {
+  double ttl;
+  Clock::time_point expires;
+  std::set<std::string> keys;
+};
+
+class Engine {
+ public:
+  static constexpr size_t kEventCap = 4096;  // memory.py _EVENT_LOG_CAP
+
+  int64_t put(const std::string& key, const std::string& value, int64_t lease) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    return put_locked(key, value, lease);
+  }
+
+  bool get(const std::string& key, Rec* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    auto it = data_.find(key);
+    if (it == data_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  std::pair<std::vector<Rec>, int64_t> range(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    std::vector<Rec> recs;
+    for (auto it = data_.lower_bound(prefix);
+         it != data_.end() && it->first.compare(0, prefix.size(), prefix) == 0;
+         ++it)
+      recs.push_back(it->second);
+    return {recs, revision_};
+  }
+
+  bool del(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    return delete_locked(key);
+  }
+
+  int64_t del_range(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    std::vector<std::string> keys;
+    for (auto it = data_.lower_bound(prefix);
+         it != data_.end() && it->first.compare(0, prefix.size(), prefix) == 0;
+         ++it)
+      keys.push_back(it->first);
+    for (const auto& k : keys) delete_locked(k);
+    return static_cast<int64_t>(keys.size());
+  }
+
+  int64_t lease_grant(double ttl) {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t lid = next_lease_++;
+    leases_[lid] = Lease{ttl, Clock::now() + to_dur(ttl), {}};
+    return lid;
+  }
+
+  bool lease_keepalive(int64_t lid) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    auto it = leases_.find(lid);
+    if (it == leases_.end()) return false;
+    it->second.expires = Clock::now() + to_dur(it->second.ttl);
+    return true;
+  }
+
+  void lease_revoke(int64_t lid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = leases_.find(lid);
+    if (it == leases_.end()) return;
+    std::set<std::string> keys = it->second.keys;
+    leases_.erase(it);
+    for (const auto& k : keys) delete_locked(k);
+  }
+
+  bool put_if_absent(const std::string& key, const std::string& value,
+                     int64_t lease) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    auto it = data_.find(key);
+    if (it != data_.end())
+      // idempotent re-seize: same value + same live lease (memory.py:162)
+      return it->second.value == value && lease != 0 &&
+             it->second.lease == lease;
+    put_locked(key, value, lease);
+    return true;
+  }
+
+  bool put_if_equals(const std::string& guard_key, const std::string& guard_value,
+                     const std::string& key, const std::string& value,
+                     int64_t lease) {
+    std::lock_guard<std::mutex> g(mu_);
+    expire_locked(Clock::now());
+    auto it = data_.find(guard_key);
+    if (it == data_.end() || it->second.value != guard_value) return false;
+    put_locked(key, value, lease);
+    return true;
+  }
+
+  std::pair<std::vector<Event>, int64_t> wait(const std::string& prefix,
+                                              int64_t since, double timeout) {
+    std::unique_lock<std::mutex> g(mu_);
+    auto deadline = Clock::now() + to_dur(timeout);
+    for (;;) {
+      expire_locked(Clock::now());
+      if (!events_.empty() && since < events_.front().first - 1 &&
+          since < revision_) {
+        // caller's revision predates the bounded log: snapshot-as-puts
+        std::vector<Event> evs;
+        for (auto it = data_.lower_bound(prefix);
+             it != data_.end() &&
+             it->first.compare(0, prefix.size(), prefix) == 0;
+             ++it)
+          evs.push_back(Event{"put", it->second});
+        return {evs, revision_};
+      }
+      std::vector<Event> evs;
+      for (const auto& re : events_)
+        if (re.first > since &&
+            re.second.rec.key.compare(0, prefix.size(), prefix) == 0)
+          evs.push_back(re.second);
+      if (!evs.empty()) return {evs, revision_};
+      if (Clock::now() >= deadline) return {{}, revision_};
+      cv_.wait_for(g, std::min(to_dur(0.25), deadline - Clock::now()));
+    }
+  }
+
+  void run_sweeper() {
+    sweeper_ = std::thread([this] {
+      for (;;) {
+        std::this_thread::sleep_for(to_dur(0.25));
+        std::lock_guard<std::mutex> g(mu_);
+        expire_locked(Clock::now());
+      }
+    });
+    sweeper_.detach();
+  }
+
+ private:
+  static Clock::duration to_dur(double sec) {
+    return std::chrono::duration_cast<Clock::duration>(
+        std::chrono::duration<double>(sec));
+  }
+
+  int64_t put_locked(const std::string& key, const std::string& value,
+                     int64_t lease) {
+    if (lease != 0) {
+      auto it = leases_.find(lease);
+      if (it == leases_.end())
+        throw std::runtime_error("lease " + std::to_string(lease) + " not found");
+      it->second.keys.insert(key);
+    }
+    auto old = data_.find(key);
+    if (old != data_.end() && old->second.lease != 0 &&
+        old->second.lease != lease) {
+      auto ol = leases_.find(old->second.lease);
+      if (ol != leases_.end()) ol->second.keys.erase(key);
+    }
+    Rec rec{key, value, ++revision_, lease};
+    data_[key] = rec;
+    emit_locked("put", rec);
+    return rec.revision;
+  }
+
+  bool delete_locked(const std::string& key) {
+    auto it = data_.find(key);
+    if (it == data_.end()) return false;
+    Rec old = it->second;
+    data_.erase(it);
+    if (old.lease != 0) {
+      auto ol = leases_.find(old.lease);
+      if (ol != leases_.end()) ol->second.keys.erase(key);
+    }
+    Rec tomb{key, "", ++revision_, old.lease};
+    emit_locked("delete", tomb);
+    return true;
+  }
+
+  void emit_locked(const std::string& type, const Rec& rec) {
+    events_.emplace_back(rec.revision, Event{type, rec});
+    while (events_.size() > kEventCap) events_.pop_front();
+    cv_.notify_all();
+  }
+
+  void expire_locked(Clock::time_point now) {
+    std::vector<int64_t> dead;
+    for (const auto& kv : leases_)
+      if (kv.second.expires <= now) dead.push_back(kv.first);
+    for (int64_t lid : dead) {
+      std::set<std::string> keys = leases_[lid].keys;
+      leases_.erase(lid);
+      for (const auto& k : keys) delete_locked(k);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, Rec> data_;
+  std::unordered_map<int64_t, Lease> leases_;
+  std::deque<std::pair<int64_t, Event>> events_;
+  int64_t revision_ = 0;
+  int64_t next_lease_ = 1;
+  std::thread sweeper_;
+};
+
+// ------------------------------------------------------------------ server --
+static constexpr uint32_t kMaxFrame = 1u << 30;  // framing.py MAX_FRAME
+
+static bool recv_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool send_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static Value rec_to_wire(const Rec& r) {
+  Value v = Value::array();
+  v.arr.push_back(Value::str(r.key));
+  v.arr.push_back(Value::bin(r.value));
+  v.arr.push_back(Value::integer(r.revision));
+  v.arr.push_back(Value::integer(r.lease));
+  return v;
+}
+
+static int64_t arg_int(const Value& a, const char* name, int64_t dflt) {
+  const Value* v = a.find(name);
+  return v && !v->is_nil() ? v->as_int() : dflt;
+}
+
+static double arg_num(const Value& a, const char* name, double dflt) {
+  const Value* v = a.find(name);
+  return v && !v->is_nil() ? v->as_double() : dflt;
+}
+
+static std::string arg_str(const Value& a, const char* name) {
+  const Value* v = a.find(name);
+  if (!v) throw std::runtime_error(std::string("missing argument ") + name);
+  return v->as_str();
+}
+
+static std::string arg_bytes(const Value& a, const char* name) {
+  const Value* v = a.find(name);
+  if (!v) throw std::runtime_error(std::string("missing argument ") + name);
+  return v->as_bytes();
+}
+
+static Value dispatch(Engine& kv, const std::string& m, const Value& a) {
+  Value r = Value::object();
+  auto set = [&](const char* k, Value v) {
+    r.map.emplace_back(Value::str(k), std::move(v));
+  };
+  if (m == "kv_put") {
+    set("rev", Value::integer(kv.put(arg_str(a, "key"), arg_bytes(a, "value"),
+                                     arg_int(a, "lease_id", 0))));
+  } else if (m == "kv_get") {
+    Rec rec;
+    set("rec", kv.get(arg_str(a, "key"), &rec) ? rec_to_wire(rec)
+                                               : Value::nil());
+  } else if (m == "kv_range") {
+    auto [recs, rev] = kv.range(arg_str(a, "prefix"));
+    Value arr = Value::array();
+    for (const auto& rc : recs) arr.arr.push_back(rec_to_wire(rc));
+    set("recs", std::move(arr));
+    set("rev", Value::integer(rev));
+  } else if (m == "kv_del") {
+    set("deleted", Value::boolean(kv.del(arg_str(a, "key"))));
+  } else if (m == "kv_del_range") {
+    set("n", Value::integer(kv.del_range(arg_str(a, "prefix"))));
+  } else if (m == "lease_grant") {
+    set("lease_id", Value::integer(kv.lease_grant(arg_num(a, "ttl", 15.0))));
+  } else if (m == "lease_keepalive") {
+    set("alive", Value::boolean(kv.lease_keepalive(arg_int(a, "lease_id", 0))));
+  } else if (m == "lease_revoke") {
+    kv.lease_revoke(arg_int(a, "lease_id", 0));
+  } else if (m == "txn_put_if_absent") {
+    set("succeeded", Value::boolean(kv.put_if_absent(
+        arg_str(a, "key"), arg_bytes(a, "value"), arg_int(a, "lease_id", 0))));
+  } else if (m == "txn_put_if_equals") {
+    set("succeeded", Value::boolean(kv.put_if_equals(
+        arg_str(a, "guard_key"), arg_bytes(a, "guard_value"),
+        arg_str(a, "key"), arg_bytes(a, "value"), arg_int(a, "lease_id", 0))));
+  } else if (m == "wait") {
+    double timeout = std::min(arg_num(a, "timeout", 30.0), 60.0);
+    auto [evs, rev] = kv.wait(arg_str(a, "prefix"),
+                              arg_int(a, "since_revision", 0), timeout);
+    Value arr = Value::array();
+    for (const auto& e : evs) {
+      Value pair = Value::array();
+      pair.arr.push_back(Value::str(e.type));
+      pair.arr.push_back(rec_to_wire(e.rec));
+      arr.arr.push_back(std::move(pair));
+    }
+    set("events", std::move(arr));
+    set("rev", Value::integer(rev));
+  } else if (m == "ping") {
+    set("pong", Value::boolean(true));
+  } else {
+    throw std::runtime_error("no such method " + m);
+  }
+  return r;
+}
+
+static void serve_conn(Engine* kv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t header[8];
+    if (!recv_exact(fd, header, 8)) break;
+    if (std::memcmp(header, "EDL1", 4) != 0) break;
+    uint32_t len = (uint32_t(header[4]) << 24) | (uint32_t(header[5]) << 16) |
+                   (uint32_t(header[6]) << 8) | uint32_t(header[7]);
+    if (len > kMaxFrame) break;
+    std::vector<uint8_t> body(len);
+    if (!recv_exact(fd, body.data(), len)) break;
+
+    Value resp = Value::object();
+    try {
+      Cursor c{body.data(), body.data() + body.size()};
+      Value msg = unpack(c);
+      const Value* mv = msg.find("m");
+      const Value* av = msg.find("a");
+      Value empty = Value::object();
+      Value result = dispatch(*kv, mv ? mv->as_str() : "",
+                              av && !av->is_nil() ? *av : empty);
+      resp.map.emplace_back(Value::str("s"), Value::nil());
+      resp.map.emplace_back(Value::str("r"), std::move(result));
+    } catch (const std::exception& e) {
+      Value status = Value::object();
+      status.map.emplace_back(Value::str("type"),
+                              Value::str("EdlInternalError"));
+      status.map.emplace_back(Value::str("detail"), Value::str(e.what()));
+      resp.map.emplace_back(Value::str("s"), std::move(status));
+      resp.map.emplace_back(Value::str("r"), Value::nil());
+    }
+    std::string payload;
+    pack(resp, payload);
+    uint8_t out_header[8] = {'E', 'D', 'L', '1',
+                             static_cast<uint8_t>(payload.size() >> 24),
+                             static_cast<uint8_t>(payload.size() >> 16),
+                             static_cast<uint8_t>(payload.size() >> 8),
+                             static_cast<uint8_t>(payload.size())};
+    if (!send_all(fd, out_header, 8)) break;
+    if (!send_all(fd, payload.data(), payload.size())) break;
+  }
+  ::close(fd);
+}
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 2379;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (std::string(argv[i]) == "--host") host = argv[++i];
+    else if (std::string(argv[i]) == "--port") port = std::atoi(argv[++i]);
+  }
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) { std::perror("socket"); return 1; }
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+    addr.sin_addr.s_addr = INADDR_ANY;
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (::listen(lfd, 128) != 0) { std::perror("listen"); return 1; }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("COORDD LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  Engine kv;
+  kv.run_sweeper();
+  for (;;) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    std::thread(serve_conn, &kv, cfd).detach();
+  }
+}

@@ -47,12 +47,15 @@ class DataCheckpoint(JsonSerializable):
         self.processed: list[ProcessedRange] = []
 
     def mark_processed(self, file_idx: int, begin: int, end: int) -> None:
-        """Record [begin,end) as done, merging adjacent ranges per file."""
-        for r in self.processed:
-            if r.file_idx == file_idx and r.end == begin:
-                r.end = end
-                return
-        self.processed.append(ProcessedRange(file_idx, begin, end))
+        """Record [begin,end) as done, merging overlapping/adjacent
+        ranges per file (general merge — the distributed reader marks
+        per record, in whatever order batches were stolen)."""
+        from edl_tpu.utils.spans import merge_span
+        spans = [[r.begin, r.end] for r in self.processed
+                 if r.file_idx == file_idx]
+        merge_span(spans, begin, end)
+        self.processed = ([r for r in self.processed if r.file_idx != file_idx]
+                         + [ProcessedRange(file_idx, b, e) for b, e in spans])
 
     def is_processed(self, file_idx: int, record_no: int) -> bool:
         return any(r.file_idx == file_idx and r.begin <= record_no < r.end
@@ -69,6 +72,13 @@ class State(JsonSerializable):
         self.data_checkpoint = DataCheckpoint()
         self.epochs: list[EpochAttr] = []
         self.train_status: str = "initial"
+        # mid-epoch resume (finishes the reference's WIP state.py intent):
+        # the epoch currently in progress (-1 = between epochs) and the
+        # global step at which it started; a mid-epoch checkpoint carries
+        # both plus data_checkpoint's consumed spans, so a stop-resume
+        # restart re-enters the SAME epoch and skips trained records
+        self.in_epoch = -1
+        self.epoch_start_step = 0
 
     # -- epoch history -------------------------------------------------------
     def epoch_attr(self, epoch_no: int) -> EpochAttr | None:
@@ -86,7 +96,11 @@ class State(JsonSerializable):
 
     @property
     def next_epoch(self) -> int:
-        """First epoch to (re)run on resume (reference train_status.next())."""
+        """First epoch to (re)run on resume (reference train_status.next());
+        an epoch in progress at checkpoint time is re-entered, with
+        ``data_checkpoint`` saying which records it already trained."""
+        if self.in_epoch >= 0:
+            return self.in_epoch
         done = [e.epoch_no for e in self.epochs]
         return max(done) + 1 if done else 0
 

@@ -26,6 +26,7 @@ from edl_tpu.collective.generator import ClusterGenerator
 from edl_tpu.collective.leader import LeaderElector
 from edl_tpu.collective.pod_server import start_pod_server
 from edl_tpu.collective.watcher import ClusterWatcher
+from edl_tpu.data.data_server import DataService
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
@@ -49,6 +50,7 @@ class Launcher:
         self._period = period
         self._ttl = register_ttl
         self._server = None
+        self._data_service: DataService | None = None
         self._resource_register = None
         self._elector: LeaderElector | None = None
         self._generator: ClusterGenerator | None = None
@@ -60,6 +62,12 @@ class Launcher:
         save_pod_status(self._store, job_id, self._pod.pod_id, Status.INITIAL)
         self._server = start_pod_server(self._store, job_id, self._pod.pod_id,
                                         self._pod.port)
+        # the distributed data service rides the launcher's RPC server on
+        # EVERY pod (inert until addressed; trainers talk to the current
+        # leader's), so its work-queue state survives trainer stop-resume
+        # — the integration the reference's WIP data server never had
+        self._data_service = DataService()
+        self._server.register_instance(self._data_service)
         self._pod.port = self._server.port
         try:
             final = self._run()
@@ -101,8 +109,15 @@ class Launcher:
             # membership changed: stop-resume
             logger.info("membership changed; re-barrier + restart trainers")
             self._shutdown_trainers()
+            old_pods = set(cluster.pod_ids())
             cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
                                          timeout=self._resize_barrier_timeout)
+            # release departed pods' data-service work (their files and
+            # unconsumed batches requeue minus already-consumed spans);
+            # restarted trainers then join fresh reader generations keyed
+            # by the new stage, seeded from the restored DataCheckpoint
+            for dead in old_pods - set(cluster.pod_ids()):
+                self._data_service.mark_pod_dead(dead)
 
     def _supervise(self, watcher: ClusterWatcher) -> Status | None:
         """Returns final status, or None on membership change (resize).

@@ -3,8 +3,10 @@
 Run standalone (``python -m edl_tpu.coord.server --port 2379``) the way
 the reference's tests booted a local etcd binary (etcd_test.sh), or
 embed via :func:`start_server`.  The native C++ daemon
-(native/coordd.cc) serves the identical method set/wire format and is a
-drop-in replacement for production.
+(csrc/coordd.cc, built on demand by
+``edl_tpu.native.build.ensure_coordd``) serves the identical method
+set and wire format; the coordination test battery runs against both
+backends (tests/test_coord.py), so either is a drop-in for production.
 """
 
 from __future__ import annotations

@@ -1,24 +1,33 @@
 """Distributed data service (SURVEY.md §2.4/§3.5 — the reference's WIP
 pillar, finished here).
 
-A leader-hosted :class:`DataService` splits the file list across pods
-and hands out produced batch ids exactly once; every pod runs a
-:class:`PodDataServer` that serves its locally-produced batches to
-peers; the trainer-side :class:`DistributedReader` produces, reports,
-pulls its balanced share (possibly from other pods' caches) and records
-:class:`~edl_tpu.cluster.state.DataCheckpoint` ranges for resume.
+A leader-hosted :class:`DataService` runs a span-aware work queue:
+files are assigned to producer pods dynamically, produced batches carry
+record spans, consumers ack spans back, and lost work re-queues minus
+the consumed union — exactly-once under stop-resume, no silent drops
+under pod death.  Every pod runs a :class:`PodDataServer` serving its
+locally-produced batches to peers; the trainer-side
+:class:`DistributedReader` produces, reports and pulls its share;
+:class:`ElasticInput` turns the stream into fixed-size, masked,
+collectively-agreed batches safe for a jitted multi-host train step,
+checkpointed per record into
+:class:`~edl_tpu.cluster.state.DataCheckpoint`.
 
 Redesign notes vs the reference (python/edl/utils/data_server.py:431,
 python/edl/collective/distribute_reader.py:391 — broken as written,
 SURVEY.md §2.4): batch distribution is pull-based work stealing with an
-in-flight table (re-queued when a consumer pod dies) instead of the
-barrier-then-average push rebalance, which preserves the exactly-once
-id set across pod loss without a global barrier per round.
+in-flight table instead of the barrier-then-average push rebalance, and
+the ragged epoch end is handled with masked batches + a per-step
+has-next agreement instead of being dropped.
 """
 
-from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
+from edl_tpu.data.dataset import FileSplitter, RecordioSplitter, TxtFileSplitter
 from edl_tpu.data.data_server import DataService, PodDataServer
 from edl_tpu.data.distribute_reader import DistributedReader
+from edl_tpu.data.elastic_input import ElasticInput
+from edl_tpu.data.registry import load_readers, register_reader, wait_dist_readers
 
-__all__ = ["FileSplitter", "TxtFileSplitter", "DataService",
-           "PodDataServer", "DistributedReader"]
+__all__ = ["FileSplitter", "TxtFileSplitter", "RecordioSplitter",
+           "DataService", "PodDataServer", "DistributedReader",
+           "ElasticInput", "register_reader", "load_readers",
+           "wait_dist_readers"]

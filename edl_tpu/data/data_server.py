@@ -1,11 +1,38 @@
 """Leader data service + per-pod batch cache server.
 
 Reference protocol (data_server.proto:94-107): GetFileList,
-ReportBatchDataMeta, ReachDataEnd, GetBatchDataMeta, GetBatchData.
-The leader tracks production and hands out batch ids exactly once,
-work-stealing style (see package docstring for the redesign rationale);
-each pod serves raw batch bytes from its own cache so the leader never
-relays data (reference data_server.py:319-330).
+ReportBatchDataMeta, ReachDataEnd, GetBatchDataMeta, GetBatchData —
+round-robin file slices plus a batch-id rebalance pass
+(data_server.py:118-224).  This is the finished TPU-era redesign of
+that WIP: instead of static slices + a rebalance barrier, the leader
+runs a **span-aware work queue**:
+
+- *files* are handed to producer pods dynamically (``next_file``), so
+  a slow or late pod simply produces fewer files — work stealing with
+  no rebalance barrier;
+- every produced batch carries its **record spans** ``(file_idx,
+  begin, end)``; consumers ack spans back, and the service keeps the
+  union of consumed spans per file;
+- if a producer dies, its in-progress and unconsumed files are
+  re-queued **minus the consumed spans**, so surviving pods re-produce
+  only what was never consumed (the no-silent-drops guarantee the
+  reference lacked — its dedup was producer-local only,
+  data_server.py:79-91);
+- a reader is created per *generation* (callers key it by epoch +
+  cluster stage); ``create_reader`` accepts the restored
+  :class:`~edl_tpu.cluster.state.DataCheckpoint` spans, which is how a
+  stop-resume restart (same or different world size) resumes
+  mid-epoch exactly once.
+
+Delivery semantics: exactly-once per generation in the absence of
+producer death; at-least-once for batches consumed-but-unacked at the
+moment their producer dies (the stop-resume path never hits this —
+a resize starts a new generation from checkpointed spans).
+
+The service is hosted on the **launcher** pod-server of every pod
+(only the leader's is addressed), so it survives trainer restarts;
+batch *data* never moves through the leader — each pod serves its own
+cache (reference data_server.py:319-330).
 """
 
 from __future__ import annotations
@@ -14,119 +41,333 @@ import threading
 from collections import OrderedDict, deque
 
 from edl_tpu.rpc.server import RpcServer
-from edl_tpu.utils.exceptions import EdlStopIteration, EdlTableError
+from edl_tpu.utils.exceptions import EdlDataError, EdlStopIteration, EdlTableError
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
 
 logger = get_logger(__name__)
 
 
-class _ReaderState:
-    def __init__(self, pods: list[str], file_list: list[str]):
-        self.pods = list(pods)
-        self.file_list = list(file_list)
-        # round-robin file slices (reference PodsData, data_server.py:118-133)
-        self.slices = {pod: [(i, f) for i, f in enumerate(file_list)
-                             if i % len(pods) == pods.index(pod)]
-                       for pod in pods}
-        self.queue: deque = deque()          # (producer_pod, endpoint, batch_id)
-        self.inflight: dict[str, list] = {}  # consumer pod -> metas handed out
-        self.ended: set[str] = set()         # producers done
-        self.total_produced = 0
-        self.total_consumed = 0
+from edl_tpu.utils.spans import in_spans, merge_span  # noqa: F401 — re-export
+
+
+class _Meta:
+    """One produced batch: where it lives and which records it covers."""
+
+    __slots__ = ("producer", "endpoint", "batch_id", "spans")
+
+    def __init__(self, producer: str, endpoint: str, batch_id: str,
+                 spans: list[list[int]]):
+        self.producer = producer
+        self.endpoint = endpoint
+        self.batch_id = batch_id
+        self.spans = spans  # [[file_idx, begin, end], ...]
+
+    def wire(self) -> list:
+        return [self.producer, self.endpoint, self.batch_id, self.spans]
+
+
+class _ReaderGen:
+    """State of one reader generation.
+
+    ``pending`` entries are ``[file_idx, only]`` where ``only`` is None
+    (produce the whole file minus consumed spans) or a span list
+    (re-produce JUST those records — the cache-eviction repair path,
+    which must not duplicate the file's still-fetchable batches)."""
+
+    def __init__(self, files: list[str]):
+        self.files = list(files)
+        self.pending: deque[list] = deque([i, None] for i in range(len(files)))
+        self.owner: dict[int, str] = {}          # file_idx -> producing pod
+        self.consumed: dict[int, list[list[int]]] = {}  # file_idx -> spans
+        self.queue: deque[_Meta] = deque()
+        self.inflight: dict[str, OrderedDict[str, _Meta]] = {}
+        self.error: str | None = None            # fatal producer error
+        self.produced = 0
+        self.acked = 0
+
+    def exhausted(self) -> bool:
+        """Nothing left to hand out (now)."""
+        return not self.pending and not self.owner and not self.queue
+
+    def drained(self) -> bool:
+        """Nothing left AND nothing in flight that could nack back.
+
+        Gates the producer ``eof`` only (advisor r3: a producer exiting
+        on queue-empty left nacked files with no producer).  Consumers
+        must NOT wait on each other's inflight — a finished consumer
+        blocking here while a peer waits for it in the per-step
+        agreement collective deadlocks the epoch."""
+        return self.exhausted() and not any(len(h)
+                                            for h in self.inflight.values())
 
 
 class DataService:
-    """Leader-hosted; registered on the leader pod's RPC server."""
+    """Leader-hosted; registered on the pod's launcher RPC server."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._readers: dict[str, _ReaderState] = {}
+        self._gens: dict[str, _ReaderGen] = {}
 
-    def create_reader(self, reader: str, pods: list[str],
-                      file_list: list[str]) -> dict:
+    # -- lifecycle -----------------------------------------------------------
+    def create_reader(self, reader: str, files: list[str],
+                      consumed: list[list[int]] | None = None) -> dict:
+        """Idempotent: the first caller creates the generation, later
+        callers join it (and their ``consumed`` spans — the restored
+        DataCheckpoint — are unioned in only at creation, when the set
+        is identical across pods anyway: all pods restore the same
+        checkpoint)."""
+        base = reader.split("@", 1)[0]
         with self._lock:
-            if reader not in self._readers:
-                self._readers[reader] = _ReaderState(pods, file_list)
-                logger.info("reader %s: %d files over pods %s", reader,
-                            len(file_list), [p[:8] for p in pods])
+            if reader not in self._gens:
+                gen = _ReaderGen(files)
+                for file_idx, b, e in consumed or []:
+                    merge_span(gen.consumed.setdefault(int(file_idx), []),
+                               int(b), int(e))
+                # drop pending files that are already fully consumed is
+                # not knowable here (record counts unknown); producers
+                # discover emptiness and report file_done with 0 batches
+                self._gens[reader] = gen
+                # GC older generations of the same base reader name: a
+                # new epoch/stage obsoletes them (launcher-hosted state
+                # must not grow across a long job)
+                stale = [k for k in self._gens
+                         if k != reader and k.split("@", 1)[0] == base]
+                for k in stale:
+                    del self._gens[k]
+                logger.info("reader %s: %d files (%d stale gens dropped)",
+                            reader, len(files), len(stale))
         return {}
 
-    def _state(self, reader: str) -> _ReaderState:
-        st = self._readers.get(reader)
-        if st is None:
+    def _gen(self, reader: str) -> _ReaderGen:
+        gen = self._gens.get(reader)
+        if gen is None:
             raise EdlTableError(f"unknown reader {reader!r}")
-        return st
+        return gen
 
-    def get_file_list(self, reader: str, pod_id: str) -> dict:
-        """This pod's (file_idx, path) slice."""
+    # -- producer side -------------------------------------------------------
+    def next_file(self, reader: str, pod_id: str) -> dict:
+        """Assign the next unproduced file to this pod; ``skip`` carries
+        the already-consumed spans of that file so re-produced files
+        (dead producer, resumed epoch) emit only unconsumed records.
+
+        ``file=None, eof=False`` means "nothing right now, poll again":
+        a dead peer's files may requeue later — producers must outlive
+        their own slice, or requeued work would have no producer."""
         with self._lock:
-            st = self._state(reader)
-            if pod_id not in st.slices:
-                raise EdlTableError(f"pod {pod_id} not in reader {reader}")
-            return {"files": st.slices[pod_id]}
+            gen = self._gen(reader)
+            if not gen.pending:
+                return {"file": None, "skip": [],
+                        "eof": gen.drained() or gen.error is not None}
+            file_idx, only = gen.pending.popleft()
+            gen.owner[file_idx] = pod_id
+            return {"file": [file_idx, gen.files[file_idx]], "eof": False,
+                    "only": only,
+                    "skip": [list(s) for s in gen.consumed.get(file_idx, [])]}
 
     def report_batch_meta(self, reader: str, pod_id: str, endpoint: str,
-                          batch_ids: list[str]) -> dict:
+                          batches: list) -> dict:
+        """``batches``: [[batch_id, [[file_idx, begin, end], ...]], ...].
+        Returns the queue backlog so producers can throttle before their
+        local caches evict unfetched batches (an empty ``batches`` call
+        is the cheap backlog poll)."""
         with self._lock:
-            st = self._state(reader)
-            for bid in batch_ids:
-                st.queue.append((pod_id, endpoint, bid))
-            st.total_produced += len(batch_ids)
+            gen = self._gen(reader)
+            for batch_id, spans in batches:
+                gen.queue.append(_Meta(pod_id, endpoint, batch_id,
+                                       [list(map(int, s)) for s in spans]))
+            gen.produced += len(batches)
+            return {"backlog": len(gen.queue)}
+
+    def file_done(self, reader: str, pod_id: str, file_idx: int) -> dict:
+        with self._lock:
+            gen = self._gen(reader)
+            if gen.owner.get(int(file_idx)) == pod_id:
+                del gen.owner[int(file_idx)]
         return {}
 
-    def reach_data_end(self, reader: str, pod_id: str) -> dict:
+    def file_failed(self, reader: str, pod_id: str, file_idx: int,
+                    error: str) -> dict:
+        """A producer hit a non-transient error (unreadable file): fail
+        the whole generation so every consumer sees it — the reference
+        surfaced producer errors only on the producing pod."""
         with self._lock:
-            st = self._state(reader)
-            st.ended.add(pod_id)
+            gen = self._gen(reader)
+            gen.error = f"producer {pod_id[:8]} file {file_idx}: {error}"
+            logger.error("reader %s failed: %s", reader, gen.error)
         return {}
 
+    # -- consumer side -------------------------------------------------------
     def get_batch_meta(self, reader: str, pod_id: str, n: int = 1,
-                       ack: int = 0) -> dict:
-        """Pop up to ``n`` balanced metas for this consumer; ``ack``
-        confirms that many previously handed-out metas were consumed
-        (freeing them from the in-flight table).  Raises
-        EdlStopIteration when production has ended and the queue is
-        drained."""
+                       ack_ids: list[str] | None = None) -> dict:
+        """Pop up to ``n`` metas for this consumer; ``ack_ids`` confirms
+        previously handed-out batches were consumed (their spans join
+        the consumed union).  Raises EdlStopIteration once every file is
+        produced and every batch handed out."""
         with self._lock:
-            st = self._state(reader)
-            held = st.inflight.setdefault(pod_id, [])
-            if ack:
-                st.total_consumed += min(ack, len(held))
-                del held[:ack]
+            gen = self._gen(reader)
+            held = gen.inflight.setdefault(pod_id, OrderedDict())
+            for bid in ack_ids or []:
+                meta = held.pop(bid, None)
+                if meta is not None:
+                    gen.acked += 1
+                    for file_idx, b, e in meta.spans:
+                        merge_span(gen.consumed.setdefault(file_idx, []), b, e)
+            if gen.error is not None:
+                raise EdlDataError(gen.error)
             metas = []
-            while st.queue and len(metas) < n:
-                metas.append(st.queue.popleft())
-            held.extend(metas)
-            if not metas and st.ended >= set(st.pods) and not st.queue:
-                raise EdlStopIteration(f"reader {reader} drained "
-                                      f"({st.total_produced} batches)")
+            while gen.queue and len(metas) < n:
+                meta = gen.queue.popleft()
+                held[meta.batch_id] = meta
+                metas.append(meta.wire())
+            # end-of-data is per consumer: ITS acks are in (held empty)
+            # and nothing is pending globally.  Other consumers' inflight
+            # must not delay it (deadlock vs the step agreement); should
+            # one of their batches nack later, any still-live producer
+            # re-produces it and still-consuming pods pick it up.
+            if not metas and not held and gen.exhausted():
+                raise EdlStopIteration(
+                    f"reader {reader} drained ({gen.produced} batches, "
+                    f"{gen.acked} acked)")
             return {"metas": metas}
 
-    def requeue_pod(self, reader: str, dead_pod: str) -> dict:
-        """Cluster resize: a consumer died — its unconsumed in-flight
-        metas return to the pool (the no-silent-drops guarantee the
-        reference lacked, SURVEY.md §7 hard parts)."""
+    def nack_batches(self, reader: str, pod_id: str, batch_ids: list[str],
+                     producer_dead: bool = True) -> dict:
+        """Consumer could not fetch these batches.
+
+        ``producer_dead=True`` (transport failure): the producer is
+        presumed dead and ALL its work requeues via mark_pod_dead.
+        ``producer_dead=False`` (the producer answered "not in cache" —
+        it evicted the batch under pressure): re-produce ONLY the lost
+        batches' spans; the producer is healthy and its other queued
+        batches are still fetchable, so declaring it dead would drop
+        them and double-produce their files (advisor r3)."""
+        producers = set()
         with self._lock:
-            st = self._state(reader)
-            metas = st.inflight.pop(dead_pod, [])
-            for m in reversed(metas):
-                st.queue.appendleft(m)
-            if metas:
-                logger.info("requeued %d in-flight batches from dead pod %s",
-                            len(metas), dead_pod[:8])
+            gen = self._gen(reader)
+            held = gen.inflight.get(pod_id, OrderedDict())
+            for bid in batch_ids:
+                meta = held.pop(bid, None)
+                if meta is not None:
+                    producers.add(meta.producer)
+                    self._requeue_spans_locked(
+                        gen, meta.spans, whole_file=producer_dead)
+        if producer_dead:
+            for producer in producers:
+                self.mark_pod_dead(producer, reader=reader)
         return {}
+
+    # -- failure handling ----------------------------------------------------
+    def mark_pod_dead(self, pod_id: str, reader: str | None = None) -> dict:
+        """A pod left the cluster (or stopped answering fetches): across
+        the given (default: every) generation, requeue the metas it held
+        as a consumer, drop the queued metas it produced, and requeue
+        its files — all minus already-consumed spans."""
+        with self._lock:
+            gens = ([self._gens[reader]] if reader and reader in self._gens
+                    else list(self._gens.values()) if reader is None else [])
+            for gen in gens:
+                # consumer side: unconsumed handed-out metas return to the
+                # pool (unless their producer is the dead pod itself)
+                held = gen.inflight.pop(pod_id, None)
+                requeued = 0
+                for meta in reversed((held or {}).values()):
+                    if meta.producer == pod_id:
+                        self._requeue_spans_locked(gen, meta.spans,
+                                                   whole_file=True)
+                    else:
+                        gen.queue.appendleft(meta)  # reversed: keeps order
+                        requeued += 1
+                # producer side: queued batches of a dead producer point
+                # at a dead cache — re-produce their files instead
+                dead_queued = [m for m in gen.queue if m.producer == pod_id]
+                if dead_queued:
+                    gen.queue = deque(m for m in gen.queue
+                                      if m.producer != pod_id)
+                    for meta in dead_queued:
+                        self._requeue_spans_locked(gen, meta.spans,
+                                                   whole_file=True)
+                # metas it produced that other consumers hold will fail
+                # their fetch and come back through nack_batches
+                for file_idx, owner in list(gen.owner.items()):
+                    if owner == pod_id:
+                        del gen.owner[file_idx]
+                        # whole-file re-production supersedes any pending
+                        # span-only repair entry for this file
+                        gen.pending = deque(e for e in gen.pending
+                                            if e[0] != file_idx)
+                        gen.pending.appendleft([file_idx, None])
+                if held or dead_queued:
+                    logger.info(
+                        "pod %s dead: requeued %d metas, re-producing %d "
+                        "batches' files", pod_id[:8], requeued,
+                        len(dead_queued))
+        return {}
+
+    @staticmethod
+    def _requeue_spans_locked(gen: _ReaderGen, spans: list,
+                              whole_file: bool) -> None:
+        """Mark lost batches for re-production.
+
+        ``whole_file=True`` (producer dead: every unconsumed record of
+        the file needs a new producer) requeues the file unless already
+        pending/owned.  ``whole_file=False`` (single evicted batch from
+        a live producer) requeues ONLY the batch's spans — even if the
+        file is currently owned, since these records were already
+        produced and are disjoint from whatever the owner is still
+        emitting."""
+        if whole_file:
+            for file_idx in {s[0] for s in spans}:
+                if file_idx in gen.owner:
+                    continue
+                entry = next((e for e in gen.pending if e[0] == file_idx),
+                             None)
+                if entry is None:
+                    gen.pending.append([file_idx, None])
+                else:
+                    entry[1] = None  # upgrade a span-only repair entry
+        else:
+            by_file: dict[int, list[list[int]]] = {}
+            for file_idx, b, e in spans:
+                merge_span(by_file.setdefault(file_idx, []), b, e)
+            for file_idx, only in by_file.items():
+                entry = next((e for e in gen.pending
+                              if e[0] == file_idx and e[1] is not None), None)
+                if entry is not None:
+                    for b, e in only:
+                        merge_span(entry[1], b, e)
+                elif any(e[0] == file_idx and e[1] is None
+                         for e in gen.pending):
+                    pass  # whole-file re-production already covers these
+                else:
+                    gen.pending.append([file_idx, only])
+
+    # -- introspection --------------------------------------------------------
+    def reader_status(self, reader: str) -> dict:
+        with self._lock:
+            gen = self._gen(reader)
+            return {
+                "files": len(gen.files), "pending": len(gen.pending),
+                "owned": len(gen.owner), "queued": len(gen.queue),
+                "inflight": {k: len(v) for k, v in gen.inflight.items()},
+                "produced": gen.produced, "acked": gen.acked,
+                "consumed": {str(k): [list(s) for s in v]
+                             for k, v in gen.consumed.items()},
+                "error": gen.error,
+            }
 
 
 class PodDataServer:
     """Every pod's batch cache + RPC surface.  The leader's instance
-    additionally carries the :class:`DataService`."""
+    additionally carries the :class:`DataService` (tests/standalone use;
+    under the elastic launcher the service rides the launcher's pod
+    server instead — see collective/launcher.py)."""
 
     def __init__(self, pod_id: str, is_leader: bool = False,
                  host: str | None = None, port: int = 0,
                  cache_cap: int = 256):
         self.pod_id = pod_id
-        self._cache: OrderedDict[str, list] = OrderedDict()
+        self._cache: OrderedDict[str, dict] = OrderedDict()
         self._cache_cap = cache_cap
         self._lock = threading.Lock()
         self._rpc = RpcServer(host="0.0.0.0", port=port)
@@ -138,12 +379,13 @@ class PodDataServer:
         self.endpoint = f"{host or local_ip()}:{self._rpc.port}"
 
     # -- local cache ---------------------------------------------------------
-    def put_batch(self, batch_id: str, records: list) -> None:
+    def put_batch(self, batch_id: str, payload: dict) -> None:
         with self._lock:
-            self._cache[batch_id] = records
+            self._cache[batch_id] = payload
             while len(self._cache) > self._cache_cap:
                 evicted, _ = self._cache.popitem(last=False)
-                logger.warning("cache full: evicted batch %s", evicted)
+                logger.warning("cache full: evicted batch %s (the consumer "
+                               "will nack and the file re-produces)", evicted)
 
     def pop_batch(self, batch_id: str):
         with self._lock:
@@ -151,10 +393,10 @@ class PodDataServer:
 
     def get_batch_data(self, batch_id: str) -> dict:
         with self._lock:
-            records = self._cache.get(batch_id)
-        if records is None:
+            payload = self._cache.get(batch_id)
+        if payload is None:
             raise EdlTableError(f"batch {batch_id} not in cache of {self.pod_id}")
-        return {"records": records}
+        return {"payload": payload}
 
     def stop(self) -> None:
         self._rpc.stop()

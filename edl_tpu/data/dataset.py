@@ -28,3 +28,18 @@ class TxtFileSplitter(FileSplitter):
                 if line:
                     yield record_no, line
                     record_no += 1
+
+
+class RecordioSplitter(FileSplitter):
+    """One record per CRC-checked recordio entry (csrc/recordio.cc) —
+    the image-pipeline format, so the distributed data service can feed
+    the collective ResNet workload."""
+
+    def split(self, path: str) -> Iterator[tuple[int, bytes]]:
+        from edl_tpu.native.recordio import RecordReader
+        reader = RecordReader(path)
+        try:
+            for record_no, record in enumerate(reader):
+                yield record_no, record
+        finally:
+            reader.close()

@@ -2,29 +2,36 @@
 
 Reference intent: python/edl/collective/distribute_reader.py (391,
 broken as written — SURVEY.md §2.4 documents the typos and dead
-modules; this is the working redesign).  Three roles in one object:
+modules; this is the working redesign over the span-aware work queue
+in data_server.py).  Three roles in one object:
 
-- **produce** (thread): parse this pod's file slice into batches of
-  records, cache them in the local :class:`PodDataServer`, report the
-  ids to the leader;
+- **produce** (thread): pull file assignments from the leader
+  (``next_file``), parse each into batches of records — skipping
+  already-consumed spans — cache them in the local
+  :class:`PodDataServer`, report ``(batch_id, spans)`` metas;
 - **consume** (iterator): pull balanced metas from the leader
   (ack-previous work-stealing), fetch batch bytes locally or from the
-  producing pod's data server, yield ``(batch_id, records)``;
-- **checkpoint**: every yielded batch marks its record ranges in a
-  :class:`DataCheckpoint` so a resumed job skips processed records
-  (reference data_filter.py stub, state.py:25-31 — finished here).
+  producing pod's data server, yield ``(batch_id, payload)`` where
+  ``payload = {"records": [...], "spans": [[file_idx, b, e), ...]}``;
+- **checkpoint**: every yielded batch marks its record spans in a
+  :class:`DataCheckpoint` *before* the trainer steps on it, so a
+  mid-epoch Orbax save captures exactly the consumed-so-far set and a
+  resumed job (any world size) re-creates the reader generation from
+  it — exactly-once across stop-resume (reference data_filter.py
+  stub + state.py:25-31, finished here).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Iterator
+import time
+from typing import Iterator
 
 from edl_tpu.cluster.state import DataCheckpoint
-from edl_tpu.data.data_server import PodDataServer
+from edl_tpu.data.data_server import PodDataServer, in_spans
 from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
 from edl_tpu.rpc.client import RpcClient
-from edl_tpu.utils.exceptions import EdlStopIteration
+from edl_tpu.utils.exceptions import EdlError, EdlStopIteration, EdlTableError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -36,7 +43,7 @@ class DistributedReader:
                  batch_size: int = 32,
                  splitter: FileSplitter | None = None,
                  checkpoint: DataCheckpoint | None = None,
-                 meta_prefetch: int = 4):
+                 meta_prefetch: int = 4, mark_on_yield: bool = True):
         self.name = reader_name
         self.pod_id = pod_id
         self._leader = RpcClient(leader_endpoint)
@@ -45,49 +52,110 @@ class DistributedReader:
         self._splitter = splitter or TxtFileSplitter()
         self.checkpoint = checkpoint or DataCheckpoint(reader_name)
         self._prefetch = meta_prefetch
+        # mark_on_yield=False defers checkpoint marking to the caller
+        # (elastic_input marks per record as batches are actually fed to
+        # the train step, so a mid-epoch save never claims records that
+        # were fetched but not yet trained)
+        self._mark_on_yield = mark_on_yield
+        # producer pauses when the leader's unfetched backlog exceeds
+        # this (half the default PodDataServer cache, so local caches
+        # never evict in steady state)
+        self._backpressure = 128
         self._produce_exc: BaseException | None = None
+        self._stop_produce = threading.Event()
         self._peer_clients: dict[str, RpcClient] = {}
+
+    def create(self, files: list[str]) -> "DistributedReader":
+        """Create/join this reader's generation on the leader, seeding it
+        with this pod's restored checkpoint spans (identical across pods
+        — every pod restores the same shared checkpoint)."""
+        consumed = [[r.file_idx, r.begin, r.end]
+                    for r in self.checkpoint.processed]
+        self._leader.call("create_reader", reader=self.name, files=files,
+                          consumed=consumed)
+        return self
 
     # -- producer ------------------------------------------------------------
     def _produce(self) -> None:
         try:
-            files = self._leader.call("get_file_list", reader=self.name,
-                                      pod_id=self.pod_id)["files"]
             seq = 0
-            batch: list = []
-            spans: list[tuple[int, int, int]] = []  # (file_idx, begin, end)
-            for file_idx, path in files:
-                begin = None
-                for record_no, record in self._splitter.split(path):
-                    if self.checkpoint.is_processed(file_idx, record_no):
-                        continue  # resume: skip checkpointed records
-                    if begin is None:
-                        begin = record_no
-                    batch.append(record)
-                    if len(batch) == self._bs:
-                        spans.append((file_idx, begin, record_no + 1))
-                        seq = self._publish(seq, batch, spans)
-                        batch, spans, begin = [], [], None
-                if begin is not None:
-                    spans.append((file_idx, begin, record_no + 1))
-            if batch:
-                self._publish(seq, batch, spans)
-            self._leader.call("reach_data_end", reader=self.name,
-                              pod_id=self.pod_id)
+            while not self._stop_produce.is_set():
+                assignment = self._leader.call("next_file", reader=self.name,
+                                               pod_id=self.pod_id)
+                if assignment["file"] is None:
+                    if assignment.get("eof"):
+                        return  # generation fully drained — really done
+                    # stay alive: a dead peer's files may requeue to us
+                    time.sleep(0.05)
+                    continue
+                file_idx, path = assignment["file"]
+                skip = assignment["skip"]
+                only = assignment.get("only")
+                seq = self._produce_file(int(file_idx), path, skip, only, seq)
         except BaseException as e:  # noqa: BLE001 — surfaced by consumer
             self._produce_exc = e
+
+    def _produce_file(self, file_idx: int, path: str,
+                      skip: list[list[int]], only: list[list[int]] | None,
+                      seq: int) -> int:
+        """Emit batches for one file, skipping consumed spans (and, for a
+        span-only repair assignment, everything outside ``only``);
+        report failure to the leader so ALL consumers see it (the
+        reference surfaced producer errors only on the producing pod)."""
+        try:
+            batch: list = []
+            spans: list[list[int]] = []
+            begin = None
+            record_no = -1
+            for record_no, record in self._splitter.split(path):
+                if (only is not None and not in_spans(only, record_no)) or \
+                        in_spans(skip, record_no) or \
+                        self.checkpoint.is_processed(file_idx, record_no):
+                    if begin is not None:
+                        spans.append([file_idx, begin, record_no])
+                        begin = None
+                    continue
+                if begin is None:
+                    begin = record_no
+                batch.append(record)
+                if len(batch) == self._bs:
+                    spans.append([file_idx, begin, record_no + 1])
+                    seq = self._publish(seq, batch, spans)
+                    batch, spans, begin = [], [], None
+            if begin is not None:
+                spans.append([file_idx, begin, record_no + 1])
+            if batch:
+                seq = self._publish(seq, batch, spans)
+            self._leader.call("file_done", reader=self.name,
+                              pod_id=self.pod_id, file_idx=file_idx)
+            return seq
+        except EdlError:
+            raise  # leader unreachable etc. — not a file problem
+        except Exception as e:  # noqa: BLE001 — unreadable/corrupt file
             try:
-                self._leader.call("reach_data_end", reader=self.name,
-                                  pod_id=self.pod_id)
+                self._leader.call("file_failed", reader=self.name,
+                                  pod_id=self.pod_id, file_idx=file_idx,
+                                  error=f"{type(e).__name__}: {e}")
             except Exception:  # noqa: BLE001
                 pass
+            raise
 
     def _publish(self, seq: int, batch: list, spans: list) -> int:
-        batch_id = f"{self.pod_id}:{seq}"
+        batch_id = f"{self.pod_id}:{self.name}:{seq}"
         self._server.put_batch(batch_id, {"records": batch, "spans": spans})
-        self._leader.call("report_batch_meta", reader=self.name,
-                          pod_id=self.pod_id, endpoint=self._server.endpoint,
-                          batch_ids=[batch_id])
+        backlog = self._leader.call(
+            "report_batch_meta", reader=self.name, pod_id=self.pod_id,
+            endpoint=self._server.endpoint,
+            batches=[[batch_id, spans]])["backlog"]
+        # throttle: running far ahead of consumption would evict
+        # unfetched batches from the local cache (repairable, but wasted
+        # re-production); an empty report is the cheap backlog poll
+        while (backlog > self._backpressure
+               and not self._stop_produce.is_set()):
+            time.sleep(0.05)
+            backlog = self._leader.call(
+                "report_batch_meta", reader=self.name, pod_id=self.pod_id,
+                endpoint=self._server.endpoint, batches=[])["backlog"]
         return seq + 1
 
     # -- consumer ------------------------------------------------------------
@@ -95,45 +163,80 @@ class DistributedReader:
         producer = threading.Thread(target=self._produce, daemon=True,
                                     name=f"produce:{self.name}")
         producer.start()
-        ack = 0
+        ack_ids: list[str] = []
         try:
             while True:
                 try:
                     metas = self._leader.call(
                         "get_batch_meta", reader=self.name,
                         pod_id=self.pod_id, n=self._prefetch,
-                        ack=ack)["metas"]
+                        ack_ids=ack_ids)["metas"]
                 except EdlStopIteration:
                     break
-                ack = len(metas)
+                ack_ids = []
                 if not metas:
                     if self._produce_exc is not None:
                         raise self._produce_exc
-                    threading.Event().wait(0.05)
+                    time.sleep(0.05)
                     continue
-                for producer_pod, endpoint, batch_id in metas:
-                    payload = self._fetch(producer_pod, endpoint, batch_id)
-                    for file_idx, begin, end in payload["spans"]:
-                        self.checkpoint.mark_processed(file_idx, begin, end)
-                    yield batch_id, payload["records"]
-            # the leader ends the epoch once ALL producers report done —
-            # including one that died mid-slice; surface that here rather
-            # than finish "successfully" with silently-dropped files
-            producer.join(timeout=5.0)
+                nacks: dict[bool, list[str]] = {True: [], False: []}
+                for producer_pod, endpoint, batch_id, spans in metas:
+                    payload, failure = self._fetch(producer_pod, endpoint,
+                                                   batch_id)
+                    if payload is None:
+                        # "dead" (unreachable) kills the producer's work;
+                        # "miss" (evicted by a live producer) re-produces
+                        # just this batch's spans
+                        nacks[failure == "dead"].append(batch_id)
+                        continue
+                    if self._mark_on_yield:
+                        for file_idx, begin, end in payload["spans"]:
+                            self.checkpoint.mark_processed(file_idx, begin, end)
+                    ack_ids.append(batch_id)
+                    yield batch_id, payload
+                for dead, ids in nacks.items():
+                    if ids:
+                        logger.warning("nacking %d batches (producer_dead=%s)",
+                                       len(ids), dead)
+                        self._leader.call("nack_batches", reader=self.name,
+                                          pod_id=self.pod_id, batch_ids=ids,
+                                          producer_dead=dead)
             if self._produce_exc is not None:
                 raise self._produce_exc
         finally:
+            self._stop_produce.set()
             producer.join(timeout=5.0)
             for c in self._peer_clients.values():
                 c.close()
             self._leader.close()
 
-    def _fetch(self, producer_pod: str, endpoint: str, batch_id: str) -> dict:
+    def _fetch(self, producer_pod: str, endpoint: str, batch_id: str,
+               ) -> tuple[dict | None, str | None]:
+        """(payload, None) on success; (None, "miss") when a LIVE
+        producer answered but no longer has the batch (cache eviction);
+        (None, "dead") when the producer is unreachable."""
         if producer_pod == self.pod_id:
             local = self._server.pop_batch(batch_id)
             if local is not None:
-                return local
+                return local, None
+            return None, "miss"  # own cache evicted it; we are alive
         client = self._peer_clients.get(endpoint)
         if client is None:
-            client = self._peer_clients[endpoint] = RpcClient(endpoint)
-        return client.call("get_batch_data", batch_id=batch_id)["records"]
+            client = self._peer_clients[endpoint] = RpcClient(endpoint,
+                                                              timeout=10.0)
+        # a transient stall (peer busy compiling, GC pause) must not be
+        # read as death — declaring a LIVE producer dead re-produces its
+        # files and double-trains records; so retry before concluding
+        for attempt in range(3):
+            try:
+                return client.call("get_batch_data",
+                                   batch_id=batch_id)["payload"], None
+            except EdlTableError as e:  # server answered: batch evicted
+                logger.warning("fetch %s from %s: %s", batch_id, endpoint, e)
+                return None, "miss"
+            except EdlError as e:  # transport failure
+                logger.warning("fetch %s from %s failed (try %d/3): %s",
+                               batch_id, endpoint, attempt + 1, e)
+                if attempt < 2:
+                    time.sleep(1.0 * (attempt + 1))
+        return None, "dead"

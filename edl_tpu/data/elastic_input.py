@@ -1,0 +1,203 @@
+"""ElasticInput: the data service wired into collective training.
+
+This is the integration the reference never finished (SURVEY.md §2.4:
+distribute_reader.py was broken/WIP; examples sharded files statically
+per rank).  One object per trainer process turns the span-aware work
+queue (data_server.py) into **fixed-size, collectively-agreed, masked
+batches** safe to feed a jitted multi-host train step:
+
+- per epoch, every pod registers its batch cache in the reader
+  registry and waits until the reader set equals the cluster pod set
+  (reference reader.py:70-99), so all processes enter together;
+- records stream in via :class:`DistributedReader` (work-stealing, so
+  pods consume *different* amounts) and are re-chunked into exactly
+  ``batch_size``-record host batches;
+- every step runs a tiny **has-next agreement** across processes
+  (allgather of one flag): while ANY pod still has records, every pod
+  steps — pods with a short/empty buffer pad with zeros and a 0 mask.
+  The loss must be mask-weighted (``sum(loss*mask)/sum(mask)``), which
+  makes the ragged end of an epoch *counted* instead of dropped: every
+  record trains exactly once, and collective step counts always match
+  (the raggedness problem the reference's batch-id rebalance barrier
+  tried and failed to solve, data_server.py:171-224).  Caveat: models
+  with cross-example batch statistics (BatchNorm) still see padded
+  rows inside their statistics — gate the running-stat update on
+  ``mask.min() > 0`` (see train_resnet.py) or prefer per-example
+  norms (GroupNorm/LayerNorm) for bitwise exactness;
+- records are marked into the job's :class:`DataCheckpoint` only when
+  their batch is actually yielded to the train loop, so a mid-epoch
+  Orbax save captures exactly the trained-so-far set and stop-resume
+  (any world size) resumes the epoch exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import numpy as np
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.state import DataCheckpoint
+from edl_tpu.data import registry
+from edl_tpu.data.data_server import PodDataServer
+from edl_tpu.data.dataset import FileSplitter
+from edl_tpu.data.distribute_reader import DistributedReader
+from edl_tpu.utils.exceptions import EdlDataError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+# assemble(records) -> {"name": np.ndarray (B', ...)} for B' <= batch_size
+Assemble = Callable[[list], dict]
+
+
+def _allgather_flag(flag: int) -> np.ndarray:
+    """One int32 per process, allgathered — the per-step agreement."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.asarray(flag, np.int32)))
+
+
+def sync_checkpoint(checkpoint: DataCheckpoint) -> None:
+    """Merge every process's consumed spans into ``checkpoint`` in place.
+
+    The Orbax JSON sidecar is written by the primary host only, but each
+    process marks only the records IT trained — without this merge a
+    mid-epoch checkpoint would lose every other host's spans and a
+    resumed job would re-train them.  Must be called at the same step on
+    every process (the trainer calls it right before each save; steps
+    are collective, so save points always align)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    local = np.asarray([[r.file_idx, r.begin, r.end]
+                        for r in checkpoint.processed],
+                       np.int32).reshape(-1, 3)
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray(len(local), np.int32)))
+    cap = int(counts.max())
+    if cap == 0:
+        return
+    padded = np.zeros((cap, 3), np.int32)
+    padded[:len(local)] = local
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    from edl_tpu.cluster.state import ProcessedRange
+    from edl_tpu.utils.spans import merge_span
+
+    per_file: dict[int, list[list[int]]] = {}
+    for p in range(gathered.shape[0]):
+        for i in range(int(counts[p])):
+            fi, b, e = (int(x) for x in gathered[p, i])
+            merge_span(per_file.setdefault(fi, []), b, e)
+    checkpoint.processed = [ProcessedRange(fi, b, e)
+                            for fi in sorted(per_file)
+                            for b, e in per_file[fi]]
+
+
+class ElasticInput:
+    """Lives for the whole trainer process; ``epoch()`` yields one
+    epoch's batches.  ``assemble`` builds host-batch arrays from raw
+    records; short/empty batches are zero-padded and masked."""
+
+    def __init__(self, store, job_id: str, pod_id: str, reader_base: str,
+                 files: list[str], batch_size: int, splitter: FileSplitter,
+                 assemble: Assemble, distributed: bool = False,
+                 cache_cap: int = 256):
+        self._store = store
+        self._job_id = job_id
+        self._pod_id = pod_id
+        self._base = reader_base
+        self._files = sorted(files)
+        self._bs = batch_size
+        self._splitter = splitter
+        self._assemble = assemble
+        self._distributed = distributed
+        self.server = PodDataServer(pod_id, cache_cap=cache_cap)
+
+    def _leader_endpoint(self, cluster: Cluster) -> str:
+        leader = cluster.leader
+        if leader is None:
+            raise EdlDataError("cluster has no pods")
+        return leader.endpoint
+
+    def epoch(self, epoch: int, checkpoint: DataCheckpoint,
+              ) -> Iterator[dict]:
+        """Yield masked host batches for one epoch.  The generation key
+        is ``base@e<epoch>@<stage>`` — a new cluster stage (elastic
+        resize) or epoch makes a fresh generation, seeded from
+        ``checkpoint`` (the restored mid-epoch spans on resume)."""
+        cluster = Cluster.load_from_store(self._store, self._job_id)
+        if cluster is None:
+            raise EdlDataError("no cluster in store; is the launcher up?")
+        name = f"{self._base}@e{epoch}@{cluster.stage[:8]}"
+        checkpoint.reader_name = name
+        reg = registry.register_reader(self._store, self._job_id, name,
+                                       self._pod_id, self.server.endpoint)
+        try:
+            registry.wait_dist_readers(self._store, self._job_id, name,
+                                       cluster.pod_ids())
+            reader = DistributedReader(
+                name, self._pod_id, self._leader_endpoint(cluster),
+                self.server, batch_size=self._bs, splitter=self._splitter,
+                checkpoint=checkpoint, mark_on_yield=False)
+            reader.create(self._files)
+            yield from self._batches(reader, checkpoint)
+        finally:
+            reg.stop()
+
+    # -- the re-chunk + agreement loop ---------------------------------------
+    def _batches(self, reader: DistributedReader,
+                 checkpoint: DataCheckpoint) -> Iterator[dict]:
+        buf: list[tuple[object, int, int]] = []  # (record, file_idx, record_no)
+        it = iter(reader)
+        exhausted = False
+        while True:
+            while len(buf) < self._bs and not exhausted:
+                try:
+                    _bid, payload = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                records = payload["records"]
+                coords = [(fi, no) for fi, b, e in payload["spans"]
+                          for no in range(b, e)]
+                assert len(coords) == len(records), \
+                    f"spans cover {len(coords)} records, got {len(records)}"
+                buf.extend((r, fi, no)
+                           for r, (fi, no) in zip(records, coords))
+            has = int(bool(buf))
+            if self._distributed:
+                flags = _allgather_flag(has)
+                if not flags.any():
+                    return
+            elif not has:
+                return
+            take, buf = buf[:self._bs], buf[self._bs:]
+            batch = self._assemble([r for r, _fi, _no in take])
+            n = len(take)
+            pad = self._bs - n
+            if pad:
+                batch = {k: np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    for k, v in batch.items()}
+            batch["mask"] = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)])
+            # mark AFTER assembly, right before the train step consumes it:
+            # a mid-epoch checkpoint then claims exactly the trained
+            # records (grouped into contiguous runs — marking per record
+            # would rescan the span list a million times per epoch)
+            runs: list[list[int]] = []
+            for _r, fi, no in take:
+                if runs and runs[-1][0] == fi and runs[-1][2] == no:
+                    runs[-1][2] = no + 1
+                else:
+                    runs.append([fi, no, no + 1])
+            for fi, b, e in runs:
+                checkpoint.mark_processed(fi, b, e)
+            yield batch
+
+    def stop(self) -> None:
+        self.server.stop()

@@ -1,0 +1,70 @@
+"""Reader registry: which pods serve batches for a reader generation.
+
+Reference: python/edl/utils/reader.py:70-99 — ``ReaderMeta(name,
+pod_id, data_server_endpoint)`` records in the ``reader`` table, and
+``check_dist_readers`` asserting the registered reader set equals the
+cluster pod set.  Here the check is a *wait*: every trainer registers
+its batch-cache endpoint under the generation key and blocks until all
+cluster pods have done the same, so no epoch starts with a partial
+data plane (and the collective has-next agreement in elastic_input.py
+can assume every process enters the epoch together).
+
+Entries are TTL-leased like every other advert; a generation's records
+vanish with their pods, and table sweeps at job cleanup cover the rest.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlDataError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def _reader_key(job_id: str, reader: str, pod_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_READER, f"{reader}/{pod_id}")
+
+
+def register_reader(store, job_id: str, reader: str, pod_id: str,
+                    endpoint: str, ttl: float = constants.ETCD_TTL) -> Register:
+    """Advertise this pod's data server for ``reader`` (TTL-leased)."""
+    meta = json.dumps({"name": reader, "pod_id": pod_id,
+                       "endpoint": endpoint}).encode()
+    return Register(store, _reader_key(job_id, reader, pod_id), meta, ttl=ttl)
+
+
+def load_readers(store, job_id: str, reader: str) -> dict[str, str]:
+    """{pod_id: endpoint} registered for ``reader``."""
+    prefix = paths.key(job_id, constants.ETCD_READER, f"{reader}/")
+    recs, _rev = store.get_prefix(prefix)
+    out = {}
+    for rec in recs:
+        meta = json.loads(rec.value.decode())
+        out[meta["pod_id"]] = meta["endpoint"]
+    return out
+
+
+def wait_dist_readers(store, job_id: str, reader: str, pod_ids: list[str],
+                      timeout: float = 60.0,
+                      period: float = 0.2) -> dict[str, str]:
+    """Block until the reader set equals the cluster pod set (reference
+    check_dist_readers, reader.py:70-99); returns {pod_id: endpoint}.
+    Raises EdlDataError on timeout — a pod that never registers means
+    the data plane can't serve this epoch."""
+    want = set(pod_ids)
+    deadline = time.monotonic() + timeout
+    while True:
+        got = load_readers(store, job_id, reader)
+        if set(got) >= want:
+            return {p: got[p] for p in want}
+        if time.monotonic() >= deadline:
+            raise EdlDataError(
+                f"reader {reader}: registered {sorted(got)} != cluster "
+                f"{sorted(want)} after {timeout:.0f}s")
+        time.sleep(period)

@@ -26,11 +26,15 @@ _lib: ctypes.CDLL | None = None
 _failed = False
 
 
+# standalone binaries (own main()), not part of the shared library
+_STANDALONE = {"coordd.cc"}
+
+
 def _sources() -> list[str]:
     if not os.path.isdir(_SRC_DIR):
         return []
     return sorted(os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
-                  if f.endswith(".cc"))
+                  if f.endswith(".cc") and f not in _STANDALONE)
 
 
 def _stale(sources: list[str]) -> bool:
@@ -53,11 +57,7 @@ def ensure_built() -> ctypes.CDLL | None:
             return None
         try:
             if _stale(sources):
-                os.makedirs(os.path.dirname(_OUT), exist_ok=True)
-                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                       "-pthread", "-o", _OUT, *sources]
-                logger.info("building native lib: %s", " ".join(cmd))
-                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                _compile(["-O3", "-shared", "-fPIC", *sources], _OUT)
             _lib = ctypes.CDLL(_OUT)
         except (subprocess.CalledProcessError, OSError) as e:
             detail = getattr(e, "stderr", "") or str(e)
@@ -67,5 +67,40 @@ def ensure_built() -> ctypes.CDLL | None:
         return _lib
 
 
+def _compile(flags: list[str], out: str) -> None:
+    """g++ to a process-unique tmp then atomic rename: concurrent
+    builders (launcher subprocesses) must never tear the output."""
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-std=c++17", "-pthread", *flags, "-o", tmp]
+    logger.info("building native: %s", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def native_available() -> bool:
     return ensure_built() is not None
+
+
+def ensure_coordd() -> str | None:
+    """Compile (if stale) the native coordination daemon
+    (csrc/coordd.cc); returns the binary path or None if the toolchain
+    is unavailable."""
+    src = os.path.join(_SRC_DIR, "coordd.cc")
+    out = os.path.join(_ROOT, "build", "coordd")
+    if not os.path.exists(src):
+        return None
+    with _lock:
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(src) > os.path.getmtime(out)):
+                _compile(["-O2", src], out)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("coordd build failed: %s", detail.strip()[:500])
+            return None
+    return out

@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from edl_tpu.cluster.env import TrainerEnv
-from edl_tpu.cluster.state import AdjustRegistry, State
+from edl_tpu.cluster.state import AdjustRegistry, DataCheckpoint, State
 from edl_tpu.cluster.train_status import TrainStatus, save_train_status
 from edl_tpu.parallel.mesh import MeshSpec, batch_divisor, build_mesh
 from edl_tpu.parallel.sharding import (
@@ -207,6 +207,12 @@ class ElasticTrainer:
     def _run_epoch(self, state, meta, data_fn, epoch, rng, on_epoch_end=None):
         t_epoch, n_steps = time.monotonic(), 0
         start_step = int(state.step)  # one sync per epoch, not per step
+        if meta.in_epoch != epoch:
+            # entering fresh (not a mid-epoch resume): reset the data
+            # checkpoint so mid-epoch saves this epoch start from zero
+            meta.in_epoch = epoch
+            meta.epoch_start_step = start_step
+            meta.data_checkpoint = DataCheckpoint()
         for batch in data_fn(epoch):
             gbatch = shard_host_batch(batch, self.mesh, self.rules)
             rng, step_rng = jax.random.split(rng)
@@ -219,14 +225,27 @@ class ElasticTrainer:
             if (self.ckpt is not None and self.cfg.save_every_steps
                     and step % self.cfg.save_every_steps == 0):
                 meta.step = step
+                self._sync_data_checkpoint(meta)
                 self.ckpt.save(step, state, meta)
         dt = time.monotonic() - t_epoch
-        meta.record_epoch(epoch, self.world_size, n_steps,
+        # step_num covers the WHOLE epoch, including segments trained
+        # before a mid-epoch stop-resume; avg time reflects this segment
+        total_steps = (start_step + n_steps) - meta.epoch_start_step
+        meta.record_epoch(epoch, self.world_size, total_steps,
                           dt / max(1, n_steps))
         meta.step = start_step + n_steps
         meta.epoch_no = epoch
+        meta.in_epoch = -1  # epoch complete: next resume starts the next one
         if self.ckpt is not None:
-            self.ckpt.save(int(state.step), state, meta, force=True)
+            self._sync_data_checkpoint(meta)
+            if (self.cfg.save_every_steps
+                    and self.ckpt.latest_step() == int(state.step)):
+                # the last mid-epoch save already committed this step's
+                # arrays; just patch its sidecar with the end-of-epoch
+                # accounting (in_epoch=-1, the epoch record)
+                self.ckpt.save_meta(int(state.step), meta)
+            else:
+                self.ckpt.save(int(state.step), state, meta, force=True)
             # Under the elastic launcher a membership change SIGTERMs the
             # trainer between epochs; drain the async save so the resize
             # never lands before any checkpoint committed (a killed
@@ -245,6 +264,15 @@ class ElasticTrainer:
                 self.ckpt.save_meta(int(state.step), meta)
         logger.info("epoch %d done: %d steps in %.1fs", epoch, n_steps, dt)
         return state, meta
+
+    def _sync_data_checkpoint(self, meta: State) -> None:
+        """Before every save, merge all processes' consumed data spans —
+        the JSON sidecar is primary-host-only, but spans are marked by
+        whichever host trained the records (data/elastic_input.py).
+        Collective; save points are step-aligned across processes."""
+        if jax.process_count() > 1:
+            from edl_tpu.data.elastic_input import sync_checkpoint
+            sync_checkpoint(meta.data_checkpoint)
 
     # -- eval ----------------------------------------------------------------
     def make_eval_step(self, metric_fn):

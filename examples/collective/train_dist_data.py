@@ -1,0 +1,140 @@
+"""Elastic training fed by the distributed data service — the
+integration the reference left WIP (SURVEY.md §2.4/§3.5).
+
+Run under the elastic launcher on every host::
+
+    python -m edl_tpu.collective.launch --job_id dd --nodes_range 1:4 \
+        --checkpoint_dir /ckpt/dd examples/collective/train_dist_data.py \
+        -- --data_dir /data/txt --epochs 3
+
+Each record is a line ``<id> <x>``; the model regresses ``y = 3x - 1``
+with a mask-weighted loss, so the ragged end of an epoch and the
+zero-filled agreement batches are exact no-ops.  What this example
+demonstrates (and its e2e test asserts):
+
+- files are handed out dynamically by the leader's DataService (work
+  stealing — pods consume different amounts, steps stay collective via
+  the has-next agreement in ElasticInput);
+- a mid-epoch kill + elastic resize resumes THE SAME epoch from the
+  checkpointed record spans: every record of every epoch is trained
+  exactly once, at any world size;
+- per-epoch merged spans land in the State sidecar (`user_defined`)
+  as the auditable record of what trained.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", type=str, required=True)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch_size", type=int, default=4, help="per host")
+    p.add_argument("--base_lr", type=float, default=0.05)
+    p.add_argument("--save_every_steps", type=int, default=2)
+    return p.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.coord.client import connect
+    from edl_tpu.data import ElasticInput, TxtFileSplitter
+    from edl_tpu.parallel import MeshSpec
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+    from edl_tpu.train.distributed import initialize_from_env
+
+    tenv = initialize_from_env(TrainerEnv())
+    assert tenv.coord_endpoints and tenv.pod_id, \
+        "train_dist_data runs under the elastic launcher (needs the store)"
+    store = connect(tenv.coord_endpoints)
+
+    files = sorted(glob.glob(os.path.join(args.data_dir, "*.txt")))
+    assert files, f"no *.txt under {args.data_dir}"
+
+    step_sleep = float(os.environ.get("EDL_TPU_DEMO_STEP_SLEEP", "0"))
+
+    def assemble(records: list) -> dict:
+        # handles [] (agreement filler batches) via explicit shapes
+        xs = np.asarray([float(r.split()[1]) for r in records],
+                        np.float32).reshape(-1, 1)
+        return {"x": xs, "y": 3.0 * xs - 1.0}
+
+    ei = ElasticInput(store, tenv.job_id, tenv.pod_id, "train", files,
+                      args.batch_size, TxtFileSplitter(), assemble,
+                      distributed=tenv.world_size > 1)
+
+    def loss_fn(params, extra, batch, rng):
+        pred = batch["x"] * params["w"] + params["b"]
+        err = (pred - batch["y"]) ** 2
+        m = batch["mask"][:, None]
+        loss = (err * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return loss, (extra, {"mse": loss, "seen": m.sum()})
+
+    cfg = TrainConfig(mesh_spec=MeshSpec(),
+                      checkpoint_dir=tenv.checkpoint_dir,
+                      save_every_steps=args.save_every_steps,
+                      global_batch_size=args.batch_size * max(1, tenv.world_size),
+                      log_every=0)
+    trainer = ElasticTrainer(loss_fn, cfg, store=store, tenv=tenv)
+
+    def init():
+        return {"w": jnp.zeros(()), "b": jnp.zeros(())}, None
+
+    state, meta = trainer.restore_or_create(init, optax.sgd(args.base_lr))
+    resumed_spans = sum(r.end - r.begin
+                        for r in meta.data_checkpoint.processed)
+    print(f"[dist-data] rank={tenv.global_rank}/{tenv.world_size} "
+          f"resume_epoch={meta.next_epoch} in_epoch={meta.in_epoch} "
+          f"resumed_spans={resumed_spans}", flush=True)
+
+    def data_fn(epoch: int):
+        print(f"[dist-data] epoch {epoch} start", flush=True)
+        for batch in ei.epoch(epoch, meta.data_checkpoint):
+            if step_sleep:
+                time.sleep(step_sleep)
+            yield batch
+
+    def on_epoch_end(epoch, st, meta_):
+        # the sidecar just committed with the merged spans of this epoch;
+        # keep them per epoch as the auditable trained-record log (the
+        # save_meta patch after this hook persists it)
+        spans = sorted([r.file_idx, r.begin, r.end]
+                       for r in meta_.data_checkpoint.processed)
+        meta_.user_defined[f"spans_e{epoch}"] = spans
+        n = sum(e - b for _f, b, e in spans)
+        print(f"[dist-data] epoch {epoch} done: {n} records, "
+              f"w={float(st.params['w']):.3f} b={float(st.params['b']):.3f}",
+              flush=True)
+
+    state, meta = trainer.fit(state, meta, data_fn, epochs=args.epochs,
+                              on_epoch_end=on_epoch_end)
+    ei.stop()
+    w_err = abs(float(state.params["w"]) - 3.0)
+    b_err = abs(float(state.params["b"]) + 1.0)
+    marker = os.environ.get("EDL_TPU_DEMO_MARKER")
+    if marker:
+        spans = {k: v for k, v in meta.user_defined.items()
+                 if k.startswith("spans_e")}
+        with open(marker, "a") as f:
+            f.write("done " + json.dumps({
+                "rank": tenv.global_rank, "world": tenv.world_size,
+                "epochs": sorted(e.epoch_no for e in meta.epochs),
+                "w_err": round(w_err, 4), "b_err": round(b_err, 4),
+                "spans": spans}) + "\n")
+    print(f"[dist-data] done w_err={w_err:.4f} b_err={b_err:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,11 @@ def parse_args():
     p.add_argument("--bench_dump", type=str, default="",
                    help="write per-epoch benchmark JSON here "
                         "(train_with_fleet.py:642-658)")
+    p.add_argument("--data_service", action="store_true",
+                   help="read training data through the leader's "
+                        "distributed DataService (elastic, exactly-once "
+                        "mid-epoch resume) instead of static per-rank "
+                        "file shards")
     return p.parse_args()
 
 
@@ -99,19 +104,27 @@ def _generate_synthetic_once(images, data_dir: str, args) -> str:
         if not os.path.isdir(final):
             tmp = os.path.join(
                 data_dir, f".synth-tmp-{os.getpid()}-{time.monotonic_ns()}")
-            images.write_synthetic_imagenet(
-                tmp, n_files=args.synthetic_files,
-                per_file=args.synthetic_per_file, size=args.image_size,
-                classes=args.synthetic, prefix="train")
-            images.write_synthetic_imagenet(
-                tmp, n_files=1, per_file=args.synthetic_per_file,
-                size=args.image_size, classes=args.synthetic, seed=99,
-                prefix="val")
             try:
+                images.write_synthetic_imagenet(
+                    tmp, n_files=args.synthetic_files,
+                    per_file=args.synthetic_per_file, size=args.image_size,
+                    classes=args.synthetic, prefix="train")
+                images.write_synthetic_imagenet(
+                    tmp, n_files=1, per_file=args.synthetic_per_file,
+                    size=args.image_size, classes=args.synthetic, seed=99,
+                    prefix="val")
                 os.rename(tmp, final)
-            except OSError:
+            except Exception:  # noqa: BLE001 — cleanup, then re-raise below
                 shutil.rmtree(tmp, ignore_errors=True)
                 if not os.path.isdir(final):
+                    # a failed generator (ENOSPC, ...) must also drop its
+                    # advisory lock, or every later cold start stalls the
+                    # full wait deadline before generating
+                    if got_lock:
+                        try:
+                            os.unlink(lock)
+                        except FileNotFoundError:
+                            pass
                     raise  # not a lost race — surface the real error
     if os.path.isdir(final):
         # once published, the advisory lock is garbage: any process clears
@@ -197,9 +210,22 @@ def main() -> None:
         labels = optax.smooth_labels(
             jax.nn.one_hot(batch["label"], args.num_classes),
             args.label_smoothing)
-        loss = optax.softmax_cross_entropy(logits, labels).mean()
-        top1 = (logits.argmax(-1) == batch["label"]).mean()
-        return loss, (mutated["batch_stats"], {"top1": top1})
+        ce = optax.softmax_cross_entropy(logits, labels)
+        hit = (logits.argmax(-1) == batch["label"]).astype(jnp.float32)
+        mask = batch.get("mask")
+        if mask is None:
+            return ce.mean(), (mutated["batch_stats"], {"top1": hit.mean()})
+        # data-service path: ragged epoch ends arrive zero-padded with a
+        # mask, so the weighted mean trains only the real records.  On a
+        # padded step, also discard the BatchNorm running-stat update —
+        # zero rows would drag the running mean/var toward zeros and
+        # poison eval (the loss itself is already mask-exact)
+        n = jnp.maximum(mask.sum(), 1.0)
+        all_real = mask.min() > 0
+        stats = jax.tree.map(lambda new, old: jnp.where(all_real, new, old),
+                             mutated["batch_stats"], extra)
+        return (ce * mask).sum() / n, (
+            stats, {"top1": (hit * mask).sum() / n})
 
     def metric_fn(params, extra, batch):
         # per-example values: ElasticTrainer.evaluate masks padding exactly
@@ -230,15 +256,53 @@ def main() -> None:
           f"resume_epoch={meta.next_epoch} lr={lr:.4f} "
           f"steps/epoch={steps_per_epoch} files={len(my_files)}", flush=True)
 
-    def data_fn(epoch: int):
-        it = iter(images.ImageBatches(
-            my_files, args.batch_size, image_size=args.image_size,
-            train=True, seed=1000 * epoch + rank,
-            num_workers=args.num_workers))
-        for i, batch in enumerate(it):
-            if args.steps_per_epoch and i >= args.steps_per_epoch:
-                break
-            yield batch
+    if args.data_service:
+        # records flow through the leader's DataService: dynamic file
+        # assignment, spans checkpointed for exactly-once mid-epoch
+        # resume, masked ragged tail (see edl_tpu/data/elastic_input.py)
+        assert store is not None and tenv.pod_id, \
+            "--data_service requires running under the elastic launcher"
+        from concurrent.futures import ThreadPoolExecutor
+
+        from edl_tpu.data import ElasticInput, RecordioSplitter
+
+        decode_pool = ThreadPoolExecutor(args.num_workers)
+        decode_rngs = [np.random.default_rng((7, i))
+                       for i in range(args.batch_size)]
+
+        def assemble(records: list) -> dict:
+            if not records:
+                return {"image": np.zeros((0, args.image_size,
+                                           args.image_size, 3), np.float32),
+                        "label": np.zeros((0,), np.int32)}
+            decoded = list(decode_pool.map(
+                lambda ir: images.decode_train(ir[1], args.image_size,
+                                               decode_rngs[ir[0] % args.batch_size]),
+                enumerate(records)))
+            return {"image": np.stack([d[0] for d in decoded]),
+                    "label": np.asarray([d[1] for d in decoded], np.int32)}
+
+        ei = ElasticInput(store, tenv.job_id, tenv.pod_id, "imagenet",
+                          train_files, args.batch_size, RecordioSplitter(),
+                          assemble, distributed=tenv.world_size > 1)
+
+        def data_fn(epoch: int):
+            it = ei.epoch(epoch, meta.data_checkpoint)
+            for i, batch in enumerate(it):
+                if args.steps_per_epoch and i >= args.steps_per_epoch:
+                    it.close()
+                    break
+                yield batch
+    else:
+        def data_fn(epoch: int):
+            it = iter(images.ImageBatches(
+                my_files, args.batch_size, image_size=args.image_size,
+                train=True, seed=1000 * epoch + rank,
+                num_workers=args.num_workers))
+            for i, batch in enumerate(it):
+                if args.steps_per_epoch and i >= args.steps_per_epoch:
+                    break
+                yield batch
 
     def on_epoch_end(epoch, st, meta_):
         attr = meta_.epoch_attr(epoch)

