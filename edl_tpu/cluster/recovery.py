@@ -1,0 +1,73 @@
+"""Elastic recovery-time records: read + merge the per-stage timing
+halves written by the launcher (detect/killed/barrier/spawn —
+collective/launcher.py) and the trainer (restored/first_step —
+train/trainer.py).
+
+This is the north-star metric the reference never published
+(BASELINE.md "Not published: elastic resize recovery time — must be
+measured by the new framework"): how long from noticing a membership
+change until the resized world has taken its first real training step.
+"""
+
+from __future__ import annotations
+
+import json
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+def load_recovery_records(store, job_id: str) -> dict[str, dict]:
+    """{stage: {"launcher": {pod: times}, "trainer": {pod: times}}}."""
+    prefix = paths.table_prefix(job_id, constants.ETCD_RECOVERY)
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, dict] = {}
+    for rec in recs:
+        stage, role, pod = rec.key[len(prefix):].split("/", 2)
+        out.setdefault(stage, {}).setdefault(role, {})[pod] = json.loads(
+            rec.value.decode())
+    return out
+
+
+def summarize_recovery(store, job_id: str,
+                       kill_time: float | None = None) -> list[dict]:
+    """One breakdown dict per completed resize stage, oldest first.
+
+    Phases (seconds): ``detect_to_kill`` (terminate old trainers),
+    ``kill_to_barrier`` (membership re-agreement), ``barrier_to_spawn``
+    (respawn), ``spawn_to_restored`` (jax + checkpoint restore),
+    ``restored_to_first_step`` (recompile + first step), ``total`` =
+    detect → first post-resize step.  With ``kill_time`` (the harness's
+    SIGKILL timestamp) also ``kill_to_detect`` (lease TTL + generator +
+    watcher latency) and ``total_from_kill``."""
+    out = []
+    for stage, halves in load_recovery_records(store, job_id).items():
+        launchers = halves.get("launcher", {})
+        trainers = halves.get("trainer", {})
+        if not launchers:
+            continue
+        # earliest detector is the canonical launcher record; the last
+        # trainer to finish its first step closes the resize
+        lt = min(launchers.values(), key=lambda t: t["detect"])
+        entry = {
+            "stage": stage,
+            "detect_at": round(lt["detect"], 3),
+            "detect_to_kill": round(lt["killed"] - lt["detect"], 3),
+            "kill_to_barrier": round(lt["barrier"] - lt["killed"], 3),
+            "barrier_to_spawn": round(lt["spawn"] - lt["barrier"], 3),
+        }
+        if trainers:
+            tt = max(trainers.values(), key=lambda t: t["first_step"])
+            entry.update({
+                "spawn_to_restored": round(tt["restored"] - lt["spawn"], 3),
+                "restored_to_first_step": round(
+                    tt["first_step"] - tt["restored"], 3),
+                "total": round(tt["first_step"] - lt["detect"], 3),
+            })
+            if kill_time is not None:
+                entry["kill_to_detect"] = round(lt["detect"] - kill_time, 3)
+                entry["total_from_kill"] = round(
+                    tt["first_step"] - kill_time, 3)
+        out.append(entry)
+    out.sort(key=lambda e: e["detect_at"])  # chronological, oldest first
+    return out

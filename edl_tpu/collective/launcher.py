@@ -93,6 +93,7 @@ class Launcher:
                                      timeout=self._barrier_timeout)
         save_pod_status(self._store, job_id, self._pod.pod_id, Status.RUNNING)
 
+        resize_times: dict | None = None
         while True:  # one iteration per cluster generation (stage)
             self._sync_pod_from(cluster)
             watcher = ClusterWatcher(self._store, job_id, cluster, self._period)
@@ -100,18 +101,27 @@ class Launcher:
             self._procs = train_process.start_trainers(
                 self._job_env, self._pod, cluster, self._script,
                 self._script_args, self._log_dir())
+            if resize_times is not None:
+                resize_times["spawn"] = time.time()
+                self._write_recovery(cluster.stage, resize_times)
+                resize_times = None
             try:
                 verdict = self._supervise(watcher)
             finally:
                 watcher.stop()
             if verdict is not None:
                 return verdict
-            # membership changed: stop-resume
+            # membership changed: stop-resume.  Timestamp every phase —
+            # elastic recovery time is the framework's north-star metric
+            # (BASELINE.md "not published: must be measured")
             logger.info("membership changed; re-barrier + restart trainers")
+            resize_times = {"detect": time.time()}
             self._shutdown_trainers()
+            resize_times["killed"] = time.time()
             old_pods = set(cluster.pod_ids())
             cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
                                          timeout=self._resize_barrier_timeout)
+            resize_times["barrier"] = time.time()
             # release departed pods' data-service work (their files and
             # unconsumed batches requeue minus already-consumed spans);
             # restarted trainers then join fresh reader generations keyed
@@ -168,6 +178,21 @@ class Launcher:
     def _log_dir(self) -> str:
         import os
         return os.path.join(self._job_env.log_dir, self._pod.pod_id[:8])
+
+    def _write_recovery(self, stage: str, times: dict) -> None:
+        """Launcher half of the resize timing record (the trainer adds
+        restore/first-step under the same stage key — see
+        ElasticTrainer._report_recovery).  Best-effort."""
+        import json
+
+        from edl_tpu.cluster import paths
+        try:
+            self._store.put(
+                paths.key(self._job_env.job_id, constants.ETCD_RECOVERY,
+                          f"{stage}/launcher/{self._pod.pod_id}"),
+                json.dumps(times).encode())
+        except Exception:  # noqa: BLE001 — metrics must never fail a job
+            logger.exception("recovery record write failed")
 
     def _start_generator(self):
         self._generator = ClusterGenerator(

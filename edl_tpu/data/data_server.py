@@ -78,7 +78,8 @@ class _ReaderGen:
     def __init__(self, files: list[str]):
         self.files = list(files)
         self.pending: deque[list] = deque([i, None] for i in range(len(files)))
-        self.owner: dict[int, str] = {}          # file_idx -> producing pod
+        # file_idx -> (producing pod, only-spans or None for whole file)
+        self.owner: dict[int, tuple[str, list | None]] = {}
         self.consumed: dict[int, list[list[int]]] = {}  # file_idx -> spans
         self.queue: deque[_Meta] = deque()
         self.inflight: dict[str, OrderedDict[str, _Meta]] = {}
@@ -160,7 +161,7 @@ class DataService:
                 return {"file": None, "skip": [],
                         "eof": gen.drained() or gen.error is not None}
             file_idx, only = gen.pending.popleft()
-            gen.owner[file_idx] = pod_id
+            gen.owner[file_idx] = (pod_id, only)
             return {"file": [file_idx, gen.files[file_idx]], "eof": False,
                     "only": only,
                     "skip": [list(s) for s in gen.consumed.get(file_idx, [])]}
@@ -182,7 +183,8 @@ class DataService:
     def file_done(self, reader: str, pod_id: str, file_idx: int) -> dict:
         with self._lock:
             gen = self._gen(reader)
-            if gen.owner.get(int(file_idx)) == pod_id:
+            holder = gen.owner.get(int(file_idx))
+            if holder is not None and holder[0] == pod_id:
                 del gen.owner[int(file_idx)]
         return {}
 
@@ -289,7 +291,7 @@ class DataService:
                                                    whole_file=True)
                 # metas it produced that other consumers hold will fail
                 # their fetch and come back through nack_batches
-                for file_idx, owner in list(gen.owner.items()):
+                for file_idx, (owner, _only) in list(gen.owner.items()):
                     if owner == pod_id:
                         del gen.owner[file_idx]
                         # whole-file re-production supersedes any pending
@@ -318,7 +320,17 @@ class DataService:
         emitting."""
         if whole_file:
             for file_idx in {s[0] for s in spans}:
-                if file_idx in gen.owner:
+                holder = gen.owner.get(file_idx)
+                if holder is not None and holder[1] is None:
+                    continue  # a full production is already in progress
+                if holder is not None:
+                    # the current owner only covers a span-repair subset —
+                    # queue a full pass behind it so the dead producer's
+                    # other unconsumed records still re-produce (consumed
+                    # skip keeps the overlap minimal)
+                    gen.pending = deque(e for e in gen.pending
+                                        if e[0] != file_idx)
+                    gen.pending.append([file_idx, None])
                     continue
                 entry = next((e for e in gen.pending if e[0] == file_idx),
                              None)

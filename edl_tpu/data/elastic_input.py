@@ -42,6 +42,7 @@ from edl_tpu.data import registry
 from edl_tpu.data.data_server import PodDataServer
 from edl_tpu.data.dataset import FileSplitter
 from edl_tpu.data.distribute_reader import DistributedReader
+from edl_tpu.utils import constants
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logger import get_logger
 
@@ -50,12 +51,14 @@ logger = get_logger(__name__)
 # assemble(records) -> {"name": np.ndarray (B', ...)} for B' <= batch_size
 Assemble = Callable[[list], dict]
 
+# batches carry their consumed record spans under this key; the trainer
+# pops it and marks the DataCheckpoint when the batch is actually trained
+SPANS_KEY = constants.DATA_SPANS_KEY
+
 
 def _allgather_flag(flag: int) -> np.ndarray:
-    """One int32 per process, allgathered — the per-step agreement."""
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(
-        np.asarray(flag, np.int32)))
+    from edl_tpu.parallel.sharding import allgather_flag
+    return allgather_flag(flag)
 
 
 def sync_checkpoint(checkpoint: DataCheckpoint) -> None:
@@ -144,13 +147,12 @@ class ElasticInput:
                 self.server, batch_size=self._bs, splitter=self._splitter,
                 checkpoint=checkpoint, mark_on_yield=False)
             reader.create(self._files)
-            yield from self._batches(reader, checkpoint)
+            yield from self._batches(reader)
         finally:
             reg.stop()
 
     # -- the re-chunk + agreement loop ---------------------------------------
-    def _batches(self, reader: DistributedReader,
-                 checkpoint: DataCheckpoint) -> Iterator[dict]:
+    def _batches(self, reader: DistributedReader) -> Iterator[dict]:
         buf: list[tuple[object, int, int]] = []  # (record, file_idx, record_no)
         it = iter(reader)
         exhausted = False
@@ -185,18 +187,18 @@ class ElasticInput:
                     for k, v in batch.items()}
             batch["mask"] = np.concatenate(
                 [np.ones(n, np.float32), np.zeros(pad, np.float32)])
-            # mark AFTER assembly, right before the train step consumes it:
-            # a mid-epoch checkpoint then claims exactly the trained
-            # records (grouped into contiguous runs — marking per record
-            # would rescan the span list a million times per epoch)
+            # the batch CARRIES its record spans (contiguous runs); the
+            # consumer marks them into the DataCheckpoint at the moment
+            # it actually trains the batch — marking here would let a
+            # prefetching trainer checkpoint spans one batch ahead of
+            # what was trained, and a resume would skip untrained records
             runs: list[list[int]] = []
             for _r, fi, no in take:
                 if runs and runs[-1][0] == fi and runs[-1][2] == no:
                     runs[-1][2] = no + 1
                 else:
                     runs.append([fi, no, no + 1])
-            for fi, b, e in runs:
-                checkpoint.mark_processed(fi, b, e)
+            batch[SPANS_KEY] = runs
             yield batch
 
     def stop(self) -> None:

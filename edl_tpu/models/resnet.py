@@ -118,6 +118,8 @@ class ResNet(nn.Module):
 
 
 ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet18vd = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                     vd=True)
 ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
 ResNet50vd = partial(ResNet, stage_sizes=(3, 4, 6, 3), vd=True)

@@ -108,6 +108,15 @@ def shard_init(init_fn, tree_logical, mesh: Mesh,
     return jax.jit(init_fn, out_shardings=shardings)()
 
 
+def allgather_flag(flag: int) -> np.ndarray:
+    """One int32 per process, allgathered — the building block for
+    per-step cross-host agreements (has-next in elastic_input, the eval
+    loop's ragged-end handling)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.asarray(flag, np.int32)))
+
+
 def shard_host_batch(batch, mesh: Mesh, rules: ShardingRules | None = None):
     """Assemble per-host numpy batches into a global device array split
     on the batch axes.  This is the host→device hand-off the reference

@@ -15,6 +15,7 @@ ETCD_CLUSTER = "cluster"            # the generated Cluster JSON
 ETCD_READER = "reader"              # distributed-reader registry
 ETCD_STATE = "state"                # train State (data checkpoint etc.)
 ETCD_DIST_READER = "dist_reader"
+ETCD_RECOVERY = "recovery"          # per-stage resize timing records
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -26,9 +27,15 @@ ALL_TABLES = [
     ETCD_READER,
     ETCD_STATE,
     ETCD_DIST_READER,
+    ETCD_RECOVERY,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
+
+# key under which data-service batches carry their record spans from
+# producer to the train loop, which marks them into the DataCheckpoint
+# at consumption time (elastic_input.py <-> train/trainer.py)
+DATA_SPANS_KEY = "__consumed_spans__"
 
 # timing (reference constants.py:26 + register.py:59-68); every value is
 # env-overridable so integration tests can run with sub-second TTLs the

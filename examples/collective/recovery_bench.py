@@ -1,0 +1,144 @@
+"""Elastic recovery-time benchmark — the north-star metric.
+
+Runs a 2-pod elastic job (train_linear under two real launchers against
+an in-process coordination server), SIGKILLs one pod mid-run, lets the
+survivor stop-resume solo, and prints ONE JSON line with the measured
+recovery breakdown (see edl_tpu/cluster/recovery.py for the phases).
+
+    python examples/collective/recovery_bench.py [--epochs 12] [--ttl 2]
+
+The reference never published this number (BASELINE.md): its stop-resume
+design makes recovery ≈ detection latency + restart + checkpoint reload,
+which is exactly what the breakdown shows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import psutil
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def spawn(job_id, coord_ep, tmp, name, ckpt, epochs, env_extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    log = open(os.path.join(tmp, f"launcher-{name}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", job_id, "--coord_endpoints", coord_ep,
+         "--nodes_range", "1:2", "--nproc_per_node", "1",
+         "--checkpoint_dir", ckpt,
+         "--log_dir", os.path.join(tmp, f"log-{name}"),
+         os.path.join(REPO, "examples", "collective", "train_linear.py"),
+         "--", "--epochs", str(epochs), "--steps_per_epoch", "6"],
+        env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
+    return proc
+
+
+def kill_tree(proc):
+    try:
+        parent = psutil.Process(proc.pid)
+        victims = parent.children(recursive=True) + [parent]
+    except psutil.NoSuchProcess:
+        return
+    for p in victims:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except psutil.NoSuchProcess:
+            pass
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--ttl", type=float, default=2.0,
+                   help="registration lease TTL (bounds detection latency)")
+    p.add_argument("--form_timeout", type=float, default=180.0,
+                   help="max wait for the 2-pod world to train + checkpoint "
+                        "before the kill")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX platform for the trainers (two processes "
+                        "cannot share one TPU chip, so cpu by default)")
+    args = p.parse_args()
+
+    from edl_tpu.cluster.recovery import summarize_recovery
+    from edl_tpu.coord.server import start_server
+
+    env_extra = {
+        "JAX_PLATFORMS": args.platform,
+        "XLA_FLAGS": "",
+        "EDL_TPU_TTL": str(args.ttl),
+        "EDL_TPU_GENERATOR_PERIOD": "0.3",
+        "EDL_TPU_WATCHER_PERIOD": "0.3",
+        "EDL_TPU_SUPERVISOR_PERIOD": "0.3",
+        "EDL_TPU_DEMO_STEP_SLEEP": "0.3",
+    }
+    server = start_server("127.0.0.1", 0)
+    ep = f"127.0.0.1:{server.port}"
+    tmp = tempfile.mkdtemp(prefix="edl-recovery-")
+    ckpt = os.path.join(tmp, "ckpt")
+    job = "recovery-bench"
+
+    pa = spawn(job, ep, tmp, "a", ckpt, args.epochs, env_extra)
+    pb = spawn(job, ep, tmp, "b", ckpt, args.epochs, env_extra)
+
+    # kill only once the 2-pod world is really training AND a checkpoint
+    # committed — recovery = detect + restart + RESTORE + first step; a
+    # kill during world formation would measure a cold start instead
+    def world_trained() -> bool:
+        import glob
+        logs = glob.glob(os.path.join(tmp, "log-*", "*", "workerlog.0"))
+        formed = sum("/2 " in open(p, errors="replace").read()
+                     for p in logs) >= 2
+        committed = any(d.isdigit()  # not an .orbax-checkpoint-tmp dir
+                        for d in (os.listdir(ckpt) if os.path.isdir(ckpt)
+                                  else []))
+        return formed and committed
+
+    deadline = time.monotonic() + args.form_timeout
+    while not world_trained():
+        if time.monotonic() > deadline:
+            raise SystemExit("2-pod world never trained+checkpointed")
+        if pa.poll() is not None or pb.poll() is not None:
+            raise SystemExit("a launcher died during world formation")
+        time.sleep(0.5)
+    time.sleep(1.0)  # land the kill mid-training, not at the checkpoint
+    kill_time = time.time()
+    kill_tree(pb)
+    ret = pa.wait(timeout=600)
+    if ret != 0:
+        log = open(os.path.join(tmp, "launcher-a.log"), "rb").read()
+        sys.stderr.write(log[-4000:].decode(errors="replace"))
+        raise SystemExit(f"survivor exited {ret}")
+
+    from edl_tpu.coord.client import CoordClient
+    client = CoordClient(ep)
+    stages = summarize_recovery(client, job, kill_time=kill_time)
+    client.close()
+    server.stop()
+    complete = [s for s in stages if "total" in s]
+    if not complete:
+        raise SystemExit("no resize was recorded — kill landed too late?")
+    worst = max(complete,
+                key=lambda s: s.get("total_from_kill", s.get("total", 0)))
+    print(json.dumps({
+        "metric": "elastic_recovery_sec",
+        "value": worst.get("total_from_kill", worst.get("total")),
+        "unit": "s (SIGKILL of 1/2 pods -> survivor's first post-resize "
+                f"step; lease ttl {args.ttl}s)",
+        "breakdown": worst,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,11 @@ def parse_args():
     p.add_argument("--bench_dump", type=str, default="",
                    help="write per-epoch benchmark JSON here "
                         "(train_with_fleet.py:642-658)")
+    p.add_argument("--profile_steps", type=str, default="",
+                   help="'START:STOP' rank-0 jax.profiler window "
+                        "(reference profiled batches 100-105, "
+                        "train_with_fleet.py:521-530)")
+    p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--data_service", action="store_true",
                    help="read training data through the leader's "
                         "distributed DataService (elastic, exactly-once "
@@ -238,9 +243,16 @@ def main() -> None:
                 jnp.float32),
         }
 
+    profile_window = None
+    if args.profile_steps:
+        lo, _, hi = args.profile_steps.partition(":")
+        profile_window = (int(lo), int(hi or int(lo) + 5))
     cfg = TrainConfig(mesh_spec=MeshSpec(),
                       checkpoint_dir=tenv.checkpoint_dir,
-                      global_batch_size=global_batch, log_every=50)
+                      global_batch_size=global_batch, log_every=50,
+                      profile_window=profile_window,
+                      profile_dir=args.profile_dir or
+                      os.path.join(tenv.checkpoint_dir or "/tmp", "profile"))
     trainer = ElasticTrainer(loss_fn, cfg, store=store, tenv=tenv)
     trainer.adjust.register(
         lambda old, new, st: print(f"[adjust] world {old} -> {new}; "

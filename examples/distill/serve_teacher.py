@@ -1,0 +1,20 @@
+"""Teacher-serving entry point (k8s teacher Deployment, k8s/distill.yaml).
+
+Loads a checkpointed teacher model and serves it on the EDL1 wire,
+registered in the coordination store for discovery — the deployment
+shape of the reference's Paddle Serving teacher pods
+(example/distill/k8s/teacher.yaml).  Thin wrapper over
+train_image_distill's serve role so model/checkpoint flags stay in one
+place::
+
+    python serve_teacher.py --coord_endpoints coord:2379 \
+        --service resnext101_teacher --teacher_dir /ckpt/teacher \
+        --teacher_model resnet50 --width 64 --image_size 224
+"""
+
+from train_image_distill import main  # noqa: F401 — shared arg surface
+import sys
+
+if __name__ == "__main__":
+    sys.argv[1:1] = ["--role", "serve"]
+    main()

@@ -1,0 +1,56 @@
+"""Two-process ElasticTrainer.evaluate() with UNEVEN per-host batch
+counts — would hang in an unmatched collective before the per-batch
+has-next agreement (round-2 verdict weak #4).
+
+Usage: eval_uneven.py <rank> <coordinator_port>
+Prints ``EVAL_RESULT <json>`` on success; both ranks must agree.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                               process_id=rank)
+    assert jax.process_count() == 2
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.parallel import MeshSpec
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    def loss_fn(params, extra, batch, rng):
+        return (params["w"] * batch["x"]).mean(), (extra, {})
+
+    trainer = ElasticTrainer(loss_fn, TrainConfig(mesh_spec=MeshSpec()))
+    state = trainer.create_state(lambda: ({"w": jnp.ones(())}, None),
+                                 optax.sgd(0.1))
+
+    def metric_fn(params, extra, batch):
+        return {"mean_x": batch["x"][:, 0]}
+
+    n_batches = 3 if rank == 0 else 1  # deliberately uneven
+
+    def batches():
+        for b in range(n_batches):
+            x = np.asarray([[rank * 100 + b * 10 + i] for i in range(4)],
+                           np.float32)
+            yield {"x": x}
+
+    result = trainer.evaluate(state, batches(), metric_fn)
+    print("EVAL_RESULT", json.dumps({k: round(v, 4)
+                                     for k, v in result.items()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,13 @@ def test_elastic_join_resumes_training(coord_server, tmp_path):
 
     client = CoordClient(ep)
     assert load_job_status(client, "train-e2e") == Status.SUCCEED
+    # the resize left a full recovery-time record (the north-star
+    # metric): launcher phases + trainer restore/first-step merged
+    from edl_tpu.cluster.recovery import summarize_recovery
+    stages = summarize_recovery(client, "train-e2e")
+    assert stages and "total" in stages[-1], stages
+    assert 0 < stages[-1]["total"] < 300, stages
+    print("recovery breakdown:", stages[-1])
     client.close()
 
     marker_a = (tmp_path / "marker-a").read_text()

