@@ -47,6 +47,7 @@ class DistillReader:
         self._mode = "sample_list"
         self._fixed: list[str] = []
         self._discovery: tuple | None = None
+        self._servers_fn_override: Callable[[], list[str]] | None = None
         self._max_teachers = int(os.environ.get("EDL_TPU_DISTILL_MAX_TEACHER", 8))
         self._pool_kw: dict = {}
         self._apply_env()
@@ -75,6 +76,13 @@ class DistillReader:
         self._discovery = (discovery_endpoints, service)
         self._max_teachers = max_teachers
         self._fixed = []
+        return self
+
+    def set_servers_fn(self, fn: Callable[[], list[str]]) -> "DistillReader":
+        """Plug a custom discovery backend: any callable returning the
+        current teacher endpoints (e.g. LiteDiscoveryClient.servers).
+        An optional ``fn.close`` is called when iteration ends."""
+        self._servers_fn_override = fn
         return self
 
     # -- input config --------------------------------------------------------
@@ -120,6 +128,8 @@ class DistillReader:
                            max_teachers=self._max_teachers, **self._pool_kw)
 
     def _build_servers_fn(self):
+        if self._servers_fn_override is not None:
+            return self._servers_fn_override
         if self._discovery is not None:
             from edl_tpu.distill.discovery import DiscoveryClient
             endpoints, service = self._discovery

@@ -209,6 +209,17 @@ class TeacherServer:
             self._register.stop()
         self._queue.put(None)
         self._worker.join(timeout=5.0)
+        # requests enqueued behind the sentinel (the RPC server accepts
+        # until _rpc.stop below) must not strand their handler threads
+        # on done.wait() forever
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = RuntimeError("teacher server stopped")
+                req.done.set()
         self._rpc.stop()
 
 

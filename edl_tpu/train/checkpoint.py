@@ -99,10 +99,16 @@ class CheckpointManager:
         d = self._mngr.directory / str(step) / "meta"
         if not d.exists():
             return False
+        body = json.dumps(meta.to_dict())
+        if "://" in str(d):
+            # object store (gs://...): a single-object write is atomic;
+            # there is no cross-object rename to lean on
+            (d / "metadata").write_text(body)
+            return True
         path = os.path.join(str(d), "metadata")
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(meta.to_dict(), f)
+            f.write(body)
         os.replace(tmp, path)
         return True
 

@@ -1,0 +1,245 @@
+"""NLP service distillation: transformer teacher → BOW/CNN student.
+
+Reference: example/distill/nlp/* (distill.py:208 KL-with-temperature,
+model.py BOW/CNN students, fine_tune.py BERT teacher on ChnSentiCorp).
+Here the teacher is a compact :class:`TextTransformer` served by the
+TPU ``TeacherServer``; students are the BOW / CNN classifiers from
+``edl_tpu.models.text``; the loss is the same temperature-KL.
+
+The toy corpus is class-conditional token distributions with masked
+padding; the student's labels carry asymmetric noise (the wrong class
+is the plurality past 50%), so only the teacher's soft labels recover
+the true mapping — the distilled student must beat the baseline.
+
+    python train_nlp_distill.py --role local          # CI smoke
+    python train_nlp_distill.py --role local --student cnn
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", default="local", choices=["local", "serve"])
+    p.add_argument("--student", default="bow", choices=["bow", "cnn"])
+    p.add_argument("--coord_endpoints", default="")
+    p.add_argument("--service", default="nlp-teacher")
+    p.add_argument("--vocab", type=int, default=200)
+    p.add_argument("--seq_len", type=int, default=32)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--train_n", type=int, default=480)
+    p.add_argument("--test_n", type=int, default=240)
+    p.add_argument("--label_noise", type=float, default=0.65)
+    p.add_argument("--teacher_epochs", type=int, default=10)
+    p.add_argument("--student_epochs", type=int, default=8)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--teacher_batch_size", type=int, default=16)
+    p.add_argument("--alpha", type=float, default=0.05,
+                   help="hard-label weight; 1-alpha goes to the teacher KL")
+    p.add_argument("--temperature", type=float, default=2.0)
+    p.add_argument("--out", default="")
+    return p.parse_args(argv)
+
+
+# -- synthetic corpus ---------------------------------------------------------
+def make_corpus(args, n, seed, label_noise=0.0):
+    """Each class draws 40% of its tokens from a class-specific vocab
+    band; the rest is shared noise.  Variable lengths exercise the mask."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    band = args.vocab // (args.classes + 1)
+    ids = np.zeros((n, args.seq_len), np.int32)
+    mask = np.zeros((n, args.seq_len), np.float32)
+    y = rng.integers(0, args.classes, n).astype(np.int32)
+    for i, c in enumerate(y):
+        length = int(rng.integers(args.seq_len // 2, args.seq_len + 1))
+        cls_band = rng.integers(band * (c + 1), band * (c + 2), length)
+        noise = rng.integers(0, band, length)
+        pick = rng.random(length) < 0.4
+        ids[i, :length] = np.where(pick, cls_band, noise)
+        mask[i, :length] = 1.0
+    y_noisy = y.copy()
+    if label_noise > 0:
+        flip = rng.random(n) < label_noise
+        y_noisy[flip] = (y_noisy[flip] + 1) % args.classes  # asymmetric
+    return ids, mask, y, y_noisy
+
+
+def batches(ids, mask, y, bs, seed):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(ids))
+    for i in range(0, len(ids) - bs + 1, bs):
+        idx = order[i:i + bs]
+        yield {"ids": ids[idx], "mask": mask[idx], "label": y[idx]}
+
+
+# -- models -------------------------------------------------------------------
+def make_teacher(args):
+    import jax.numpy as jnp
+
+    from edl_tpu.models.text import TextTransformer
+    return TextTransformer(vocab_size=args.vocab, num_layers=2, embed_dim=64,
+                           num_heads=4, mlp_dim=128, max_len=args.seq_len,
+                           num_classes=args.classes, dtype=jnp.float32)
+
+
+def make_student(args):
+    import jax.numpy as jnp
+
+    from edl_tpu.models.text import BowClassifier, CnnClassifier
+    cls = BowClassifier if args.student == "bow" else CnnClassifier
+    return cls(vocab_size=args.vocab, embed_dim=64,
+               num_classes=args.classes, dtype=jnp.float32)
+
+
+def fit(model, args, data_fn, epochs, loss_fn, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.cluster.state import State
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    tr = ElasticTrainer(loss_fn, TrainConfig(log_every=0))
+
+    def init():
+        ids0 = jnp.zeros((1, args.seq_len), jnp.int32)
+        m0 = jnp.ones((1, args.seq_len), jnp.float32)
+        return model.init(jax.random.key(seed), ids0, m0)["params"], None
+
+    state = tr.create_state(init, optax.adam(2e-3))
+    state, _ = tr.fit(state, State(), data_fn, epochs=epochs)
+    return state
+
+
+def accuracy(model, params, ids, mask, y, bs=64):
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def fwd(p, i, m):
+        return model.apply({"params": p}, i, m, train=False).argmax(-1)
+
+    hits = sum(int((np.asarray(fwd(params, ids[i:i + bs], mask[i:i + bs]))
+                    == y[i:i + bs]).sum()) for i in range(0, len(ids), bs))
+    return hits / len(ids)
+
+
+# -- distillation -------------------------------------------------------------
+def make_distill_source(args, ids, mask, y_noisy, discovery):
+    import numpy as np
+
+    from edl_tpu.distill.reader import DistillReader
+
+    def build(epoch):
+        dr = DistillReader(ins=["ids", "mask", "label"], predicts=["logits"],
+                           feeds=["ids", "mask"],
+                           teacher_batch_size=args.teacher_batch_size)
+        dr.set_dynamic_teacher(discovery, args.service)
+
+        def gen():
+            for b in batches(ids, mask, y_noisy, args.batch_size, 100 + epoch):
+                yield b["ids"], b["mask"], b["label"]
+        dr.set_batch_generator(gen)
+        for bids, bmask, blabel, blogits in dr:
+            yield {"ids": np.asarray(bids), "mask": np.asarray(bmask),
+                   "label": np.asarray(blabel),
+                   "teacher_logits": np.asarray(blogits)}
+    return build
+
+
+def student_loss(model, args):
+    import optax
+
+    from edl_tpu.models.text import kl_distill_loss
+
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply({"params": params}, batch["ids"], batch["mask"])
+        hard = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        if "teacher_logits" in batch:
+            soft = kl_distill_loss(logits, batch["teacher_logits"],
+                                   args.temperature)
+            loss = args.alpha * hard + (1 - args.alpha) * soft
+        else:
+            loss = hard
+        return loss, (extra, {})
+    return loss_fn
+
+
+# -- roles --------------------------------------------------------------------
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+
+    import optax
+
+    ids_t, mask_t, y_t, _ = make_corpus(args, args.train_n, seed=0)
+    ids_s, mask_s, y_s, y_s_noisy = make_corpus(args, args.train_n, seed=1,
+                                                label_noise=args.label_noise)
+    ids_e, mask_e, y_e, _ = make_corpus(args, args.test_n, seed=2)
+
+    teacher = make_teacher(args)
+
+    def teacher_loss(params, extra, batch, rng):
+        logits = teacher.apply({"params": params}, batch["ids"],
+                               batch["mask"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean(), (extra, {})
+
+    tstate = fit(teacher, args,
+                 lambda e: batches(ids_t, mask_t, y_t, args.batch_size, e),
+                 args.teacher_epochs, teacher_loss)
+    teacher_acc = accuracy(teacher, tstate.params, ids_e, mask_e, y_e)
+
+    from edl_tpu.coord.client import connect
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.distill.discovery import DiscoveryServer
+    from edl_tpu.distill.teacher import TeacherServer, jit_teacher
+
+    store = (connect(args.coord_endpoints) if args.coord_endpoints
+             else MemoryKV(sweep_period=0.2))
+    predict = jit_teacher(teacher.apply, {"params": tstate.params},
+                          fetch_name="logits", train=False)
+    server = TeacherServer(predict).register(store, args.service)
+    if args.role == "serve":  # pragma: no cover - CLI path
+        threading.Event().wait()
+
+    disc = DiscoveryServer(store, host="127.0.0.1")
+    student = make_student(args)
+    loss_fn = student_loss(student, args)
+    try:
+        src = make_distill_source(args, ids_s, mask_s, y_s_noisy,
+                                  disc.endpoint)
+        dstate = fit(student, args, src, args.student_epochs, loss_fn, seed=1)
+        distill_acc = accuracy(student, dstate.params, ids_e, mask_e, y_e)
+        bstate = fit(student, args,
+                     lambda e: batches(ids_s, mask_s, y_s_noisy,
+                                       args.batch_size, 100 + e),
+                     args.student_epochs, loss_fn, seed=1)
+        baseline_acc = accuracy(student, bstate.params, ids_e, mask_e, y_e)
+        stats = server.stats()
+    finally:
+        server.stop()
+        disc.stop()
+    summary = {"student": args.student,
+               "teacher_acc": round(teacher_acc, 4),
+               "distill_acc": round(distill_acc, 4),
+               "baseline_acc": round(baseline_acc, 4),
+               "gain": round(distill_acc - baseline_acc, 4),
+               "teacher_rows": stats["rows"],
+               "teacher_rows_per_s": stats["rows_per_s"]}
+    print(f"[nlp-distill] {json.dumps(summary)}", flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Shared bits for multi-process launcher tests."""
+
+from __future__ import annotations
+
+import signal
+
+import psutil
+
+
+def kill_tree(proc) -> None:
+    """SIGKILL a subprocess and its whole child tree (launcher + trainer
+    + data servers) — the hard-failure injection used by the elastic
+    e2e tests."""
+    try:
+        parent = psutil.Process(proc.pid)
+        victims = parent.children(recursive=True) + [parent]
+    except psutil.NoSuchProcess:
+        return
+    for p in victims:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except psutil.NoSuchProcess:
+            pass

@@ -13,16 +13,15 @@ reference's WIP data server never achieved.
 import json
 import os
 import re
-import signal
 import subprocess
 import sys
 import time
 
-import psutil
 import pytest
 
 from edl_tpu.cluster.status import Status, load_job_status
 from edl_tpu.coord.client import CoordClient
+from tests.helpers.harness import kill_tree
 from tests.test_launch_integration import FAST, finish
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -64,19 +63,6 @@ def spawn(job_id, coord_ep, tmp, name, ckpt_dir, data_dir, epochs="3"):
         env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
     proc._logfile = log  # noqa: SLF001
     return proc
-
-
-def kill_tree(proc) -> None:
-    try:
-        parent = psutil.Process(proc.pid)
-        victims = parent.children(recursive=True) + [parent]
-    except psutil.NoSuchProcess:
-        return
-    for p in victims:
-        try:
-            p.send_signal(signal.SIGKILL)
-        except psutil.NoSuchProcess:
-            pass
 
 
 def wait_for_log(path, pattern, timeout):

@@ -1,0 +1,95 @@
+"""Lite (redis-variant) discovery: the zero-framework JSON wire over a
+select() loop (reference python/edl/distill/redis/*), sharing the RPC
+discovery's greedy rebalance — proof the discovery plane is pluggable.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.coord.register import Register
+from edl_tpu.distill.balance import server_key
+from edl_tpu.distill.lite_discovery import LiteBalanceServer, LiteDiscoveryClient
+
+
+def register_teacher(memkv, service, endpoint, ttl=1.0):
+    return Register(memkv, server_key(service, endpoint), endpoint.encode(),
+                    ttl=ttl)
+
+
+def wait_for(fn, timeout=10.0, period=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(period)
+    raise AssertionError("condition never became true")
+
+
+def test_register_heartbeat_rebalance(memkv):
+    regs = [register_teacher(memkv, "svc", f"10.0.0.{i}:90") for i in (1, 2)]
+    server = LiteBalanceServer(memkv, host="127.0.0.1", poll_period=0.2)
+    clients = []
+    try:
+        clients = [LiteDiscoveryClient(server.endpoint, "svc",
+                                       require_num=1, period=0.1).start()
+                   for _ in range(2)]
+        # both students get a teacher, balanced across the two
+        wait_for(lambda: all(c.servers() for c in clients))
+        assigned = [c.servers() for c in clients]
+        assert all(len(a) == 1 for a in assigned), assigned
+        assert {a[0] for a in assigned} == {"10.0.0.1:90", "10.0.0.2:90"}
+
+        # teacher death (lease expiry) -> reassignment via heartbeats
+        dead = assigned[0][0]
+        regs[0 if dead.endswith(".1:90") else 1].stop()
+        wait_for(lambda: all(c.servers() == ["10.0.0.2:90" if dead.endswith(".1:90")
+                                             else "10.0.0.1:90"]
+                             for c in clients))
+
+        # a new teacher joining raises versions and spreads again
+        regs.append(register_teacher(memkv, "svc", "10.0.0.3:90"))
+        wait_for(lambda: {c.servers()[0] for c in clients if c.servers()}
+                 and len({c.servers()[0] for c in clients}) == 2)
+    finally:
+        for c in clients:
+            c.stop()
+        server.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_distill_reader_over_lite_discovery(memkv):
+    """End-to-end: DistillReader streams through the lite wire (custom
+    servers_fn) with the nop teacher backend."""
+    from edl_tpu.distill import reader as reader_mod
+
+    reg = register_teacher(memkv, "lite-svc", "127.0.0.1:1")
+    server = LiteBalanceServer(memkv, host="127.0.0.1", poll_period=0.2)
+    client = LiteDiscoveryClient(server.endpoint, "lite-svc",
+                                 require_num=2, period=0.1).start()
+    old = reader_mod._NOP_PREDICT_TEST
+    reader_mod._NOP_PREDICT_TEST = True
+    try:
+        wait_for(lambda: client.servers())
+        dr = reader_mod.DistillReader(ins=["x", "y"], predicts=["p"],
+                                      feeds=["x"], teacher_batch_size=4)
+        def fn():
+            return client.servers()
+        fn.close = client.stop  # type: ignore[attr-defined]
+        dr.set_servers_fn(fn)
+
+        def gen():
+            for i in range(6):
+                yield np.full((8, 2), i, np.float32), np.arange(8, dtype=np.int32)
+        dr.set_batch_generator(gen)
+        got = list(dr)
+        assert len(got) == 6  # original batch shapes reassembled
+        for x, y, p in got:
+            assert len(x) == len(y) == len(p) == 8
+    finally:
+        reader_mod._NOP_PREDICT_TEST = old
+        server.stop()
+        reg.stop()

@@ -1,0 +1,30 @@
+"""NLP distillation (reference example/distill/nlp/*): transformer
+teacher served over the wire → BOW/CNN student with KL-temperature
+loss; the distilled student must beat the asymmetric-noise baseline."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE_DIR = os.path.join(REPO, "examples", "distill")
+
+
+def run(student):
+    sys.path.insert(0, EXAMPLE_DIR)
+    try:
+        from train_nlp_distill import main
+    finally:
+        sys.path.pop(0)
+    return main(["--role", "local", "--student", student])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("student", ["bow", "cnn"])
+def test_nlp_distill_beats_noisy_baseline(student):
+    summary = run(student)
+    assert summary["teacher_acc"] >= 0.9, summary
+    assert summary["distill_acc"] >= 0.8, summary
+    assert summary["gain"] >= 0.2, summary
+    assert summary["teacher_rows"] > 0 and summary["teacher_rows_per_s"] > 0
