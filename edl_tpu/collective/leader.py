@@ -17,7 +17,7 @@ from edl_tpu.collective.resource import load_resource_pods
 from edl_tpu.coord.kv import KVStore
 from edl_tpu.coord.register import Register
 from edl_tpu.utils import constants
-from edl_tpu.utils.exceptions import EdlRegisterError, EdlTableError
+from edl_tpu.utils.exceptions import EdlRegisterError, EdlRetryableError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -79,6 +79,12 @@ class LeaderElector(threading.Thread):
                         self._on_become()
                 except EdlRegisterError:
                     pass  # someone else holds the seat; retry
+                except EdlRetryableError as e:
+                    # transient store hiccup during a seize attempt must
+                    # not kill the pod (the resource register survives
+                    # dozens of these); just retry next period
+                    logger.warning("leader seize attempt failed "
+                                   "(transient): %s", e)
                 except Exception as e:  # noqa: BLE001
                     self._failed = e
                     self._halt.set()

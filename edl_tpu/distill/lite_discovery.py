@@ -142,7 +142,14 @@ class LiteBalanceServer:
         conn.buf += chunk
         try:
             for msg in conn.frames():
-                conn.sock.sendall(pack(self._handle(conn, msg)))
+                try:
+                    resp = self._handle(conn, msg)
+                except Exception as e:  # noqa: BLE001 — bad payload must
+                    # never kill the single select loop for everyone
+                    logger.warning("lite request failed: %s", e)
+                    resp = {"code": "BAD_REQUEST", "version": -1,
+                            "servers": None}
+                conn.sock.sendall(pack(resp))
         except (ConnectionError, OSError, json.JSONDecodeError) as e:
             logger.warning("lite conn dropped: %s", e)
             self._drop(conn)

@@ -65,6 +65,7 @@ class TeacherServer:
         self._buckets = tuple(sorted(buckets))
         self._wait = coalesce_wait_ms / 1000.0
         self._queue: queue.Queue[_Request | None] = queue.Queue()
+        self._stopping = False
         self._stats_lock = threading.Lock()
         self._rows = 0
         self._forwards = 0
@@ -94,6 +95,8 @@ class TeacherServer:
 
     # -- RPC side ------------------------------------------------------------
     def _predict(self, feed: dict, fetch: list[str]) -> dict:
+        if self._stopping:
+            raise RuntimeError("teacher server stopping")
         arrays = {k: decode_array(v) for k, v in feed.items()}
         req = _Request(arrays, list(fetch), len(next(iter(arrays.values()))))
         self._queue.put(req)
@@ -207,11 +210,12 @@ class TeacherServer:
     def stop(self) -> None:
         if self._register is not None:
             self._register.stop()
+        # refuse new enqueues FIRST (handlers see _stopping and error out
+        # instead of racing a request in behind the drain), then stop the
+        # worker and release anything already queued
+        self._stopping = True
         self._queue.put(None)
         self._worker.join(timeout=5.0)
-        # requests enqueued behind the sentinel (the RPC server accepts
-        # until _rpc.stop below) must not strand their handler threads
-        # on done.wait() forever
         while True:
             try:
                 req = self._queue.get_nowait()

@@ -29,8 +29,11 @@ logger = get_logger(__name__)
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = True, save_interval_steps: int = 0):
-        self._dir = os.path.abspath(directory)
-        os.makedirs(self._dir, exist_ok=True)
+        if "://" in directory:  # object store (gs://...): Orbax/epath I/O
+            self._dir = directory
+        else:
+            self._dir = os.path.abspath(directory)
+            os.makedirs(self._dir, exist_ok=True)
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,

@@ -80,6 +80,20 @@ def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
     return tenv
 
 
+def connect_store(tenv: TrainerEnv):
+    """Coordination-store client for a trainer, or None when running
+    standalone (no launcher env / store unreachable) — the common
+    trainer-side boilerplate shared by the examples."""
+    if not (tenv.coord_endpoints and tenv.pod_id):
+        return None
+    try:
+        from edl_tpu.coord.client import connect
+        return connect(tenv.coord_endpoints)
+    except Exception:  # noqa: BLE001 — standalone / store gone
+        logger.warning("coordination store unreachable; running standalone")
+        return None
+
+
 def shutdown() -> None:
     global _initialized
     if _initialized:

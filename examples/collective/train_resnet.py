@@ -152,22 +152,16 @@ def main() -> None:
     import optax
 
     from edl_tpu.cluster.env import TrainerEnv
-    from edl_tpu.coord.client import connect
     from edl_tpu.data import images
     from edl_tpu.models import resnet as resnet_mod
     from edl_tpu.parallel import MeshSpec
     from edl_tpu.train import (
         ElasticTrainer, TrainConfig, cosine_warmup, scale_lr_for_batch,
     )
-    from edl_tpu.train.distributed import initialize_from_env
+    from edl_tpu.train.distributed import connect_store, initialize_from_env
 
     tenv = initialize_from_env(TrainerEnv())
-    store = None
-    if tenv.coord_endpoints and tenv.pod_id:
-        try:
-            store = connect(tenv.coord_endpoints)
-        except Exception:  # noqa: BLE001 — standalone run
-            store = None
+    store = connect_store(tenv)
 
     world = max(1, tenv.world_size)
     rank = tenv.global_rank

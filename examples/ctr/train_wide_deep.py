@@ -81,20 +81,14 @@ def main() -> None:
     import optax
 
     from edl_tpu.cluster.env import TrainerEnv
-    from edl_tpu.coord.client import connect
     from edl_tpu.models.logical import logical_axes_from_paths
     from edl_tpu.models.wide_deep import LOGICAL_RULES, WideDeep
     from edl_tpu.parallel import MeshSpec
     from edl_tpu.train import ElasticTrainer, TrainConfig
-    from edl_tpu.train.distributed import initialize_from_env
+    from edl_tpu.train.distributed import connect_store, initialize_from_env
 
     tenv = initialize_from_env(TrainerEnv())
-    store = None
-    if tenv.coord_endpoints and tenv.pod_id:
-        try:
-            store = connect(tenv.coord_endpoints)
-        except Exception:  # noqa: BLE001 — standalone run
-            store = None
+    store = connect_store(tenv)
     world, rank = max(1, tenv.world_size), tenv.global_rank
 
     model = WideDeep(vocab_sizes=[args.vocab] * args.slots,

@@ -1,0 +1,47 @@
+"""Flagship LM pretraining example: dp x sp x tp sharded training (and
+the ring-attention long-context variant) on the virtual 8-device mesh —
+the beyond-parity parallelism capability as a real workload, not just
+the dryrun."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "examples", "lm", "train_lm.py")
+
+
+def run_lm(tmp_path, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["EDL_TPU_DEMO_MARKER"] = str(tmp_path / "marker")
+    out = subprocess.run([sys.executable, TRAIN, *args], env=env,
+                         cwd=str(tmp_path), capture_output=True, text=True,
+                         timeout=timeout)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads([l for l in (tmp_path / "marker").read_text().splitlines()
+                      if l.startswith("done ")][-1][5:])
+    return rec, out.stdout
+
+
+@pytest.mark.slow
+def test_lm_learns_on_dp_sp_tp_mesh(tmp_path):
+    rec, _ = run_lm(tmp_path, "--epochs", "3", "--steps_per_epoch", "15",
+                    "--tp", "2", "--sp", "2")
+    assert rec["mesh"]["tp"] == 2 and rec["mesh"]["sp"] == 2, rec
+    # sequence structure learned: well under the unigram entropy
+    assert rec["val_nll"] < rec["unigram_nll"] - 0.9, rec
+    # and monotone-ish improvement
+    assert rec["nll_curve"][-1] < rec["nll_curve"][0], rec
+
+
+@pytest.mark.slow
+def test_lm_ring_attention_long_context(tmp_path):
+    rec, _ = run_lm(tmp_path, "--epochs", "2", "--steps_per_epoch", "10",
+                    "--tp", "1", "--sp", "4", "--attention", "ring")
+    assert rec["mesh"]["sp"] == 4, rec
+    assert rec["val_nll"] < rec["unigram_nll"], rec
