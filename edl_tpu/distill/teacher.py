@@ -66,6 +66,8 @@ class TeacherServer:
         self._wait = coalesce_wait_ms / 1000.0
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stopping = False
+        # makes check-stopping + enqueue atomic vs stop()'s drain
+        self._enqueue_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._rows = 0
         self._forwards = 0
@@ -95,11 +97,14 @@ class TeacherServer:
 
     # -- RPC side ------------------------------------------------------------
     def _predict(self, feed: dict, fetch: list[str]) -> dict:
-        if self._stopping:
-            raise RuntimeError("teacher server stopping")
         arrays = {k: decode_array(v) for k, v in feed.items()}
         req = _Request(arrays, list(fetch), len(next(iter(arrays.values()))))
-        self._queue.put(req)
+        with self._enqueue_lock:
+            # atomic with stop(): once _stopping is set under this lock,
+            # no request can slip in behind the queue drain
+            if self._stopping:
+                raise RuntimeError("teacher server stopping")
+            self._queue.put(req)
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -210,10 +215,11 @@ class TeacherServer:
     def stop(self) -> None:
         if self._register is not None:
             self._register.stop()
-        # refuse new enqueues FIRST (handlers see _stopping and error out
-        # instead of racing a request in behind the drain), then stop the
-        # worker and release anything already queued
-        self._stopping = True
+        # refuse new enqueues FIRST (the lock makes check+put atomic, so
+        # nothing can race in behind the drain), then stop the worker and
+        # release anything already queued
+        with self._enqueue_lock:
+            self._stopping = True
         self._queue.put(None)
         self._worker.join(timeout=5.0)
         while True:

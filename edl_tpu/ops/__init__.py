@@ -8,6 +8,8 @@ ring collectives for long-context attention over the ``sp`` mesh axis.
 """
 
 from edl_tpu.ops.attention import dense_attention, dot_product_attention
+from edl_tpu.ops.pipeline import pipeline_apply
 from edl_tpu.ops.ring import ring_attention
 
-__all__ = ["dense_attention", "dot_product_attention", "ring_attention"]
+__all__ = ["dense_attention", "dot_product_attention", "pipeline_apply",
+           "ring_attention"]

@@ -1,0 +1,96 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference had no pipelining at all (SURVEY.md §5: DP only); this
+is part of the beyond-parity parallelism set (§7 step 7).  Design is
+the TPU-native GPipe: stage parameters live on their pp shard (leading
+``stage`` dim sharded over ``pp``), activations rotate between
+neighbouring stages with ``lax.ppermute`` over ICI, and the schedule is
+a statically-unrolled loop of ``M + S - 1`` ticks inside one
+``shard_map`` — jax.grad differentiates straight through (ppermute's
+transpose is the reverse rotation), so the backward schedule falls out
+of AD instead of hand-written send/recv pairs.
+
+The bubble is the classic GPipe (S-1)/(M+S-1); raise
+``n_microbatches`` to amortise.  Collectives ride the ``pp`` axis only,
+so this composes with data parallelism on the same mesh (batch axes
+sharded as usual outside the shard_map).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
+                   n_microbatches: int, axis: str = "pp",
+                   batch_axes: tuple[str, ...] = ("dp", "fsdp")):
+    """Run ``x`` through ``S`` pipelined stages.
+
+    - ``stage_fn(params_s, h) -> h``: one stage's computation; must
+      preserve the activation shape (classic equal-width pipeline).
+    - ``stage_params``: pytree whose leaves have a leading ``S`` dim,
+      sharded over ``axis`` (use logical axis "stage").
+    - ``x``: [B, ...] activations; B must divide by
+      ``n_microbatches * (product of live batch axes)``.
+
+    Returns [B, ...] outputs, batch-sharded like ``x``.
+    """
+    S = mesh.shape[axis]
+    M = n_microbatches
+    if S == 1:  # no pipeline axis: just run the stages sequentially
+        out, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None),
+                              x, stage_params)
+        return out
+
+    live_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    bspec = P(live_batch if live_batch else None)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_device(params_local, x_local):
+        # params_local: this shard's stage slice — leading dim
+        # n_layers/S; multiple layers per shard chain sequentially
+        # (a "superstage"), so any layer count pipelines over any S
+        n_local = len(jax.tree.leaves(params_local)[0])
+
+        def superstage(h):
+            for j in range(n_local):
+                h = stage_fn(jax.tree.map(lambda a: a[j], params_local), h)
+            return h
+
+        B = x_local.shape[0]
+        assert B % M == 0, \
+            f"local batch {B} not divisible by {M} microbatches"
+        mbs = x_local.reshape((M, B // M) + x_local.shape[1:])
+        stage_idx = jax.lax.axis_index(axis)
+        carry = jnp.zeros_like(mbs[0])      # activation arriving from prev
+        outs = jnp.zeros_like(mbs)          # filled on the LAST stage
+        for t in range(M + S - 1):
+            # stage 0 injects microbatch t; later stages consume the wire
+            inject = mbs[min(t, M - 1)]
+            h_in = jnp.where(stage_idx == 0, inject, carry)
+            h_out = superstage(h_in)
+            # last stage emits microbatch t-(S-1) at tick t
+            m = t - (S - 1)
+            if 0 <= m < M:
+                is_last = stage_idx == S - 1
+                outs = outs.at[m].set(jnp.where(is_last, h_out, outs[m]))
+            carry = jax.lax.ppermute(h_out, axis, perm)
+        # only the last stage holds real outputs; broadcast them to all
+        # pp shards so the result is replicated over pp (psum of
+        # one-hot-by-stage contributions)
+        outs = jnp.where(jax.lax.axis_index(axis) == S - 1, outs,
+                         jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, axis)
+        return outs.reshape((B,) + x_local.shape[1:])
+
+    from jax import shard_map  # public API (jax >= 0.6, per pyproject)
+    return shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis), bspec),
+        out_specs=bspec,
+        check_vma=False,
+    )(stage_params, x)

@@ -144,21 +144,22 @@ def main() -> None:
 
     losses = []
 
-    def on_epoch_end(epoch, st, meta_):
-        # eval loss on held-out chains from the same process
-        gen = markov_corpus(args, 999_000 + epoch)
-        val = trainer.evaluate(
-            st, (next(gen) for _ in range(4)),
-            lambda p, e, b: {"nll": _token_nll(model, p, b)})
-        losses.append(round(val["nll"], 4))
-        print(f"[train_lm] epoch {epoch}: val_nll={val['nll']:.4f}", flush=True)
-
-    def _token_nll(model_, p, b):
-        logits = model_.apply({"params": p}, b["ids"][:, :-1])
+    def metric_fn(p, e, b):
+        # ONE stable function object: make_eval_step caches the jitted
+        # eval graph by metric-fn identity — a fresh lambda per epoch
+        # would recompile every time
+        logits = model.apply({"params": p}, b["ids"][:, :-1])
         ll = jax.nn.log_softmax(logits.astype(jnp.float32))
         tgt = b["ids"][:, 1:]
         tok = jnp.take_along_axis(ll, tgt[..., None], -1)[..., 0]
-        return -tok.mean(axis=-1)  # per-example mean token NLL
+        return {"nll": -tok.mean(axis=-1)}  # per-example mean token NLL
+
+    def on_epoch_end(epoch, st, meta_):
+        # eval loss on held-out chains from the same process
+        gen = markov_corpus(args, 999_000 + epoch)
+        val = trainer.evaluate(st, (next(gen) for _ in range(4)), metric_fn)
+        losses.append(round(val["nll"], 4))
+        print(f"[train_lm] epoch {epoch}: val_nll={val['nll']:.4f}", flush=True)
 
     state, meta = trainer.fit(state, meta, data_fn, epochs=args.epochs,
                               on_epoch_end=on_epoch_end)
