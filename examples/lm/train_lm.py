@@ -39,6 +39,10 @@ def parse_args():
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--tp", type=int, default=0, help="0 = auto (2 if even)")
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help=">1 pipelines the decoder blocks over the pp mesh "
+                        "axis (GPipe; forces tp=sp=1 in this example)")
+    p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "flash", "ring"])
     p.add_argument("--remat", action="store_true")
@@ -70,6 +74,80 @@ def markov_corpus(args, seed):
         yield {"ids": batches(erng)}
 
 
+class _PipelinedLM:
+    """TransformerLM with its decoder blocks pipelined over the pp mesh
+    axis — same submodules (Embed / Block / RMSNorm / head), but the
+    stacked block params are fed through
+    :func:`edl_tpu.ops.pipeline.pipeline_apply` instead of ``nn.scan``,
+    so each pp shard holds and computes only its stage's layers.
+    Module-shaped adapter: ``init``/``apply`` like flax; ``mesh`` is
+    bound after the trainer builds it."""
+
+    def __init__(self, cfg, n_microbatches: int):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from edl_tpu.models.transformer import Block, RMSNorm
+
+        self.cfg = cfg
+        self.M = n_microbatches
+        self.mesh = None  # bound by main() once the trainer exists
+        self.embed = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                              param_dtype=jnp.float32, dtype=cfg.dtype)
+        block_cls = Block
+        if cfg.remat:  # same remat policy as TransformerLM's stack
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        self.block = block_cls(cfg)
+        self.norm = RMSNorm(cfg.dtype)
+        self.head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                             param_dtype=jnp.float32)
+
+    def init(self, key, ids, train: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jax.random.split(key, self.cfg.num_layers + 3)
+        pe = self.embed.init(ks[0], ids)["params"]
+        x = self.embed.apply({"params": pe}, ids)
+        pos = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        layers = [self.block.init(ks[1 + i], x, pos)["params"]
+                  for i in range(self.cfg.num_layers)]
+        stacked = jax.tree.map(lambda *a: jnp.stack(a), *layers)
+        return {"params": {"embed": pe, "layers": stacked,
+                           "norm": self.norm.init(ks[-2], x)["params"],
+                           "head": self.head.init(ks[-1], x)["params"]}}
+
+    def apply(self, variables, ids, train: bool = True):
+        import jax.numpy as jnp
+
+        from edl_tpu.ops.pipeline import pipeline_apply
+
+        p = variables["params"]
+        x = self.embed.apply({"params": p["embed"]}, ids)
+
+        def stage(pl, h):
+            pos = jnp.broadcast_to(jnp.arange(h.shape[1]), h.shape[:2])
+            out, _ = self.block.apply({"params": pl}, h, pos)
+            return out
+
+        x = pipeline_apply(stage, p["layers"], x, self.mesh,
+                           n_microbatches=self.M)
+        x = self.norm.apply({"params": p["norm"]}, x)
+        return self.head.apply({"params": p["head"]}, x).astype(jnp.float32)
+
+    def logical_axes(self, params_shape):
+        """Stage dim of the stacked layers on pp; everything else DP."""
+        import jax
+        repl = jax.tree.map(lambda l: (None,) * l.ndim, params_shape)
+        repl["layers"] = jax.tree.map(
+            lambda l: ("stage",) + (None,) * (l.ndim - 1),
+            params_shape["layers"])
+        return repl
+
+
 def main() -> None:
     args = parse_args()
 
@@ -93,9 +171,31 @@ def main() -> None:
     world, rank = max(1, tenv.world_size), tenv.global_rank
 
     n_dev = len(jax.devices())
-    tp = args.tp or (2 if n_dev % 2 == 0 else 1)
-    sp = args.sp
-    spec = MeshSpec(dp=-1, tp=tp, sp=sp)
+    if args.pp > 1:
+        tp = sp = 1  # this example pipelines pure-dp blocks
+        if args.attention == "ring":
+            raise SystemExit("--pp cannot combine with --attention ring "
+                             "(ring's shard_map cannot nest inside the "
+                             "pipeline's); use auto/dense/flash")
+        if args.layers % args.pp:
+            raise SystemExit(f"--layers {args.layers} must divide evenly "
+                             f"over --pp {args.pp} stages")
+        spec = MeshSpec(dp=-1, pp=args.pp)
+        # microbatches must divide the per-dp-shard local batch; clamp to
+        # the largest divisor <= requested so defaults never crash
+        dp_size = max(1, n_dev // args.pp)
+        local_batch = args.batch_size // dp_size or 1
+        m = min(args.pp_microbatches, local_batch)
+        while local_batch % m:
+            m -= 1
+        if m != args.pp_microbatches:
+            print(f"[train_lm] pp_microbatches clamped {args.pp_microbatches}"
+                  f" -> {m} (local batch {local_batch})", flush=True)
+        args.pp_microbatches = m
+    else:
+        tp = args.tp or (2 if n_dev % 2 == 0 else 1)
+        sp = args.sp
+        spec = MeshSpec(dp=-1, tp=tp, sp=sp)
 
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,
@@ -105,7 +205,8 @@ def main() -> None:
                             dtype=jnp.bfloat16 if
                             jax.devices()[0].platform == "tpu"
                             else jnp.float32)
-    model = TransformerLM(cfg)
+    model = (_PipelinedLM(cfg, args.pp_microbatches) if args.pp > 1
+             else TransformerLM(cfg))
 
     def loss_fn(params, extra, batch, rng):
         logits = model.apply({"params": params}, batch["ids"][:, :-1])
@@ -115,7 +216,9 @@ def main() -> None:
                          global_batch_size=args.batch_size * world,
                          log_every=0)
     trainer = ElasticTrainer(loss_fn, trconf, store=store, tenv=tenv)
-    if args.attention == "ring":
+    if args.pp > 1:
+        model.mesh = trainer.mesh
+    elif args.attention == "ring":
         import dataclasses
         cfg = dataclasses.replace(cfg, mesh=trainer.mesh)
         model = TransformerLM(cfg)
@@ -131,7 +234,8 @@ def main() -> None:
         return model.init(jax.random.key(0), ids0)["params"], None
 
     params_shape = jax.eval_shape(lambda: init()[0])
-    logical = logical_axes_from_paths(params_shape, tf_mod.LOGICAL_RULES)
+    logical = (model.logical_axes(params_shape) if args.pp > 1 else
+               logical_axes_from_paths(params_shape, tf_mod.LOGICAL_RULES))
     state, meta = trainer.restore_or_create(init, optax.adamw(args.lr),
                                             param_logical=logical)
     print(f"[train_lm] rank={rank}/{world} mesh={dict(trainer.mesh.shape)} "
