@@ -30,7 +30,11 @@ class TeacherClient:
     """One connection to one teacher server."""
 
     def __init__(self, endpoint: str, fetch: list[str],
-                 timeout: float = 30.0, retries: int = 3):
+                 timeout: float = 120.0, retries: int = 3):
+        # generous default: the teacher's FIRST forward per batch bucket
+        # is an XLA compile (tens of seconds on a loaded host); a short
+        # timeout here misreads compilation as death, the pool drops a
+        # healthy teacher, and a small fleet starves
         self.endpoint = endpoint
         self._fetch = list(fetch)
         self._retries = retries
