@@ -216,8 +216,15 @@ void* edl_shuffle_reader_open(const char** paths, int n_paths,
 int64_t edl_shuffle_reader_next(void* handle, uint8_t* out, uint64_t cap) {
   auto* s = static_cast<ShuffleReader*>(handle);
   std::unique_lock<std::mutex> l(s->mu);
+  // Wait for a FULL window (or producer exhaustion): sampling from a
+  // partially-filled window would make the shuffled order depend on how
+  // far the reader thread happened to race ahead — i.e. nondeterministic
+  // across runs despite the seed.  Full-or-done makes the window-size
+  // sequence (and so the sampled order) a pure function of (files, seed),
+  // matching the pure-Python ShuffleReader.
   s->cv_get.wait(l, [&] {
-    return !s->buffer.empty() || s->done.load() || !s->error.empty();
+    return s->buffer.size() >= s->buffer_cap || s->done.load() ||
+           !s->error.empty();
   });
   if (!s->error.empty()) return -2;
   if (s->buffer.empty()) return -1;
@@ -236,7 +243,8 @@ uint64_t edl_shuffle_reader_peek_len(void* handle) {
   auto* s = static_cast<ShuffleReader*>(handle);
   std::unique_lock<std::mutex> l(s->mu);
   s->cv_get.wait(l, [&] {
-    return !s->buffer.empty() || s->done.load() || !s->error.empty();
+    return s->buffer.size() >= s->buffer_cap || s->done.load() ||
+           !s->error.empty();
   });
   uint64_t mx = 0;
   for (auto& r : s->buffer) mx = r.size() > mx ? r.size() : mx;

@@ -2,9 +2,10 @@
 
 Replaces ``paddle_serving_client.Client.predict(feed, fetch)``
 (reference distill_worker.py:197-321) with the EDL1 wire.  Arrays cross
-as ``{"d": dtype, "s": shape, "b": bytes}``; ``predict`` retries 3
-times like the reference (:288-299) before the pool declares the
-teacher dead and requeues the task.
+as ``{"d": dtype, "s": shape, "b": bytes}``; ``predict`` retries
+(default 2 attempts, mirroring the reference's retry-then-requeue
+protocol, :288-299) before the pool declares the teacher dead and
+requeues the task.
 """
 
 from __future__ import annotations
@@ -30,14 +31,20 @@ class TeacherClient:
     """One connection to one teacher server."""
 
     def __init__(self, endpoint: str, fetch: list[str],
-                 timeout: float = 120.0, retries: int = 3):
-        # generous default: the teacher's FIRST forward per batch bucket
-        # is an XLA compile (tens of seconds on a loaded host); a short
-        # timeout here misreads compilation as death, the pool drops a
-        # healthy teacher, and a small fleet starves
+                 timeout: float = 45.0, first_timeout: float = 180.0,
+                 retries: int = 2):
+        # two-tier timeout: a teacher's first forwards are XLA compiles
+        # (tens of seconds on a loaded host) and it compiles once per
+        # batch-shape BUCKET, so the first few calls — full batches plus
+        # the ragged tail bucket — get ``first_timeout``.  After that,
+        # calls use the tighter ``timeout`` so a teacher that HANGS is
+        # declared dead in bounded time (timeout x transport-retry x
+        # retries), not compile-tolerance multiplied through every retry.
         self.endpoint = endpoint
         self._fetch = list(fetch)
         self._retries = retries
+        self._cold_calls = 4  # covers the common buckets' compiles
+        self._first_timeout = first_timeout
         self._rpc = RpcClient(endpoint, timeout)
 
     def predict(self, feed: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -45,7 +52,11 @@ class TeacherClient:
         last: Exception | None = None
         for attempt in range(self._retries):
             try:
-                r = self._rpc.call("predict", feed=wire, fetch=self._fetch)
+                r = self._rpc.call(
+                    "predict", feed=wire, fetch=self._fetch,
+                    _timeout=self._first_timeout if self._cold_calls > 0
+                    else None)
+                self._cold_calls -= 1
                 return {k: decode_array(v) for k, v in r["out"].items()}
             except Exception as e:  # noqa: BLE001
                 last = e
