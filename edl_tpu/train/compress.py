@@ -1,0 +1,91 @@
+"""Deep Gradient Compression (DGC) as an optax gradient transformation.
+
+Reference: the DGC knob of the collective ResNet50 recipe
+(example/collective/resnet50/train_with_fleet.py:98-111 —
+``DGCMomentumOptimizer(rampup_begin_step, ...)``; the algorithm is Lin
+et al. 2018).  On TPU the ICI fabric rarely needs gradient compression
+(SURVEY.md §7: "optional"), but the knob is part of the reference's
+strategy surface, so here it is TPU-natively: a per-leaf top-k sparsifier
+with local gradient accumulation (the unsent residual is carried, so
+small gradients still arrive eventually) and momentum correction,
+expressed as a composable ``optax.GradientTransformation`` —
+``optax.chain(dgc(...), optax.sgd(...))``.
+
+TPU-shape notes: k is static per leaf (XLA needs static shapes), the
+mask comes from ``jax.lax.top_k`` over |accumulated gradient|, and the
+dense masked gradient is returned (the allreduce stays dense — on ICI
+the win of DGC is the *accumulated-residual semantics* rather than
+wire-format sparsity, which would fight the compiler).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class DGCState(NamedTuple):
+    residual: optax.Updates   # unsent gradient accumulation
+    momentum: optax.Updates   # local momentum correction buffer
+    step: jnp.ndarray
+
+
+def dgc(sparsity: float = 0.99, momentum: float = 0.9,
+        rampup_steps: int = 0, min_size: int = 129) -> optax.GradientTransformation:
+    """Keep the top-``(1-sparsity)`` fraction of each leaf's entries per
+    step (by |value| of the momentum-corrected accumulation) and carry
+    the rest as residual.  Leaves smaller than ``min_size`` pass through
+    dense (biases, norms — same exemption the reference applied to
+    small params).  ``rampup_steps`` linearly anneals sparsity from 0,
+    the reference's ``rampup_begin_step`` intent."""
+
+    def init(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return DGCState(residual=zeros,
+                        momentum=jax.tree.map(jnp.zeros_like, params),
+                        step=jnp.zeros((), jnp.int32))
+
+    def update(updates, state, params=None):
+        del params
+        step = state.step + 1
+        if rampup_steps > 0:
+            frac = jnp.minimum(step / rampup_steps, 1.0)
+        else:
+            frac = jnp.ones(())
+        eff_sparsity = sparsity * frac  # anneal 0 -> sparsity
+
+        def one(g, res, mom):
+            if g.size < min_size:
+                # sparsification exemption only — momentum still applies
+                # (the reference's DGCMomentumOptimizer ran its regular
+                # momentum update for small params), so biases/norms get
+                # the same effective dynamics as kernels
+                vel = momentum * mom + g
+                return vel, jnp.zeros_like(g), vel
+            # momentum correction (Lin et al. §3.2): accumulate velocity,
+            # send the largest accumulated entries, keep the rest local
+            vel = momentum * mom + g
+            acc = res + vel
+            flat = jnp.abs(acc).reshape(-1)
+            # static k from the STATIC max sparsity; the rampup scales
+            # the threshold instead of k (XLA needs static shapes)
+            k = max(1, int(g.size * (1.0 - sparsity)))
+            kth = jax.lax.top_k(flat, k)[0][-1]
+            # during rampup send more: scale the threshold down
+            thr = kth * eff_sparsity / jnp.maximum(sparsity, 1e-9)
+            mask = (jnp.abs(acc) >= thr).astype(g.dtype)
+            send = acc * mask
+            return send, acc * (1 - mask), vel * (1 - mask)
+
+        out = jax.tree.map(one, updates, state.residual, state.momentum)
+        # structure-safe unzip: tree_transpose keys on the treedefs, so a
+        # params pytree that itself contains tuples cannot be confused
+        # with the per-leaf result triples
+        send, res, mom = jax.tree_util.tree_transpose(
+            jax.tree.structure(updates), jax.tree.structure((0, 0, 0)), out)
+        return send, DGCState(residual=res, momentum=mom, step=step)
+
+    return optax.GradientTransformation(init, update)

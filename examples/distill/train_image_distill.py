@@ -78,12 +78,20 @@ MODELS = {"resnet18": "ResNet18", "resnet18vd": "ResNet18vd",
 
 
 def make_model(name: str, args):
+    import jax
+    import jax.numpy as jnp
+
     from edl_tpu.models import resnet as resnet_mod
     cls_name = MODELS[name]
     if not hasattr(resnet_mod, cls_name):  # vd stem fallback for small nets
         cls_name = MODELS[name.replace("vd", "")]
+    # bf16 on TPU (the MXU path); f32 elsewhere — at toy scale on CPU,
+    # bf16 rounding interacts chaotically with the SGD trajectory and
+    # made CI outcomes depend on XLA fusion choices of the host process
+    dtype = (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
+             else jnp.float32)
     return getattr(resnet_mod, cls_name)(num_classes=args.classes,
-                                         width=args.width)
+                                         width=args.width, dtype=dtype)
 
 
 # -- data ---------------------------------------------------------------------
@@ -230,6 +238,9 @@ def eval_model(args, model, variables, val_files) -> float:
 
 
 # -- student ------------------------------------------------------------------
+_DEBUG_TEACHER = None  # (model, variables) — set by local role for EDL_TPU_DISTILL_VERIFY
+
+
 def make_distill_source(args, train_files, rank=0):
     """DistillReader over the noisy image stream: every batch gains the
     teacher fleet's logits (reference DistillReader(['image','label'],
@@ -237,6 +248,9 @@ def make_distill_source(args, train_files, rank=0):
     import numpy as np
 
     from edl_tpu.distill.reader import DistillReader
+
+    verify = (os.environ.get("EDL_TPU_DISTILL_VERIFY", "0")
+              not in ("", "0")) and _DEBUG_TEACHER is not None
 
     def build(epoch):
         dr = DistillReader(ins=["image", "label"], predicts=["logits"],
@@ -253,6 +267,17 @@ def make_distill_source(args, train_files, rank=0):
                 yield b["image"], b["label"]
         dr.set_batch_generator(gen)
         for image, label, logits in dr:
+            if verify:  # pairing audit: logits must match THESE images
+                tmodel, tvars = _DEBUG_TEACHER
+                want = np.asarray(tmodel.apply(tvars, np.asarray(image),
+                                               train=False))
+                # tolerance covers low-precision compute (bf16 reduction
+                # order varies with serve-side bucketing); a true pairing
+                # bug shows class-level errors orders of magnitude bigger
+                err = float(np.abs(want - np.asarray(logits)).max())
+                if err > 1.0:
+                    raise AssertionError(
+                        f"teacher logits mispaired: max err {err}")
             yield {"image": np.asarray(image),
                    "label": np.asarray(label),
                    "teacher_logits": np.asarray(logits)}
@@ -381,6 +406,8 @@ def main(argv=None) -> dict:
     teacher_top1 = eval_model(args, tmodel, tvars, val_files)
     print(f"[image-distill] teacher val_top1={teacher_top1:.3f}", flush=True)
 
+    global _DEBUG_TEACHER
+    _DEBUG_TEACHER = (tmodel, tvars)
     disc = DiscoveryServer(store, host="127.0.0.1")
     fleet = [serve_teacher(args, store, model=tmodel, variables=tvars,
                            block=False) for _ in range(2)]
