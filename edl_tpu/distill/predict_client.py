@@ -51,12 +51,15 @@ class TeacherClient:
         wire = {k: encode_array(v) for k, v in feed.items()}
         last: Exception | None = None
         for attempt in range(self._retries):
+            cold = self._cold_calls > 0
+            # spend the cold budget per ATTEMPT, success or not: a
+            # teacher wedged mid-compile must fall through to the tight
+            # timeout after the budget, not re-earn 180s forever
+            self._cold_calls -= 1
             try:
                 r = self._rpc.call(
                     "predict", feed=wire, fetch=self._fetch,
-                    _timeout=self._first_timeout if self._cold_calls > 0
-                    else None)
-                self._cold_calls -= 1
+                    _timeout=self._first_timeout if cold else None)
                 return {k: decode_array(v) for k, v in r["out"].items()}
             except Exception as e:  # noqa: BLE001
                 last = e

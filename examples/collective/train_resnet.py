@@ -50,6 +50,11 @@ def parse_args():
     p.add_argument("--label_smoothing", type=float, default=0.1)
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the backward (Fleet recompute analog)")
+    p.add_argument("--dgc", type=float, default=0.0,
+                   help="DGC gradient sparsity, e.g. 0.99 (reference "
+                        "DGCMomentumOptimizer, train_with_fleet.py:98-111); "
+                        "0 disables")
+    p.add_argument("--dgc_rampup_epochs", type=float, default=1.0)
     p.add_argument("--steps_per_epoch", type=int, default=0,
                    help="cap steps per epoch (0 = full dataset)")
     p.add_argument("--eval", action="store_true", default=True)
@@ -191,10 +196,21 @@ def main() -> None:
                        or max(1, len(my_files) * per_file // args.batch_size))
     schedule = cosine_warmup(lr, total_steps=args.epochs * steps_per_epoch,
                              warmup_steps=int(args.warmup_epochs * steps_per_epoch))
-    tx = optax.chain(
-        optax.add_decayed_weights(args.weight_decay),
-        optax.sgd(schedule, momentum=args.momentum, nesterov=True),
-    )
+    if args.dgc > 0:
+        # DGC carries its own momentum correction; the inner SGD stays
+        # momentum-free (reference DGCMomentumOptimizer composition)
+        from edl_tpu.train.compress import dgc
+        tx = optax.chain(
+            optax.add_decayed_weights(args.weight_decay),
+            dgc(sparsity=args.dgc, momentum=args.momentum,
+                rampup_steps=int(args.dgc_rampup_epochs * steps_per_epoch)),
+            optax.sgd(schedule),
+        )
+    else:
+        tx = optax.chain(
+            optax.add_decayed_weights(args.weight_decay),
+            optax.sgd(schedule, momentum=args.momentum, nesterov=True),
+        )
 
     def apply_train(params, batch_stats, image):
         fwd = lambda p, bs, x: model.apply(
