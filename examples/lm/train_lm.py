@@ -39,9 +39,11 @@ def parse_args():
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--tp", type=int, default=0, help="0 = auto (2 if even)")
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="zero-style parameter sharding axis size")
     p.add_argument("--pp", type=int, default=1,
                    help=">1 pipelines the decoder blocks over the pp mesh "
-                        "axis (GPipe; forces tp=sp=1 in this example)")
+                        "axis (GPipe; forces tp=sp=fsdp=1 in this example)")
     p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "flash", "ring"])
@@ -173,6 +175,9 @@ def main() -> None:
     n_dev = len(jax.devices())
     if args.pp > 1:
         tp = sp = 1  # this example pipelines pure-dp blocks
+        if args.fsdp != 1:
+            raise SystemExit("--pp pipelines pure-dp blocks in this "
+                             "example; it cannot combine with --fsdp")
         if args.attention == "ring":
             raise SystemExit("--pp cannot combine with --attention ring "
                              "(ring's shard_map cannot nest inside the "
@@ -193,9 +198,11 @@ def main() -> None:
                   f" -> {m} (local batch {local_batch})", flush=True)
         args.pp_microbatches = m
     else:
-        tp = args.tp or (2 if n_dev % 2 == 0 else 1)
+        # auto-tp from the devices LEFT once fsdp/sp take their share
+        free = max(1, n_dev // (args.fsdp * args.sp))
+        tp = args.tp or (2 if free % 2 == 0 else 1)
         sp = args.sp
-        spec = MeshSpec(dp=-1, tp=tp, sp=sp)
+        spec = MeshSpec(dp=-1, fsdp=args.fsdp, tp=tp, sp=sp)
 
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,

@@ -56,3 +56,13 @@ def test_lm_pipeline_parallel(tmp_path):
     assert rec["mesh"]["pp"] == 4, rec
     assert rec["val_nll"] < rec["unigram_nll"] - 0.4, rec
     assert rec["nll_curve"][-1] < rec["nll_curve"][0], rec
+
+
+@pytest.mark.slow
+def test_lm_fsdp_param_sharding(tmp_path):
+    """dp x fsdp x tp: zero-style parameter sharding (embed on fsdp via
+    the logical rules) trains the same workload."""
+    rec, _ = run_lm(tmp_path, "--epochs", "2", "--steps_per_epoch", "10",
+                    "--tp", "2", "--sp", "1", "--fsdp", "2")
+    assert rec["mesh"]["fsdp"] == 2 and rec["mesh"]["tp"] == 2, rec
+    assert rec["val_nll"] < rec["unigram_nll"], rec
