@@ -81,7 +81,7 @@ def main() -> None:
     # knobs let CI smoke the bench on CPU; the driver runs defaults on TPU
     size = int(os.environ.get("EDL_TPU_BENCH_SIZE", 224))
     per_dev_bs = int(os.environ.get("EDL_TPU_BENCH_BS", 128))
-    n_steps = int(os.environ.get("EDL_TPU_BENCH_STEPS", 20))
+    n_steps = int(os.environ.get("EDL_TPU_BENCH_STEPS", 30))
     width = int(os.environ.get("EDL_TPU_BENCH_WIDTH", 64))
 
     n_dev = len(jax.devices())
@@ -124,7 +124,8 @@ def main() -> None:
         {"image": host["image"].astype(jnp.bfloat16), "label": host["label"]})
 
     # -- synthetic: pure compute path (pre-sharded batch reused) -------------
-    state, metrics = trainer.step_fn(state, gbatch, rng)  # compile
+    for _ in range(3):  # compile + settle the dispatch path
+        state, metrics = trainer.step_fn(state, gbatch, rng)
     float(metrics["loss"])  # hard sync (axon tunnel: float() drains)
     t0 = time.perf_counter()
     for _ in range(n_steps):

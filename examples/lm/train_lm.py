@@ -198,6 +198,8 @@ def main() -> None:
                   f" -> {m} (local batch {local_batch})", flush=True)
         args.pp_microbatches = m
     else:
+        if args.fsdp < 1 or args.sp < 1:
+            raise SystemExit("--fsdp and --sp must be >= 1")
         # auto-tp from the devices LEFT once fsdp/sp take their share
         free = max(1, n_dev // (args.fsdp * args.sp))
         tp = args.tp or (2 if free % 2 == 0 else 1)
