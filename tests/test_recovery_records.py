@@ -1,0 +1,60 @@
+"""Recovery-time record merging (cluster/recovery.py): launcher and
+trainer halves join per stage, phases compute correctly, ordering is
+chronological."""
+
+import json
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.recovery import load_recovery_records, summarize_recovery
+from edl_tpu.utils import constants
+
+
+def put(kv, job, stage, role, pod, times):
+    kv.put(paths.key(job, constants.ETCD_RECOVERY, f"{stage}/{role}/{pod}"),
+           json.dumps(times).encode())
+
+
+def test_merge_and_breakdown(memkv):
+    t0 = 1000.0
+    put(memkv, "j", "s1", "launcher", "podA",
+        {"detect": t0, "killed": t0 + 2, "barrier": t0 + 2.5,
+         "spawn": t0 + 3})
+    put(memkv, "j", "s1", "trainer", "podA",
+        {"restored": t0 + 8, "first_step": t0 + 9.5})
+    # a second, later resize with no trainer half yet
+    put(memkv, "j", "s2", "launcher", "podA",
+        {"detect": t0 + 100, "killed": t0 + 101, "barrier": t0 + 101.2,
+         "spawn": t0 + 101.5})
+
+    recs = load_recovery_records(memkv, "j")
+    assert set(recs) == {"s1", "s2"}
+
+    stages = summarize_recovery(memkv, "j", kill_time=t0 - 1.5)
+    assert [s["stage"] for s in stages] == ["s1", "s2"]  # chronological
+    s1 = stages[0]
+    assert s1["detect_to_kill"] == 2.0
+    assert s1["kill_to_barrier"] == 0.5
+    assert s1["barrier_to_spawn"] == 0.5
+    assert s1["spawn_to_restored"] == 5.0
+    assert s1["restored_to_first_step"] == 1.5
+    assert s1["total"] == 9.5
+    assert s1["kill_to_detect"] == 1.5
+    assert s1["total_from_kill"] == 11.0
+    # incomplete stage carries launcher phases only
+    assert "total" not in stages[1]
+
+
+def test_earliest_detector_and_last_finisher_win(memkv):
+    t0 = 50.0
+    put(memkv, "j2", "s", "launcher", "podB",
+        {"detect": t0 + 1, "killed": t0 + 2, "barrier": t0 + 3,
+         "spawn": t0 + 4})
+    put(memkv, "j2", "s", "launcher", "podA",  # detected FIRST
+        {"detect": t0, "killed": t0 + 1, "barrier": t0 + 3, "spawn": t0 + 4})
+    put(memkv, "j2", "s", "trainer", "podA",
+        {"restored": t0 + 6, "first_step": t0 + 7})
+    put(memkv, "j2", "s", "trainer", "podB",  # finished LAST
+        {"restored": t0 + 6, "first_step": t0 + 9})
+    s = summarize_recovery(memkv, "j2")[0]
+    assert s["detect_at"] == t0
+    assert s["total"] == 9.0  # earliest detect -> last first_step
