@@ -50,6 +50,10 @@ def parse_args():
     p.add_argument("--label_smoothing", type=float, default=0.1)
     p.add_argument("--remat", action="store_true",
                    help="rematerialize the backward (Fleet recompute analog)")
+    p.add_argument("--save_every_steps", type=int, default=0,
+                   help="mid-epoch checkpoint cadence (0 = per-epoch only); "
+                        "with --data_service a mid-epoch resume then skips "
+                        "exactly the trained record spans")
     p.add_argument("--dgc", type=float, default=0.0,
                    help="DGC gradient sparsity, e.g. 0.99 (reference "
                         "DGCMomentumOptimizer, train_with_fleet.py:98-111); "
@@ -259,6 +263,7 @@ def main() -> None:
         profile_window = (int(lo), int(hi or int(lo) + 5))
     cfg = TrainConfig(mesh_spec=MeshSpec(),
                       checkpoint_dir=tenv.checkpoint_dir,
+                      save_every_steps=args.save_every_steps,
                       global_batch_size=global_batch, log_every=50,
                       profile_window=profile_window,
                       profile_dir=args.profile_dir or
@@ -274,9 +279,21 @@ def main() -> None:
         return variables["params"], variables["batch_stats"]
 
     state, meta = trainer.restore_or_create(init, tx)
+    resumed_spans = sum(r.end - r.begin
+                        for r in meta.data_checkpoint.processed)
     print(f"[train_resnet] {args.model} rank={rank}/{world} "
-          f"resume_epoch={meta.next_epoch} lr={lr:.4f} "
+          f"resume_epoch={meta.next_epoch} in_epoch={meta.in_epoch} "
+          f"resumed_spans={resumed_spans} lr={lr:.4f} "
           f"steps/epoch={steps_per_epoch} files={len(my_files)}", flush=True)
+
+    step_sleep = float(os.environ.get("EDL_TPU_DEMO_STEP_SLEEP", "0"))
+
+    def paced(it):
+        # integration tests pace the run so a kill can land mid-epoch
+        for item in it:
+            if step_sleep:
+                time.sleep(step_sleep)
+            yield item
 
     if args.data_service:
         # records flow through the leader's DataService: dynamic file
@@ -310,7 +327,7 @@ def main() -> None:
 
         def data_fn(epoch: int):
             it = ei.epoch(epoch, meta.data_checkpoint)
-            for i, batch in enumerate(it):
+            for i, batch in enumerate(paced(it)):
                 if args.steps_per_epoch and i >= args.steps_per_epoch:
                     it.close()
                     break
@@ -321,7 +338,7 @@ def main() -> None:
                 my_files, args.batch_size, image_size=args.image_size,
                 train=True, seed=1000 * epoch + rank,
                 num_workers=args.num_workers))
-            for i, batch in enumerate(it):
+            for i, batch in enumerate(paced(it)):
                 if args.steps_per_epoch and i >= args.steps_per_epoch:
                     break
                 yield batch

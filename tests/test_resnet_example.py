@@ -23,7 +23,7 @@ TRAIN = os.path.join(REPO, "examples", "collective", "train_resnet.py")
 
 
 def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None,
-          extra_args=()):
+          extra_args=(), nodes_range="2:2", ckpt_dir=None):
     env = dict(os.environ)
     env.update(FAST)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -31,10 +31,11 @@ def spawn(job_id, coord_ep, tmp, name, data_dir, bench, extra_env=None,
     env["EDL_TPU_DEMO_MARKER"] = os.path.join(tmp, f"marker-{name}")
     env.update(extra_env or {})
     log = open(os.path.join(tmp, f"launcher-{name}.log"), "wb")
+    ckpt = (["--checkpoint_dir", ckpt_dir] if ckpt_dir else [])
     proc = subprocess.Popen(
         [sys.executable, "-m", "edl_tpu.collective.launch",
          "--job_id", job_id, "--coord_endpoints", coord_ep,
-         "--nodes_range", "2:2", "--nproc_per_node", "1",
+         "--nodes_range", nodes_range, "--nproc_per_node", "1"] + ckpt + [
          "--log_dir", os.path.join(tmp, f"log-{name}"), TRAIN, "--",
          "--synthetic", "4", "--synthetic_per_file", "48",
          "--synthetic_files", "2", "--data_dir", data_dir,
@@ -102,6 +103,70 @@ def test_two_pod_resnet_data_service(coord_server, tmp_path):
     # records trained (the img_s accounting sees the full epoch)
     assert len(dump["epochs"]) == 2
     assert all("val_top1" in e for e in dump["epochs"])
+
+
+@pytest.mark.slow
+def test_resnet_data_service_survives_mid_epoch_kill(coord_server, tmp_path):
+    """The headline workload + DataService under a hard mid-epoch pod
+    kill: the survivor stop-resumes SOLO, re-enters the SAME epoch from
+    the checkpointed record spans, and finishes the job."""
+    import re
+    import time
+
+    from tests.helpers.harness import kill_tree
+
+    ep = f"127.0.0.1:{coord_server.port}"
+    tmp = str(tmp_path)
+    data = os.path.join(tmp, "data")
+    bench = os.path.join(tmp, "bench.json")
+    # 16 paced steps/epoch (~4s), eval off so inter-epoch gaps are tiny
+    # (a kill shortly after epoch 0's record lands inside epoch 1), and
+    # mid-epoch saves every 4 steps so the resume carries record spans
+    args = ("--data_service", "--steps_per_epoch", "0", "--epochs", "3",
+            "--synthetic_per_file", "128", "--no-eval",
+            "--save_every_steps", "4")
+    env = {"EDL_TPU_DEMO_STEP_SLEEP": "0.25"}
+    ckpt = os.path.join(tmp, "ckpt")
+    pa = spawn("rn-kill", ep, tmp, "a", data, bench, extra_env=env,
+               extra_args=args, nodes_range="1:2", ckpt_dir=ckpt)
+    pb = spawn("rn-kill", ep, tmp, "b", data, bench, extra_env=env,
+               extra_args=args, nodes_range="1:2", ckpt_dir=ckpt)
+    # wait for epoch 0's bench record (the example prints one JSON line
+    # per epoch; trainer INFO logs are not configured in subprocesses)
+    la = os.path.join(tmp, "launcher-a.log")
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if os.path.exists(la) and re.search(
+                r'"epoch": 0,', open(la, errors="replace").read()):
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError("epoch 0 never completed: " + _logs(tmp)[-3000:])
+    time.sleep(2.0)
+    kill_tree(pb)
+    assert finish(pa, 420) == 0, _logs(tmp)[-4000:]
+    try:
+        finish(pb, 10)
+    except Exception:  # noqa: BLE001 — B was SIGKILLed
+        pass
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "rn-kill") == Status.SUCCEED
+    client.close()
+    marker = (tmp_path / "marker-a").read_text()
+    assert "epochs=[0, 1, 2]" in marker, marker
+    assert "world=1" in marker, marker  # the job really shrank
+    text = open(la, errors="replace").read()
+    resumes = re.findall(
+        r"resume_epoch=(\d+) in_epoch=(-?\d+) resumed_spans=(\d+)", text)
+    assert len(resumes) >= 2, text[-2000:]
+    # the post-kill restart resumed from a committed checkpoint WITH its
+    # data-checkpoint spans — never a cold start.  Whether the resume is
+    # mid-epoch (in_epoch >= 0) or at an epoch boundary depends on which
+    # async save had committed when the kill landed; the deterministic
+    # mid-epoch exactly-once case is pinned by tests/test_data_plane_e2e
+    assert any((int(e) >= 1 or int(ie) >= 0) and int(sp) > 0
+               for e, ie, sp in resumes[1:]), resumes
 
 
 def _logs(tmp):
