@@ -12,9 +12,11 @@ python -m pip install -e ".[image,test]" \
 # fast tier: everything but the multi-process e2e tests
 python -m pytest tests/ -q -m "not slow"
 
-# full tier (FULL=1): launcher/jax.distributed end-to-end
+# full tier (FULL=1): launcher/jax.distributed end-to-end + the live
+# recovery-time measurement (north-star metric)
 if [[ "${FULL:-0}" == "1" ]]; then
     python -m pytest tests/ -q -m slow
+    python examples/collective/recovery_bench.py
 fi
 
 # packaging sanity: console scripts resolve
