@@ -184,6 +184,18 @@ def main() -> None:
         dt_p = time.perf_counter() - t0
         pipe_img_s_chip = bs * done / dt_p / n_dev
 
+    # -- LM flagship: tokens/s/chip (secondary metric) -----------------------
+    # defaults are flagship-sized (124M params), so off the TPU this only
+    # runs when explicitly requested (a CPU smoke run would take hours)
+    lm_tokens_s_chip = None
+    lm_default = "1" if jax.devices()[0].platform == "tpu" else "0"
+    if os.environ.get("EDL_TPU_BENCH_LM", lm_default) != "0":
+        try:
+            lm_tokens_s_chip = _bench_lm(n_dev)
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     out = {
         "metric": "resnet50_train_img_s_per_chip",
         "value": round(img_s_chip, 1),
@@ -202,7 +214,66 @@ def main() -> None:
         out["tflops_per_chip"] = round(tflops_chip, 1)
     if mfu is not None:
         out["mfu"] = round(mfu, 3)
+    if lm_tokens_s_chip is not None:
+        out["lm_tokens_s_per_chip"] = round(lm_tokens_s_chip, 0)
     print(json.dumps(out))
+
+
+def _bench_lm(n_dev: int) -> float:
+    """Flagship TransformerLM training throughput (tokens/s/chip):
+    default 124M-param config (12L × 768, vocab 32k, seq 1024), bf16,
+    remat, flash attention on TPU, fused blockwise CE — through
+    ElasticTrainer on a dp mesh like the headline bench."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models import TransformerConfig, TransformerLM
+    from edl_tpu.models import transformer as tf_mod
+    from edl_tpu.models.logical import logical_axes_from_paths
+    from edl_tpu.models.transformer import lm_loss_fused
+    from edl_tpu.parallel import MeshSpec
+    from edl_tpu.parallel.sharding import shard_host_batch
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    seq = int(os.environ.get("EDL_TPU_BENCH_LM_SEQ", 1024))
+    per_dev_bs = int(os.environ.get("EDL_TPU_BENCH_LM_BS", 8))
+    n_steps = int(os.environ.get("EDL_TPU_BENCH_LM_STEPS", 20))
+    vocab = int(os.environ.get("EDL_TPU_BENCH_LM_VOCAB", 32_000))
+    bs = per_dev_bs * n_dev
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=12, embed_dim=768,
+                            num_heads=12, mlp_dim=3072, max_len=seq)
+    model = TransformerLM(cfg)
+
+    def loss_fn(params, extra, batch, rng):
+        h = model.apply({"params": params}, batch["ids"][:, :-1],
+                        return_hidden=True)
+        return lm_loss_fused(params, h, batch["ids"][:, 1:], cfg), (extra, {})
+
+    tr = ElasticTrainer(loss_fn, TrainConfig(mesh_spec=MeshSpec(),
+                                             log_every=0))
+
+    def init():
+        ids0 = jnp.zeros((1, 8), jnp.int32)
+        return model.init(jax.random.key(0), ids0)["params"], None
+
+    shape = jax.eval_shape(lambda: init()[0])
+    logical = logical_axes_from_paths(shape, tf_mod.LOGICAL_RULES)
+    state = tr.create_state(init, optax.adamw(3e-4), param_logical=logical)
+    ids = np.random.default_rng(2).integers(
+        0, vocab, (bs, seq + 1)).astype(np.int32)
+    gbatch = shard_host_batch({"ids": ids}, tr.mesh, tr.rules)
+    rng = jax.random.key(3)
+    for _ in range(2):
+        state, metrics = tr.step_fn(state, gbatch, rng)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = tr.step_fn(state, gbatch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return bs * seq * n_steps / dt / n_dev
 
 
 if __name__ == "__main__":

@@ -120,7 +120,11 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, ids, positions=None, train: bool = True):
+    def __call__(self, ids, positions=None, train: bool = True,
+                 return_hidden: bool = False):
+        """Logits [B, L, V] f32 — or, with ``return_hidden``, the
+        final-norm hidden states [B, L, D] for the fused-CE loss path
+        (:func:`lm_loss_fused`), which never materialises the logits."""
         cfg = self.cfg
         del train
         if positions is None:
@@ -137,6 +141,11 @@ class TransformerLM(nn.Module):
                         in_axes=nn.broadcast, metadata_params={})
         x, _ = Stack(cfg, name="layers")(x, positions)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # NOTE: init() must run with the default return_hidden=False
+            # so the lm_head params are created; apply() with extra
+            # params present is fine in flax
+            return x
         if cfg.tie_embeddings:
             embed = self.get_variable("params", "tok_embed")["embedding"]
             logits = x @ embed.T.astype(cfg.dtype)
@@ -146,10 +155,32 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def _masked_mean(nll, mask):
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
 def lm_loss(logits, targets, mask=None):
     """Next-token cross entropy; ``targets`` already shifted."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return nll.mean()
+    return _masked_mean(nll, mask)
+
+
+def lm_loss_fused(params, hidden, targets, cfg: TransformerConfig,
+                  mask=None, block_size: int = 4096):
+    """Next-token CE from ``apply(..., return_hidden=True)`` hidden
+    states, via the blockwise fused kernel (edl_tpu/ops/ce.py) — the
+    [B, L, V] logits (~1 GiB at the flagship config) are never
+    materialised.  Numerically equivalent to ``lm_loss`` of the dense
+    head: the same bf16-cast matmul with f32 accumulation."""
+    from edl_tpu.ops.ce import blockwise_cross_entropy
+
+    if cfg.tie_embeddings:
+        w = params["tok_embed"]["embedding"].T
+    else:
+        w = params["lm_head"]["kernel"]
+    nll = blockwise_cross_entropy(hidden, w.astype(hidden.dtype), targets,
+                                  block_size=block_size)
+    return _masked_mean(nll, mask)

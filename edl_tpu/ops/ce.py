@@ -1,0 +1,148 @@
+"""Blockwise fused softmax cross-entropy for large vocabularies.
+
+``lm_loss`` materialises ``[N, V]`` logits **plus** an f32
+``log_softmax`` copy — at the flagship config (batch 8 × seq 1024,
+vocab 32k) that second copy alone is ~1 GiB of HBM per step.  This op
+computes the same per-token NLL **from the hidden states and the head
+weight directly**, scanning the vocabulary in blocks:
+
+- forward: one ``[N, block]`` logits tile at a time folded into an
+  online logsumexp (the flash-attention recurrence applied to the
+  softmax denominator) while the target logit is gathered from
+  whichever block contains it — the full logits array never exists;
+- backward: recompute each block's logits from the saved ``(m, lse)``
+  statistics, form ``softmax - onehot`` tile by tile, and accumulate
+  ``dhidden`` and the per-block ``dW`` — again never holding ``[N, V]``.
+
+Peak activation memory drops from O(N·V) to O(N·block + D·V); the
+matmuls stay MXU-shaped (``[N, D] @ [D, block]``) and bf16 with f32
+accumulation, so throughput is the same or better (HBM traffic for the
+logits round-trip disappears).  The reference has no analogue — its
+largest softmax is ImageNet's 1000 classes — but the LM flagship
+(models/transformer.py) is exactly the workload this exists for.
+
+Pure-JAX ``lax.scan`` + ``custom_vjp``: runs identically on the CPU
+test mesh and on TPU, shards under the usual logical rules (the vocab
+axis of ``weight`` may live on ``tp``; XLA inserts the collectives).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30  # finite: keeps exp()=0 without inf-inf NaNs
+
+
+def _pad_blocks(weight, block_size: int):
+    """[D, V] -> ([nb, bs, D] stacked blocks, V, nb)."""
+    D, V = weight.shape
+    nb = -(-V // block_size)
+    pad = nb * block_size - V
+    wt = weight.T  # [V, D]
+    if pad:
+        wt = jnp.pad(wt, ((0, pad), (0, 0)))
+    return wt.reshape(nb, block_size, D), V, nb
+
+
+def _block_logits(hidden_f, wb, start, bs, V):
+    """f32 [N, bs] logits for one vocab block; padded columns -> -inf."""
+    logits = jnp.einsum("nd,bd->nb", hidden_f, wb,
+                        preferred_element_type=jnp.float32)
+    cols = start + jnp.arange(bs)
+    return jnp.where(cols[None, :] < V, logits, NEG_INF)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _blockwise_ce(hidden, weight, targets, block_size):
+    nll, _ = _ce_fwd_impl(hidden, weight, targets, block_size)
+    return nll
+
+
+def _ce_fwd_impl(hidden, weight, targets, block_size):
+    N = hidden.shape[0]
+    wblocks, V, nb = _pad_blocks(weight, block_size)
+    bs = wblocks.shape[1]
+    hidden_f = hidden  # keep bf16 for the MXU; f32 accumulation via pet
+
+    def fold(carry, inp):
+        m, l, tgt = carry
+        wb, start = inp
+        logits = _block_logits(hidden_f, wb, start, bs, V)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=1)
+        idx = targets - start
+        inside = (idx >= 0) & (idx < bs)
+        safe = jnp.clip(idx, 0, bs - 1)
+        val = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        tgt = jnp.where(inside, val, tgt)
+        return (m_new, l, tgt), None
+
+    starts = jnp.arange(nb) * bs
+    init = (jnp.full((N,), NEG_INF, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.full((N,), NEG_INF, jnp.float32))
+    (m, l, tgt), _ = jax.lax.scan(fold, init, (wblocks, starts))
+    lse = m + jnp.log(l)
+    return lse - tgt, lse
+
+
+def _ce_fwd(hidden, weight, targets, block_size):
+    nll, lse = _ce_fwd_impl(hidden, weight, targets, block_size)
+    return nll, (hidden, weight, targets, lse)
+
+
+def _ce_bwd(block_size, res, g):
+    hidden, weight, targets, lse = res
+    N, D = hidden.shape
+    wblocks, V, nb = _pad_blocks(weight, block_size)
+    bs = wblocks.shape[1]
+
+    def fold(dh, inp):
+        wb, start = inp
+        logits = _block_logits(hidden, wb, start, bs, V)
+        p = jnp.exp(logits - lse[:, None])          # softmax tile (pad -> 0)
+        idx = targets - start
+        inside = (idx >= 0) & (idx < bs)
+        onehot_col = jnp.clip(idx, 0, bs - 1)
+        p = p - jnp.where(
+            inside[:, None] & (jnp.arange(bs)[None, :] == onehot_col[:, None]),
+            1.0, 0.0)
+        dlogits = p * g[:, None]                    # [N, bs] f32
+        dh = dh + jnp.einsum("nb,bd->nd", dlogits, wb,
+                             preferred_element_type=jnp.float32)
+        dwb = jnp.einsum("nb,nd->bd", dlogits, hidden,
+                         preferred_element_type=jnp.float32)
+        return dh, dwb
+
+    starts = jnp.arange(nb) * bs
+    dh, dwbs = jax.lax.scan(fold, jnp.zeros((N, D), jnp.float32),
+                            (wblocks, starts))
+    dweight = dwbs.reshape(nb * bs, D)[:V].T.astype(weight.dtype)
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
+    return dh.astype(hidden.dtype), dweight, dtargets
+
+
+_blockwise_ce.defvjp(_ce_fwd, _ce_bwd)
+
+
+def blockwise_cross_entropy(hidden, weight, targets, *,
+                            block_size: int = 4096):
+    """Per-token NLL of ``softmax(hidden @ weight)`` against ``targets``
+    without materialising the logits.
+
+    ``hidden``: ``[..., D]`` (bf16 or f32), ``weight``: ``[D, V]``,
+    ``targets``: ``[...]`` int — returns f32 NLL of ``targets``' shape.
+    Differentiable in ``hidden`` and ``weight``."""
+    lead = targets.shape
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    t2 = targets.reshape(-1)
+    if h2.shape[0] != t2.shape[0]:
+        raise ValueError(f"hidden leading dims {hidden.shape[:-1]} != "
+                         f"targets shape {lead}")
+    nll = _blockwise_ce(h2, weight, t2, int(block_size))
+    return nll.reshape(lead)

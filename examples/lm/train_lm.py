@@ -48,6 +48,10 @@ def parse_args():
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "flash", "ring"])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--fused_ce", action="store_true",
+                   help="blockwise fused cross-entropy: never materialise "
+                        "the [B, L, vocab] logits (edl_tpu/ops/ce.py)")
+    p.add_argument("--ce_block", type=int, default=4096)
     return p.parse_args()
 
 
@@ -217,7 +221,17 @@ def main() -> None:
     model = (_PipelinedLM(cfg, args.pp_microbatches) if args.pp > 1
              else TransformerLM(cfg))
 
+    if args.fused_ce and args.pp > 1:
+        raise SystemExit("--fused_ce applies to the TransformerLM head; "
+                         "the --pp adapter computes its own head")
+
     def loss_fn(params, extra, batch, rng):
+        if args.fused_ce:
+            from edl_tpu.models.transformer import lm_loss_fused
+            h = model.apply({"params": params}, batch["ids"][:, :-1],
+                            return_hidden=True)
+            return lm_loss_fused(params, h, batch["ids"][:, 1:], cfg,
+                                 block_size=args.ce_block), (extra, {})
         logits = model.apply({"params": params}, batch["ids"][:, :-1])
         return lm_loss(logits, batch["ids"][:, 1:]), (extra, {})
 
