@@ -1,0 +1,78 @@
+"""Metrics collector: store-sourced CSV rows + job-phase accounting."""
+
+import json
+import os
+import sys
+
+from edl_tpu.cluster import paths
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.pod import Pod
+from edl_tpu.cluster.status import Status, save_job_status, save_pod_status
+from edl_tpu.cluster.train_status import TrainStatus, save_train_status
+from edl_tpu.utils import constants
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "collective"))
+from collector import FIELDS, JobPhases, collect_row  # noqa: E402
+
+
+def _seed_job(kv, job="j1"):
+    pods = [Pod(pod_id=f"p{i}", port=7000 + i) for i in range(2)]
+    for p in pods:
+        p.make_trainers(2, [7100, 7101])
+    cluster = Cluster.from_pods(pods)
+    kv.put(paths.key(job, constants.ETCD_CLUSTER, "cluster"),
+           cluster.to_json().encode())
+    for p in pods:
+        kv.put(paths.key(job, constants.ETCD_POD_RESOURCE, p.pod_id),
+               p.to_json().encode())
+        save_pod_status(kv, job, p.pod_id, Status.RUNNING)
+        save_train_status(kv, job, p.pod_id, TrainStatus.RUNNING)
+    save_job_status(kv, job, Status.RUNNING)
+    return cluster
+
+
+def test_collect_row_running_job(memkv):
+    cluster = _seed_job(memkv)
+    row = collect_row(memkv, "j1", now=100.0)
+    assert list(row) == FIELDS
+    assert row["job_status"] == Status.RUNNING.value
+    assert row["stage"] == cluster.stage[:8]
+    assert row["live_pods"] == 2 and row["cluster_pods"] == 2
+    assert row["world_size"] == 4 and row["pods_running"] == 2
+    assert row["train_status"] == f"{TrainStatus.RUNNING.value}:2"
+    assert row["resizes"] == 0 and row["last_recovery_sec"] == ""
+
+
+def test_collect_row_empty_store(memkv):
+    row = collect_row(memkv, "ghost")
+    assert row["job_status"] == "N/A"
+    assert row["cluster_pods"] == 0 and row["world_size"] == 0
+
+
+def test_collect_row_includes_recovery(memkv):
+    _seed_job(memkv)
+    stage = "s1"
+    memkv.put(paths.key("j1", constants.ETCD_RECOVERY,
+                        f"{stage}/launcher/p0"),
+              json.dumps({"detect": 10.0, "killed": 10.5, "barrier": 11.0,
+                          "spawn": 11.2}).encode())
+    memkv.put(paths.key("j1", constants.ETCD_RECOVERY,
+                        f"{stage}/trainer/p0"),
+              json.dumps({"restored": 14.0, "first_step": 15.5}).encode())
+    row = collect_row(memkv, "j1")
+    assert row["resizes"] == 1
+    assert row["last_recovery_sec"] == 5.5  # 15.5 - 10.0
+
+
+def test_job_phases_accounting():
+    ph = JobPhases()
+    ph.observe({"job_id": "a", "ts": 1.0, "job_status": "N/A",
+                "pods_running": 0})
+    ph.observe({"job_id": "a", "ts": 4.0,
+                "job_status": Status.RUNNING.value, "pods_running": 2})
+    ph.observe({"job_id": "a", "ts": 10.0,
+                "job_status": Status.SUCCEED.value, "pods_running": 0})
+    (s,) = ph.summary()
+    assert s == {"job_id": "a", "status": Status.SUCCEED.value,
+                 "pending_sec": 3.0, "run_sec": 6.0}
