@@ -52,7 +52,8 @@ def _ring_local(ql, kl, vl, *, axis: str, n_shards: int, causal: bool,
     my = jax.lax.axis_index(axis)
     q_pos = my * Lq + jnp.arange(Lq)                     # global query rows
 
-    qf = ql.astype(jnp.float32) * scale
+    # matmuls stay in the input dtype (bf16 on TPU -> full-rate MXU) with
+    # f32 accumulation; only the softmax statistics are carried in f32
     m = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, Lq), jnp.float32)
     acc = jnp.zeros((B, Lq, H, D), jnp.float32)
@@ -60,7 +61,8 @@ def _ring_local(ql, kl, vl, *, axis: str, n_shards: int, causal: bool,
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     for step in range(n_shards):
         src = (my - step) % n_shards                     # owner of this block
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kl.astype(jnp.float32))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", ql, kl,
+                            preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = src * Lk + jnp.arange(Lk)
             mask = q_pos[:, None] >= k_pos[None, :]      # [Lq, Lk]
@@ -71,7 +73,8 @@ def _ring_local(ql, kl, vl, *, axis: str, n_shards: int, causal: bool,
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vl.astype(jnp.float32))
+            "bhqk,bkhd->bqhd", p.astype(ql.dtype), vl,
+            preferred_element_type=jnp.float32)
         m = m_new
         if step + 1 < n_shards:                          # rotate k/v blocks
             kl = jax.lax.ppermute(kl, axis, perm)

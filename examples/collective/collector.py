@@ -35,8 +35,6 @@ from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.recovery import summarize_recovery
 from edl_tpu.cluster.status import Status, load_job_status, load_pods_status
 from edl_tpu.cluster.train_status import load_train_statuses
-from edl_tpu.cluster import paths
-from edl_tpu.utils import constants
 
 FIELDS = ["ts", "job_id", "job_status", "stage", "live_pods",
           "cluster_pods", "world_size", "pods_running", "train_status",
@@ -48,10 +46,11 @@ TERMINAL_VALUES = {Status.SUCCEED.value, Status.FAILED.value}
 def collect_row(store, job_id: str, now: float | None = None) -> dict:
     """One poll of everything the store knows about ``job_id``."""
     now = time.time() if now is None else now
+    from edl_tpu.collective.resource import load_resource_pods
+
     job = load_job_status(store, job_id)
     cluster = Cluster.load_from_store(store, job_id)
-    live, _rev = store.get_prefix(
-        paths.table_prefix(job_id, constants.ETCD_POD_RESOURCE))
+    live = load_resource_pods(store, job_id)
     pods = load_pods_status(store, job_id)
     trains = load_train_statuses(store, job_id)
     resizes = summarize_recovery(store, job_id)
@@ -127,9 +126,12 @@ def main() -> None:
     phases = JobPhases()
     tick = 0
     try:
+        # last-known status per job: a job whose poll failed this tick
+        # must NOT drop out of the terminal check (its series would be
+        # silently truncated the moment the others finish)
+        latest = {job: "N/A" for job in args.job_id}
         while True:
             tick += 1
-            statuses = []
             for job in args.job_id:
                 # a transient store RPC failure (most likely during the
                 # very resize window being measured) must not end the
@@ -142,11 +144,11 @@ def main() -> None:
                     continue
                 writer.writerow(row)
                 phases.observe(row)
-                statuses.append(row["job_status"])
+                latest[job] = row["job_status"]
             sink.flush()
             if args.max_ticks and tick >= args.max_ticks:
                 break
-            if statuses and all(s in TERMINAL_VALUES for s in statuses):
+            if all(s in TERMINAL_VALUES for s in latest.values()):
                 break
             time.sleep(args.interval)
     finally:
