@@ -42,7 +42,14 @@ AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp", "ep")
 @dataclass(frozen=True)
 class MeshSpec:
     """A declarative mesh shape.  At most one axis may be -1 (absorb all
-    remaining devices); every other axis must divide the device count."""
+    remaining devices); every other axis must divide the device count.
+
+    ``dcn_dp`` spreads data-parallel replicas ACROSS slices over DCN
+    (the cross-slice reduction of SURVEY.md §5's backend mapping): the
+    per-slice axes above ride ICI, and the resulting ``dp`` axis is
+    ``dcn_dp × dp``-wide with slice-major order so XLA's hierarchical
+    collectives reduce within each slice first.  0 = auto (one replica
+    group per slice when running on a multi-slice platform, else 1)."""
 
     dp: int = -1
     fsdp: int = 1
@@ -50,12 +57,14 @@ class MeshSpec:
     sp: int = 1
     tp: int = 1
     ep: int = 1
+    dcn_dp: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        """Fill in the -1 axis and validate divisibility."""
+        """Fill in the -1 axis and validate divisibility (``n_devices``
+        is per-DCN-group when ``dcn_dp`` > 1; see build_mesh)."""
         sizes = self.sizes()
         wild = [a for a, s in sizes.items() if s == -1]
         if len(wild) > 1:
@@ -74,14 +83,30 @@ class MeshSpec:
         return build_mesh(self, devices)
 
 
+def n_slices(devices) -> int:
+    """Distinct TPU slices among ``devices`` (1 on single-slice / CPU)."""
+    ids = {getattr(d, "slice_index", 0) for d in devices}
+    return len(ids)
+
+
 def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
     """Build a ``Mesh`` from a spec over ``devices`` (default: all).
 
     Uses ``mesh_utils.create_device_mesh`` so that on real TPU slices the
     assignment respects the physical torus; on CPU/test platforms it
-    falls back to a plain reshape.
+    falls back to a plain reshape.  With ``dcn_dp`` > 1 (or auto on a
+    multi-slice platform) the assignment goes through
+    ``create_hybrid_device_mesh``: per-slice axes on ICI, replica groups
+    across slices on DCN, merged slice-major into the ``dp`` axis.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
+    if spec.dcn_dp < 0:
+        # no wildcard here (unlike the per-group axes): 0 already means
+        # "one group per slice", which is the only sensible auto
+        raise ValueError(f"dcn_dp must be >= 0, got {spec.dcn_dp}")
+    dcn = spec.dcn_dp or n_slices(devices)
+    if dcn > 1:
+        return _build_hybrid(spec, devices, dcn)
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     try:
@@ -92,6 +117,34 @@ def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
             raise  # on real slices a mapping failure means a bad mesh shape
         logger.warning("create_device_mesh failed (%s); plain reshape fallback", e)
         dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def _build_hybrid(spec: MeshSpec, devices, dcn: int) -> Mesh:
+    """ICI×DCN hybrid mesh: ``dcn`` replica groups (normally one per
+    slice) × a per-group spec.  The returned mesh's ``dp`` axis is
+    ``dcn × per-group dp``, slice-major, so data-parallel gradient
+    reduction becomes reduce-scatter on ICI + small all-reduce on DCN —
+    exactly the reference's hierarchical-allreduce intent
+    (train_with_fleet.py:93) expressed through the compiler."""
+    if len(devices) % dcn:
+        raise ValueError(f"{len(devices)} devices not divisible into "
+                         f"{dcn} DCN groups")
+    sizes = spec.resolve(len(devices) // dcn)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dcn_shape = tuple(dcn if a == "dp" else 1 for a in AXIS_ORDER)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn_mesh_shape=dcn_shape, devices=np.asarray(devices))
+    except Exception as e:
+        if getattr(devices[0], "platform", "") == "tpu":
+            raise
+        logger.warning("create_hybrid_device_mesh failed (%s); slice-major "
+                       "reshape fallback", e)
+        # [dcn, per-group...] then merge dcn into dp (dp is outermost)
+        per = np.asarray(devices).reshape((dcn,) + shape)
+        dev_array = per.reshape((dcn * shape[0],) + shape[1:])
     return Mesh(dev_array, AXIS_ORDER)
 
 

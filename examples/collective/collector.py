@@ -35,6 +35,7 @@ from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.recovery import summarize_recovery
 from edl_tpu.cluster.status import Status, load_job_status, load_pods_status
 from edl_tpu.cluster.train_status import load_train_statuses
+from edl_tpu.collective.resource import load_resource_pods
 
 FIELDS = ["ts", "job_id", "job_status", "stage", "live_pods",
           "cluster_pods", "world_size", "pods_running", "train_status",
@@ -42,12 +43,15 @@ FIELDS = ["ts", "job_id", "job_status", "stage", "live_pods",
 
 TERMINAL_VALUES = {Status.SUCCEED.value, Status.FAILED.value}
 
+# consecutive poll failures after which a job is abandoned (transient
+# store blips ride through; a permanently unpollable job can't hang the
+# collector forever once every other job is terminal)
+MAX_CONSECUTIVE_FAILURES = 10
+
 
 def collect_row(store, job_id: str, now: float | None = None) -> dict:
     """One poll of everything the store knows about ``job_id``."""
     now = time.time() if now is None else now
-    from edl_tpu.collective.resource import load_resource_pods
-
     job = load_job_status(store, job_id)
     cluster = Cluster.load_from_store(store, job_id)
     live = load_resource_pods(store, job_id)
@@ -128,27 +132,34 @@ def main() -> None:
     try:
         # last-known status per job: a job whose poll failed this tick
         # must NOT drop out of the terminal check (its series would be
-        # silently truncated the moment the others finish)
+        # silently truncated the moment the others finish) — but a job
+        # that NEVER polls (corrupt record, dead store shard) is given
+        # up after MAX_CONSECUTIVE_FAILURES so the loop still terminates
         latest = {job: "N/A" for job in args.job_id}
+        failures = dict.fromkeys(args.job_id, 0)
         while True:
             tick += 1
             for job in args.job_id:
-                # a transient store RPC failure (most likely during the
-                # very resize window being measured) must not end the
-                # time series — log, skip the tick, poll again
+                if failures[job] >= MAX_CONSECUTIVE_FAILURES:
+                    continue  # given up (counted terminal below)
                 try:
                     row = collect_row(store, job)
                 except Exception as e:  # noqa: BLE001
-                    print(f"[collector] poll {job} failed: {e}",
-                          file=sys.stderr, flush=True)
+                    failures[job] += 1
+                    print(f"[collector] poll {job} failed "
+                          f"({failures[job]}/{MAX_CONSECUTIVE_FAILURES}):"
+                          f" {e}", file=sys.stderr, flush=True)
                     continue
+                failures[job] = 0
                 writer.writerow(row)
                 phases.observe(row)
                 latest[job] = row["job_status"]
             sink.flush()
             if args.max_ticks and tick >= args.max_ticks:
                 break
-            if all(s in TERMINAL_VALUES for s in latest.values()):
+            if all(s in TERMINAL_VALUES
+                   or failures[j] >= MAX_CONSECUTIVE_FAILURES
+                   for j, s in latest.items()):
                 break
             time.sleep(args.interval)
     finally:

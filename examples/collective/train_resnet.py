@@ -72,6 +72,9 @@ def parse_args():
                         "(reference profiled batches 100-105, "
                         "train_with_fleet.py:521-530)")
     p.add_argument("--profile_dir", type=str, default="")
+    p.add_argument("--dcn_dp", type=int, default=0,
+                   help="data-parallel replica groups across slices (DCN); "
+                        "0 = auto (one group per slice)")
     p.add_argument("--data_service", action="store_true",
                    help="read training data through the leader's "
                         "distributed DataService (elastic, exactly-once "
@@ -261,7 +264,7 @@ def main() -> None:
     if args.profile_steps:
         lo, _, hi = args.profile_steps.partition(":")
         profile_window = (int(lo), int(hi or int(lo) + 5))
-    cfg = TrainConfig(mesh_spec=MeshSpec(),
+    cfg = TrainConfig(mesh_spec=MeshSpec(dcn_dp=args.dcn_dp),
                       checkpoint_dir=tenv.checkpoint_dir,
                       save_every_steps=args.save_every_steps,
                       global_batch_size=global_batch, log_every=50,

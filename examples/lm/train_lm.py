@@ -48,6 +48,9 @@ def parse_args():
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "flash", "ring"])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--dcn_dp", type=int, default=0,
+                   help="data-parallel replica groups across slices (DCN); "
+                        "0 = auto (one group per slice)")
     p.add_argument("--fused_ce", action="store_true",
                    help="blockwise fused cross-entropy: never materialise "
                         "the [B, L, vocab] logits (edl_tpu/ops/ce.py)")
@@ -189,7 +192,7 @@ def main() -> None:
         if args.layers % args.pp:
             raise SystemExit(f"--layers {args.layers} must divide evenly "
                              f"over --pp {args.pp} stages")
-        spec = MeshSpec(dp=-1, pp=args.pp)
+        spec = MeshSpec(dp=-1, pp=args.pp, dcn_dp=args.dcn_dp)
         # microbatches must divide the per-dp-shard local batch; clamp to
         # the largest divisor <= requested so defaults never crash
         dp_size = max(1, n_dev // args.pp)
@@ -208,7 +211,8 @@ def main() -> None:
         free = max(1, n_dev // (args.fsdp * args.sp))
         tp = args.tp or (2 if free % 2 == 0 else 1)
         sp = args.sp
-        spec = MeshSpec(dp=-1, fsdp=args.fsdp, tp=tp, sp=sp)
+        spec = MeshSpec(dp=-1, fsdp=args.fsdp, tp=tp, sp=sp,
+                        dcn_dp=args.dcn_dp)
 
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,

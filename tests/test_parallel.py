@@ -79,3 +79,38 @@ def test_matmul_psum_over_tp_mesh():
     ws = jax.device_put(w, logical_sharding(("mlp", None), mesh, rules))
     out = jax.jit(lambda a, b: a @ b)(xs, ws)
     np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-4, atol=1e-4)
+
+
+def test_hybrid_mesh_dcn_groups():
+    """dcn_dp spreads replica groups across slices; dp = dcn x inner dp.
+    On CPU there are no slice indices, so this exercises the slice-major
+    reshape fallback; the resulting mesh must still run a sharded step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from edl_tpu.parallel.mesh import MeshSpec, batch_divisor, build_mesh
+    from edl_tpu.parallel.sharding import shard_host_batch
+
+    mesh = build_mesh(MeshSpec(dp=-1, tp=2, dcn_dp=2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    assert batch_divisor(mesh) == 4
+    g = shard_host_batch({"x": np.ones((8, 4), np.float32)}, mesh)
+    out = jax.jit(lambda b: b["x"].sum())(g)
+    assert float(out) == 32.0
+
+
+def test_hybrid_mesh_auto_single_slice():
+    from edl_tpu.parallel.mesh import MeshSpec, build_mesh, n_slices
+    import jax
+
+    assert n_slices(jax.devices()) == 1  # CPU: no slice_index attr
+    mesh = build_mesh(MeshSpec(dp=-1, dcn_dp=0))  # auto -> 1 group
+    assert mesh.shape["dp"] == 8
+
+
+def test_hybrid_mesh_bad_group_count():
+    import pytest
+    from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    with pytest.raises(ValueError, match="DCN groups"):
+        build_mesh(MeshSpec(dp=-1, dcn_dp=3))
