@@ -35,6 +35,9 @@ LOGICAL_RULES = [
     (r"layers/mlp_in/kernel", ("layers", "embed", "mlp")),
     (r"layers/mlp_gate/kernel", ("layers", "embed", "mlp")),
     (r"layers/mlp_out/kernel", ("layers", "mlp", "embed")),
+    (r"layers/moe/gate", ("layers", "embed", None)),
+    (r"layers/moe/w_in", ("layers", "expert", "embed", "expert_mlp")),
+    (r"layers/moe/w_out", ("layers", "expert", "expert_mlp", "embed")),
     (r"layers/.*norm/scale", ("layers", "norm")),
     (r"final_norm/scale", ("norm",)),
     (r"lm_head/kernel", ("embed", "vocab")),
@@ -55,6 +58,11 @@ class TransformerConfig:
     remat: bool = True
     rope_theta: float = 10_000.0
     tie_embeddings: bool = False
+    # mixture-of-experts MLP (ops/moe.py): 0 = dense MLP; > 0 routes
+    # every block's FFN over this many experts (shard over ``ep``)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -106,6 +114,13 @@ class Block(nn.Module):
         x = x + nn.DenseGeneral(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                                 param_dtype=jnp.float32, name="attn_out")(attn)
         y = RMSNorm(cfg.dtype, name="mlp_norm")(x)
+        if cfg.moe_experts:
+            from edl_tpu.ops.moe import MoEMLP
+            y, aux = MoEMLP(num_experts=cfg.moe_experts,
+                            mlp_dim=cfg.mlp_dim, top_k=cfg.moe_top_k,
+                            capacity_factor=cfg.moe_capacity,
+                            dtype=cfg.dtype, name="moe")(y)
+            return x + y, aux
         gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="mlp_gate")(y)
         up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
@@ -121,10 +136,12 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, train: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, with_aux: bool = False):
         """Logits [B, L, V] f32 — or, with ``return_hidden``, the
         final-norm hidden states [B, L, D] for the fused-CE loss path
-        (:func:`lm_loss_fused`), which never materialises the logits."""
+        (:func:`lm_loss_fused`), which never materialises the logits.
+        ``with_aux`` additionally returns the mean per-layer auxiliary
+        loss (the MoE load-balance term; 0 for dense MLP configs)."""
         cfg = self.cfg
         del train
         if positions is None:
@@ -139,20 +156,23 @@ class TransformerLM(nn.Module):
         Stack = nn.scan(block, variable_axes={"params": 0},
                         split_rngs={"params": True}, length=cfg.num_layers,
                         in_axes=nn.broadcast, metadata_params={})
-        x, _ = Stack(cfg, name="layers")(x, positions)
+        x, aux = Stack(cfg, name="layers")(x, positions)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        aux_total = (jnp.mean(aux) if aux is not None
+                     else jnp.zeros((), jnp.float32))
         if return_hidden:
             # NOTE: init() must run with the default return_hidden=False
             # so the lm_head params are created; apply() with extra
             # params present is fine in flax
-            return x
+            return (x, aux_total) if with_aux else x
         if cfg.tie_embeddings:
             embed = self.get_variable("params", "tok_embed")["embedding"]
             logits = x @ embed.T.astype(cfg.dtype)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, aux_total) if with_aux else logits
 
 
 def _masked_mean(nll, mask):

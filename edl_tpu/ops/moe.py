@@ -1,0 +1,103 @@
+"""Mixture-of-experts MLP with expert parallelism over the ``ep`` axis.
+
+Beyond-parity capability (the reference's only sparse structure is the
+CTR embedding table, example/ctr/): a GShard-style top-k-routed expert
+FFN designed for the compiler rather than hand-scheduled all-to-alls —
+routing is expressed as dense dispatch/combine einsums against expert
+weights whose leading axis carries the ``expert`` logical name (mapped
+to ``ep`` by the default sharding rules), so XLA derives the token
+shuffle collectives from the shardings the same way it derives the
+data-parallel gradient reduction.
+
+Shapes (per group = one batch row): tokens ``[B, S, M]``, experts
+``E``, per-expert capacity ``C = ceil(top_k * S * capacity_factor /
+E)``.  Tokens routed past an expert's capacity are dropped (their
+combine weight is zero — the standard GShard/Switch overflow rule), so
+every tensor is static-shaped for jit.
+
+The auxiliary load-balance loss is the Switch-Transformer form
+``E * Σ_e f_e · P_e`` (fraction of tokens top-1-routed to e × mean
+router probability of e); minimised at uniform routing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def compute_routing(probs, top_k: int, capacity: int):
+    """Routing tensors from router probabilities ``[B, S, E]``.
+
+    Returns ``(dispatch [B, S, E, C] in {0,1}, combine [B, S, E, C]
+    f32, aux_loss scalar)``.  Slot priority is k-major (every token's
+    first choice is placed before any token's second choice), positions
+    within an expert are sequence-ordered — deterministic, no RNG.
+    """
+    B, S, E = probs.shape
+    gates, idx = jax.lax.top_k(probs, top_k)              # [B, S, K]
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [B, S, K, E]
+
+    # k-major slot order: [B, K*S, E]
+    slots = onehot.transpose(0, 2, 1, 3).reshape(B, top_k * S, E)
+    pos = (jnp.cumsum(slots, axis=1) * slots).astype(jnp.int32) - 1
+    kept = (pos >= 0) & (pos < capacity)
+    pos_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * kept[..., None]
+    # back to token-major [B, S, K, E, C]; merge k (distinct (e, c) each)
+    pos_c = pos_c.reshape(B, top_k, S, E, capacity).transpose(0, 2, 1, 3, 4)
+    dispatch = pos_c.sum(axis=2)                          # [B, S, E, C]
+    combine = jnp.einsum("bske,bskec->bsec",
+                         onehot * gates[..., None], pos_c)
+
+    # Switch aux loss from top-1 assignments
+    top1 = jax.nn.one_hot(idx[..., 0], E, dtype=jnp.float32)
+    frac_tokens = top1.mean(axis=(0, 1))                  # [E]
+    frac_prob = probs.mean(axis=(0, 1))                   # [E]
+    aux = E * jnp.sum(frac_tokens * frac_prob)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN (drop-in for a transformer MLP block).
+
+    Returns ``(y [B, S, M], aux_loss scalar)``.  Expert weights carry
+    the ``expert`` leading logical axis; shard them over ``ep`` via the
+    default rules (LOGICAL_RULES in models/transformer.py adds the
+    matching param-path entries)."""
+
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, M = x.shape
+        E = self.num_experts
+        gate_w = self.param("gate", nn.initializers.lecun_normal(),
+                            (M, E), jnp.float32)
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          (E, M, self.mlp_dim), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           (E, self.mlp_dim, M), jnp.float32)
+
+        # router in f32 (tiny matmul, routing decisions precision-critical)
+        probs = jax.nn.softmax(x.astype(jnp.float32) @ gate_w, axis=-1)
+        capacity = max(1, math.ceil(
+            self.top_k * S * self.capacity_factor / E))
+        dispatch, combine, aux = compute_routing(probs, self.top_k, capacity)
+
+        dtype = self.dtype
+        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype),
+                               x.astype(dtype))
+        h = nn.silu(jnp.einsum("ebcm,emh->ebch", expert_in,
+                               w_in.astype(dtype)))
+        out = jnp.einsum("ebch,ehm->ebcm", h, w_out.astype(dtype))
+        y = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), out)
+        return y.astype(x.dtype), aux

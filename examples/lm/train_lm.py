@@ -48,6 +48,13 @@ def parse_args():
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "flash", "ring"])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--moe", type=int, default=0,
+                   help=">0 replaces each block's FFN with this many "
+                        "routed experts, sharded over the ep mesh axis")
+    p.add_argument("--moe_top_k", type=int, default=2)
+    p.add_argument("--moe_aux_weight", type=float, default=0.01)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh axis size (use with --moe)")
     p.add_argument("--dcn_dp", type=int, default=0,
                    help="data-parallel replica groups across slices (DCN); "
                         "0 = auto (one group per slice)")
@@ -205,20 +212,28 @@ def main() -> None:
                   f" -> {m} (local batch {local_batch})", flush=True)
         args.pp_microbatches = m
     else:
-        if args.fsdp < 1 or args.sp < 1:
-            raise SystemExit("--fsdp and --sp must be >= 1")
-        # auto-tp from the devices LEFT once fsdp/sp take their share
-        free = max(1, n_dev // (args.fsdp * args.sp))
+        if args.fsdp < 1 or args.sp < 1 or args.ep < 1:
+            raise SystemExit("--fsdp, --sp and --ep must be >= 1")
+        if args.ep > 1 and not args.moe:
+            raise SystemExit("--ep needs --moe (no expert weights to shard)")
+        # auto-tp from the devices LEFT once fsdp/sp/ep take their share
+        free = max(1, n_dev // (args.fsdp * args.sp * args.ep))
         tp = args.tp or (2 if free % 2 == 0 else 1)
         sp = args.sp
-        spec = MeshSpec(dp=-1, fsdp=args.fsdp, tp=tp, sp=sp,
+        spec = MeshSpec(dp=-1, fsdp=args.fsdp, tp=tp, sp=sp, ep=args.ep,
                         dcn_dp=args.dcn_dp)
 
+    if args.moe and args.pp > 1:
+        raise SystemExit("--moe is not supported by the --pp adapter")
+    if args.moe and args.moe_top_k > args.moe:
+        raise SystemExit(f"--moe_top_k {args.moe_top_k} cannot exceed "
+                         f"--moe {args.moe} experts")
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,
                             mlp_dim=args.mlp, max_len=args.seq_len,
                             attention_impl=args.attention,
                             remat=args.remat,
+                            moe_experts=args.moe, moe_top_k=args.moe_top_k,
                             dtype=jnp.bfloat16 if
                             jax.devices()[0].platform == "tpu"
                             else jnp.float32)
@@ -230,14 +245,26 @@ def main() -> None:
                          "the --pp adapter computes its own head")
 
     def loss_fn(params, extra, batch, rng):
+        # TransformerLM returns aux_total=0 for dense configs, so the
+        # pp==1 paths always ask for it; only the loss term is gated
+        metrics = {}
         if args.fused_ce:
             from edl_tpu.models.transformer import lm_loss_fused
-            h = model.apply({"params": params}, batch["ids"][:, :-1],
-                            return_hidden=True)
-            return lm_loss_fused(params, h, batch["ids"][:, 1:], cfg,
-                                 block_size=args.ce_block), (extra, {})
-        logits = model.apply({"params": params}, batch["ids"][:, :-1])
-        return lm_loss(logits, batch["ids"][:, 1:]), (extra, {})
+            h, aux = model.apply({"params": params}, batch["ids"][:, :-1],
+                                 return_hidden=True, with_aux=True)
+            loss = lm_loss_fused(params, h, batch["ids"][:, 1:], cfg,
+                                 block_size=args.ce_block)
+        elif args.pp > 1:
+            logits = model.apply({"params": params}, batch["ids"][:, :-1])
+            loss, aux = lm_loss(logits, batch["ids"][:, 1:]), None
+        else:
+            logits, aux = model.apply({"params": params},
+                                      batch["ids"][:, :-1], with_aux=True)
+            loss = lm_loss(logits, batch["ids"][:, 1:])
+        if args.moe:
+            loss = loss + args.moe_aux_weight * aux
+            metrics["moe_aux"] = aux
+        return loss, (extra, metrics)
 
     trconf = TrainConfig(mesh_spec=spec, checkpoint_dir=tenv.checkpoint_dir,
                          global_batch_size=args.batch_size * world,
