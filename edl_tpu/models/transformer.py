@@ -63,6 +63,10 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity: float = 1.25
+    # autoregressive decoding: attention reads/writes a per-layer KV
+    # cache ("cache" collection) instead of recomputing the prefix
+    # (models/generate.py drives this)
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -96,6 +100,37 @@ class Block(nn.Module):
 
     cfg: TransformerConfig
 
+    def _decode_attention(self, q, k, v):
+        """Incremental attention against a persistent KV cache sized
+        ``[B, max_len, H, D]``.  First call (init, or a fresh "cache"
+        collection) creates the zeroed cache; subsequent mutable-apply
+        calls append the new k/v at ``cache_index`` and attend the
+        queries against the whole written prefix (position mask also
+        excludes the not-yet-written tail).  Dense attention is the
+        right kernel here: decode is a [L=1] x [max_len] matvec."""
+        cfg = self.cfg
+        B, L, H, Dh = q.shape
+        is_initialized = self.has_variable("cache", "cached_key")
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, cfg.max_len, H, Dh), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, cfg.max_len, H, Dh), cfg.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if not is_initialized:      # init trace: shapes only
+            return dot_product_attention(q, k, v, causal=True, impl="dense")
+        idx = ci.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+        ci.value = idx + L
+        q_pos = idx + jnp.arange(L)
+        mask = (jnp.arange(cfg.max_len)[None, :]
+                <= q_pos[:, None])[None, None]      # [1, 1, L, max_len]
+        return dot_product_attention(q, ck.value, cv.value, impl="dense",
+                                     mask=mask)
+
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
@@ -108,8 +143,12 @@ class Block(nn.Module):
         q = rope(q.reshape(B, L, H, Dh), positions, cfg.rope_theta)
         k = rope(k.reshape(B, L, H, Dh), positions, cfg.rope_theta)
         v = v.reshape(B, L, H, Dh)
-        attn = dot_product_attention(q, k, v, causal=True,
-                                     impl=cfg.attention_impl, mesh=cfg.mesh)
+        if cfg.decode:
+            attn = self._decode_attention(q, k, v)
+        else:
+            attn = dot_product_attention(q, k, v, causal=True,
+                                         impl=cfg.attention_impl,
+                                         mesh=cfg.mesh)
         attn = attn.reshape(B, L, H * Dh)
         x = x + nn.DenseGeneral(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
                                 param_dtype=jnp.float32, name="attn_out")(attn)
@@ -153,7 +192,7 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False,
                              policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        Stack = nn.scan(block, variable_axes={"params": 0},
+        Stack = nn.scan(block, variable_axes={"params": 0, "cache": 0},
                         split_rngs={"params": True}, length=cfg.num_layers,
                         in_axes=nn.broadcast, metadata_params={})
         x, aux = Stack(cfg, name="layers")(x, positions)
