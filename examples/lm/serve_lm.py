@@ -1,0 +1,146 @@
+"""LM generation service: KV-cache decoding behind the teacher wire.
+
+The serving half of the LM workload — the reference only ever served
+classification-style teachers (Paddle Serving, README.md:51-64); here
+the same TPU serving stack (TeacherServer: EDL1 RPC, pad-to-bucket,
+request coalescing, TTL-leased discovery registration) hosts
+:func:`edl_tpu.models.generate.generate`.  Clients send
+``feed={"ids": [B, P] int32}`` and fetch ``["tokens"]`` →
+``[B, max_new_tokens]`` continuations.  Every prompt in a request must
+genuinely be P tokens long — do NOT right-pad shorter prompts (the
+model would condition on the pad tokens and decode from the position
+after them); send ragged prompts as separate requests, the server's
+coalescing shares forward passes between same-shape requests anyway.
+Each distinct (bucket, P) shape compiles once.
+
+Serve a trained checkpoint::
+
+    python examples/lm/serve_lm.py --coord_endpoints host:2379 \
+        --service lm --checkpoint_dir /ckpt/lm --layers 12 --embed 768 \
+        --max_new_tokens 64 --temperature 0.8 --top_k 40
+
+Query (see ``request()`` below, or any TeacherClient)::
+
+    from examples.lm.serve_lm import request
+    toks = request("host:port", np.array([[5, 3, 9]], np.int32))
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+import numpy as np
+
+
+def request(endpoint: str, prompts: np.ndarray, timeout: float = 120.0):
+    """One-shot client: ``[B, P]`` int32 prompts → generated tokens."""
+    from edl_tpu.distill.predict_client import TeacherClient
+
+    client = TeacherClient(endpoint, fetch=["tokens"], timeout=timeout)
+    try:
+        return client.predict({"ids": prompts.astype(np.int32)})["tokens"]
+    finally:
+        client.close()
+
+
+def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
+                     top_k: int):
+    """jitted (params, ids, rng) -> tokens, with a fresh fold per call
+    so temperature sampling differs between identical requests."""
+    import jax
+
+    from edl_tpu.models.generate import generate
+
+    @jax.jit
+    def gen(p, ids, rng):
+        return generate(cfg, p, ids, max_new_tokens, rng=rng,
+                        temperature=temperature, top_k=top_k)
+
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    def predict(feed: dict) -> dict:
+        with lock:
+            counter["n"] += 1
+            n = counter["n"]
+        rng = jax.random.fold_in(jax.random.key(20_26), n)
+        toks = gen(params, feed["ids"].astype(np.int32), rng)
+        return {"tokens": np.asarray(toks)}
+
+    return predict
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coord_endpoints", default="",
+                   help="register under --service when set")
+    p.add_argument("--service", default="lm")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--checkpoint_dir", default="",
+                   help="restore trained params (else random init — demo)")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--embed", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--mlp", type=int, default=256)
+    p.add_argument("--max_len", type=int, default=512)
+    p.add_argument("--max_new_tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top_k", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.distill.teacher import TeacherServer
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, num_layers=args.layers, embed_dim=args.embed,
+        num_heads=args.heads, mlp_dim=args.mlp, max_len=args.max_len,
+        remat=False, dtype=jnp.bfloat16
+        if jax.devices()[0].platform == "tpu" else jnp.float32)
+    model = TransformerLM(cfg)
+
+    def init_params():
+        return model.init(jax.random.key(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+
+    if args.checkpoint_dir:
+        # the checkpoint holds train_lm's full TrainState; mirror its
+        # optimizer (adamw — hyperparameters don't affect the tree
+        # structure) to shape the restore, then keep only the params.
+        # All under eval_shape: nothing is materialised before restore.
+        import optax
+
+        from edl_tpu.train.checkpoint import CheckpointManager
+        from edl_tpu.train.state import TrainState
+        skeleton = jax.eval_shape(
+            lambda: TrainState.create(init_params(), optax.adamw(1e-3)))
+        restored = CheckpointManager(args.checkpoint_dir).restore(skeleton)
+        if restored is None:
+            raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+        params = restored[0].params
+    else:
+        params = init_params()    # random weights: wiring demo only
+
+    predict = build_predict_fn(cfg, params, args.max_new_tokens,
+                               args.temperature, args.top_k)
+    server = TeacherServer(predict, port=args.port)
+    if args.coord_endpoints:
+        from edl_tpu.coord.client import connect
+        server.register(connect(args.coord_endpoints), args.service)
+    print(f"[serve_lm] serving on {server.endpoint} "
+          f"(max_new_tokens={args.max_new_tokens})", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
