@@ -22,6 +22,20 @@ import jax.numpy as jnp
 from edl_tpu.models.transformer import TransformerConfig, TransformerLM
 
 
+def _split_layer_params(params, num_layers: int):
+    """Trained params stack the decoder layers (nn.scan, leading dim =
+    num_layers); the decode model unrolls them into per-layer modules
+    (layer_0..layer_N-1) so every layer's KV cache is a separate buffer
+    XLA can update in place inside the generation loop."""
+    if "layers" not in params:      # already split
+        return params
+    stacked = params["layers"]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(num_layers):
+        out[f"layer_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+    return out
+
+
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              *, rng=None, temperature: float = 1.0, top_k: int = 0):
     """Sample ``[B, max_new_tokens]`` continuations of ``prompt [B, P]``.
@@ -47,6 +61,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     dcfg = dataclasses.replace(cfg, decode=True, attention_impl="dense",
                                mesh=None)
     model = TransformerLM(dcfg)
+    params = _split_layer_params(params, cfg.num_layers)
     rng = jax.random.key(0) if rng is None else rng
 
     # zeroed caches at [B, max_len], sized WITHOUT materialising params

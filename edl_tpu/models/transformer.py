@@ -101,35 +101,48 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     def _decode_attention(self, q, k, v):
-        """Incremental attention against a persistent KV cache sized
-        ``[B, max_len, H, D]``.  First call (init, or a fresh "cache"
-        collection) creates the zeroed cache; subsequent mutable-apply
-        calls append the new k/v at ``cache_index`` and attend the
-        queries against the whole written prefix (position mask also
-        excludes the not-yet-written tail).  Dense attention is the
-        right kernel here: decode is a [L=1] x [max_len] matvec."""
+        """Incremental attention against a persistent KV cache.  First
+        call (init, or a fresh "cache" collection) creates the zeroed
+        cache; subsequent mutable-apply calls append the new k/v at
+        ``cache_index`` and attend the queries against the whole written
+        prefix (the position mask also excludes the not-yet-written
+        tail).
+
+        Cache layouts match the two attention matmuls exactly — keys
+        ``[B, H, D, max_len]`` (contraction over D, time on the lane
+        axis) and values ``[B, H, max_len, D]`` — so reading the cache
+        each step is a straight matmul operand with NO full-cache
+        transpose; only the tiny new slab is rearranged on write."""
         cfg = self.cfg
         B, L, H, Dh = q.shape
         is_initialized = self.has_variable("cache", "cached_key")
         ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (B, cfg.max_len, H, Dh), cfg.dtype)
+                           (B, H, Dh, cfg.max_len), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (B, cfg.max_len, H, Dh), cfg.dtype)
+                           (B, H, cfg.max_len, Dh), cfg.dtype)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         if not is_initialized:      # init trace: shapes only
             return dot_product_attention(q, k, v, causal=True, impl="dense")
         idx = ci.value
         ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            ck.value, k.transpose(0, 2, 3, 1).astype(cfg.dtype),
+            (0, 0, 0, idx))
         cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cv.value, v.transpose(0, 2, 1, 3).astype(cfg.dtype),
+            (0, 0, idx, 0))
         ci.value = idx + L
         q_pos = idx + jnp.arange(L)
-        mask = (jnp.arange(cfg.max_len)[None, :]
-                <= q_pos[:, None])[None, None]      # [1, 1, L, max_len]
-        return dot_product_attention(q, ck.value, cv.value, impl="dense",
-                                     mask=mask)
+        mask = jnp.arange(cfg.max_len)[None, :] <= q_pos[:, None]  # [L, max]
+        scale = Dh ** -0.5
+        # precision recipe matches dense_attention exactly (input-dtype
+        # matmuls, f32 softmax) so cached decode stays bit-identical to
+        # the full-prefix forward in bf16 too
+        logits = jnp.einsum("blhd,bhdk->bhlk", q, ck.value
+                            ).astype(jnp.float32) * scale
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhlk,bhkd->blhd", weights, cv.value)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -188,14 +201,27 @@ class TransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, param_dtype=jnp.float32,
                      dtype=cfg.dtype, name="tok_embed")(ids)
 
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        Stack = nn.scan(block, variable_axes={"params": 0, "cache": 0},
-                        split_rngs={"params": True}, length=cfg.num_layers,
-                        in_axes=nn.broadcast, metadata_params={})
-        x, aux = Stack(cfg, name="layers")(x, positions)
+        if cfg.decode:
+            # unrolled layers with SEPARATE per-layer caches: inside the
+            # token-generation while-loop XLA aliases each [B, H, D, max]
+            # cache buffer in place.  The scanned (stacked) layout forced
+            # a full copy of the 12-layer cache tensor per decoded token
+            # — measured 10ms/step of pure copy at the flagship config.
+            # generate() splits the trained stacked params to match
+            # (models/generate.py _split_layer_params).
+            aux = None
+            for i in range(cfg.num_layers):
+                x, _ = Block(cfg, name=f"layer_{i}")(x, positions)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, prevent_cse=False,
+                                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            Stack = nn.scan(block, variable_axes={"params": 0, "cache": 0},
+                            split_rngs={"params": True},
+                            length=cfg.num_layers,
+                            in_axes=nn.broadcast, metadata_params={})
+            x, aux = Stack(cfg, name="layers")(x, positions)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
         aux_total = (jnp.mean(aux) if aux is not None
                      else jnp.zeros((), jnp.float32))

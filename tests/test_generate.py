@@ -85,3 +85,19 @@ def test_bad_args_rejected():
     moe_cfg = dataclasses.replace(CFG, moe_experts=2, moe_top_k=1)
     with pytest.raises(NotImplementedError, match="MoE"):
         generate(moe_cfg, params, prompt, 2)
+
+
+def test_cached_greedy_matches_full_recompute_bf16():
+    """The precision recipe (input-dtype matmuls, f32 softmax) must keep
+    cached decode token-identical to the full-prefix forward in bf16."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, dtype=jnp.bfloat16)
+    model = TransformerLM(cfg)
+    ids = jnp.zeros((2, 4), jnp.int32)
+    params = model.init(jax.random.key(2), ids)["params"]
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 61, (2, 6)), jnp.int32)
+    want = _greedy_full_recompute(model, params, prompt, 6)
+    got = generate(cfg, params, prompt, 6, temperature=0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
