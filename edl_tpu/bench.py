@@ -187,11 +187,11 @@ def main() -> None:
     # -- LM flagship: tokens/s/chip (secondary metric) -----------------------
     # defaults are flagship-sized (124M params), so off the TPU this only
     # runs when explicitly requested (a CPU smoke run would take hours)
-    lm_tokens_s_chip = None
+    lm_metrics = {}
     lm_default = "1" if jax.devices()[0].platform == "tpu" else "0"
     if os.environ.get("EDL_TPU_BENCH_LM", lm_default) != "0":
         try:
-            lm_tokens_s_chip = _bench_lm(n_dev)
+            lm_metrics = _bench_lm(n_dev)
         except Exception:  # noqa: BLE001 — secondary metric, never fatal
             import traceback
             traceback.print_exc()
@@ -214,16 +214,16 @@ def main() -> None:
         out["tflops_per_chip"] = round(tflops_chip, 1)
     if mfu is not None:
         out["mfu"] = round(mfu, 3)
-    if lm_tokens_s_chip is not None:
-        out["lm_tokens_s_per_chip"] = round(lm_tokens_s_chip, 0)
+    out.update(lm_metrics)
     print(json.dumps(out))
 
 
-def _bench_lm(n_dev: int) -> float:
-    """Flagship TransformerLM training throughput (tokens/s/chip):
-    default 124M-param config (12L × 768, vocab 32k, seq 1024), bf16,
-    remat, flash attention on TPU, fused blockwise CE — through
-    ElasticTrainer on a dp mesh like the headline bench."""
+def _bench_lm(n_dev: int) -> dict:
+    """Flagship TransformerLM throughput: training tokens/s/chip
+    (default 124M-param config — 12L × 768, vocab 32k, seq 1024 — bf16,
+    flash attention on TPU, fused blockwise CE, through ElasticTrainer
+    on a dp mesh like the headline bench) plus batched KV-cache decode
+    tokens/s on the trained state (models/generate.py)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -277,7 +277,24 @@ def _bench_lm(n_dev: int) -> float:
         state, metrics = tr.step_fn(state, gbatch, rng)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
-    return bs * seq * n_steps / dt / n_dev
+    out = {"lm_tokens_s_per_chip": round(bs * seq * n_steps / dt / n_dev)}
+
+    if os.environ.get("EDL_TPU_BENCH_DECODE", "1") != "0":
+        from edl_tpu.models.generate import generate
+        B = min(8, ids.shape[0])
+        # scale prompt/new to whatever seq the run was configured with
+        plen = max(1, min(128, seq // 2))
+        new = max(1, min(128, seq - plen))
+        prompt = jnp.asarray(ids[:B, :plen])
+        g = jax.jit(lambda p, i, r: generate(cfg, p, i, new, rng=r,
+                                             temperature=0.8, top_k=40))
+        np.asarray(g(state.params, prompt, jax.random.key(4)))  # compile
+        t0 = time.perf_counter()
+        np.asarray(g(state.params, prompt, jax.random.key(5)))
+        out["lm_decode_tokens_s"] = round(
+            B * new / (time.perf_counter() - t0))
+        out["lm_decode_batch"] = B
+    return out
 
 
 if __name__ == "__main__":

@@ -93,9 +93,7 @@ def test_cached_greedy_matches_full_recompute_bf16():
     import dataclasses
 
     cfg = dataclasses.replace(CFG, dtype=jnp.bfloat16)
-    model = TransformerLM(cfg)
-    ids = jnp.zeros((2, 4), jnp.int32)
-    params = model.init(jax.random.key(2), ids)["params"]
+    model, params = _model_and_params(cfg, seed=2)
     prompt = jnp.asarray(
         np.random.default_rng(7).integers(0, 61, (2, 6)), jnp.int32)
     want = _greedy_full_recompute(model, params, prompt, 6)
