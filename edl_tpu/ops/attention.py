@@ -66,6 +66,22 @@ def _flash_ok(q, k) -> bool:
     return Lq % 128 == 0 and Lk % 128 == 0 and D % 64 == 0
 
 
+_warned_shapes: set[tuple[int, int, int]] = set()
+
+
+def _warn_downgrade(lq: int, lk: int, d: int) -> None:
+    """Loud downgrade (perf-sensitive users must see it), but once per
+    shape — init/trace passes with tiny shapes would otherwise repeat
+    it on every model build."""
+    if (lq, lk, d) in _warned_shapes:
+        return
+    _warned_shapes.add((lq, lk, d))
+    from edl_tpu.utils.logger import get_logger
+    get_logger(__name__).warning(
+        "attention auto: shapes L=%d/%d D=%d not tileable for the pallas "
+        "flash kernel; using dense", lq, lk, d)
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           sm_scale: float | None = None,
                           mask=None, impl: str = "auto",
@@ -79,12 +95,7 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
             impl = "flash"
         else:
             if _on_tpu() and mask is None:
-                # loud downgrade: perf-sensitive users must see this
-                import logging
-                logging.getLogger(__name__).warning(
-                    "attention auto: shapes L=%d/%d D=%d not tileable for "
-                    "the pallas flash kernel; using dense",
-                    q.shape[1], k.shape[1], q.shape[3])
+                _warn_downgrade(q.shape[1], k.shape[1], q.shape[3])
             impl = "dense"
     if impl == "ring":
         if mesh is None:
