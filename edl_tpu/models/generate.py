@@ -52,12 +52,11 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         raise ValueError(
             f"prompt {P} + new {max_new_tokens} exceeds max_len "
             f"{cfg.max_len} (the KV cache size)")
-    if cfg.moe_experts:
-        # per-step routing sees capacity-1 groups, so drop patterns (and
-        # therefore logits) would diverge from the full-prefix forward —
-        # the exact-match contract below cannot hold for MoE configs
-        raise NotImplementedError(
-            "generate() does not support MoE configs yet")
+    # MoE configs decode with per-token expert gather (ops/moe.py
+    # decode=True): no capacity machinery, so output matches the
+    # training forward exactly whenever training capacity dropped
+    # nothing (ample capacity_factor); when training did drop overflow
+    # tokens, decode is the drop-free ideal rather than a replica.
     dcfg = dataclasses.replace(cfg, decode=True, attention_impl="dense",
                                mesh=None)
     model = TransformerLM(dcfg)

@@ -171,7 +171,8 @@ class Block(nn.Module):
             y, aux = MoEMLP(num_experts=cfg.moe_experts,
                             mlp_dim=cfg.mlp_dim, top_k=cfg.moe_top_k,
                             capacity_factor=cfg.moe_capacity,
-                            dtype=cfg.dtype, name="moe")(y)
+                            dtype=cfg.dtype, decode=cfg.decode,
+                            name="moe")(y)
             return x + y, aux
         gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="mlp_gate")(y)

@@ -68,13 +68,20 @@ class MoEMLP(nn.Module):
     Returns ``(y [B, S, M], aux_loss scalar)``.  Expert weights carry
     the ``expert`` leading logical axis; shard them over ``ep`` via the
     default rules (LOGICAL_RULES in models/transformer.py adds the
-    matching param-path entries)."""
+    matching param-path entries).
+
+    ``decode=True`` (incremental generation, S small) switches to
+    per-token expert gather: each token reads exactly its top-k
+    experts' weights, no capacity machinery and therefore no drops —
+    identical to the training forward whenever training capacity
+    dropped nothing."""
 
     num_experts: int
     mlp_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -89,15 +96,36 @@ class MoEMLP(nn.Module):
 
         # router in f32 (tiny matmul, routing decisions precision-critical)
         probs = jax.nn.softmax(x.astype(jnp.float32) @ gate_w, axis=-1)
+        dtype = self.dtype
+
+        # per-token gather only for the incremental steps (S tiny): it
+        # materialises [B, S, K, M, H] gathered weights, ruinous at
+        # prefill length.  Prefill (decode=True, S = prompt) falls
+        # through to the capacity path — the training forward's exact
+        # semantics, which is what the prompt pass should be anyway.
+        if self.decode and S * self.top_k <= 8:
+            gates, idx = jax.lax.top_k(probs, self.top_k)     # [B, S, K]
+            gates = gates / jnp.maximum(
+                gates.sum(-1, keepdims=True), 1e-9)
+            sel_in = w_in[idx].astype(dtype)                  # [B,S,K,M,H]
+            sel_out = w_out[idx].astype(dtype)                # [B,S,K,H,M]
+            h = nn.silu(jnp.einsum("bsm,bskmh->bskh",
+                                   x.astype(dtype), sel_in))
+            out = jnp.einsum("bskh,bskhm->bskm", h, sel_out)
+            y = (out * gates[..., None].astype(dtype)).sum(axis=2)
+            # module dtype, not input dtype: the block's norm emits f32
+            # (f32 scale param), and a f32 MoE output would promote the
+            # residual stream out of bf16 on TPU
+            return y.astype(dtype), jnp.zeros((), jnp.float32)
+
         capacity = max(1, math.ceil(
             self.top_k * S * self.capacity_factor / E))
         dispatch, combine, aux = compute_routing(probs, self.top_k, capacity)
 
-        dtype = self.dtype
         expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype),
                                x.astype(dtype))
         h = nn.silu(jnp.einsum("ebcm,emh->ebch", expert_in,
                                w_in.astype(dtype)))
         out = jnp.einsum("ebch,ehm->ebcm", h, w_out.astype(dtype))
         y = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), out)
-        return y.astype(x.dtype), aux
+        return y.astype(dtype), aux

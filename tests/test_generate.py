@@ -76,15 +76,25 @@ def test_overflow_guard():
 
 
 def test_bad_args_rejected():
-    import dataclasses
-
     _, params = _model_and_params()
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(CFG, params, prompt, 0)
-    moe_cfg = dataclasses.replace(CFG, moe_experts=2, moe_top_k=1)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        generate(moe_cfg, params, prompt, 2)
+
+
+def test_moe_cached_greedy_matches_full_recompute():
+    """With ample training capacity (nothing dropped), per-token decode
+    routing must reproduce the capacity-based training forward."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, moe_experts=4, moe_top_k=2,
+                              moe_capacity=4.0)
+    model, params = _model_and_params(cfg, seed=4)
+    prompt = jnp.asarray(
+        np.random.default_rng(9).integers(0, 61, (2, 5)), jnp.int32)
+    want = _greedy_full_recompute(model, params, prompt, 6)
+    got = generate(cfg, params, prompt, 6, temperature=0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_cached_greedy_matches_full_recompute_bf16():
@@ -96,6 +106,21 @@ def test_cached_greedy_matches_full_recompute_bf16():
     model, params = _model_and_params(cfg, seed=2)
     prompt = jnp.asarray(
         np.random.default_rng(7).integers(0, 61, (2, 6)), jnp.int32)
+    want = _greedy_full_recompute(model, params, prompt, 6)
+    got = generate(cfg, params, prompt, 6, temperature=0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_moe_cached_greedy_matches_full_recompute_bf16():
+    """bf16 MoE: gather-path decode must stay token-identical to the
+    capacity-path training forward (ample capacity, nothing dropped)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, dtype=jnp.bfloat16, moe_experts=4,
+                              moe_top_k=2, moe_capacity=4.0)
+    model, params = _model_and_params(cfg, seed=5)
+    prompt = jnp.asarray(
+        np.random.default_rng(11).integers(0, 61, (2, 5)), jnp.int32)
     want = _greedy_full_recompute(model, params, prompt, 6)
     got = generate(cfg, params, prompt, 6, temperature=0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
