@@ -21,6 +21,7 @@ from edl_tpu.models.vgg import VGG, VGG16
 from edl_tpu.models.wide_deep import WideDeep
 from edl_tpu.models.text import BowClassifier, CnnClassifier, TextTransformer
 from edl_tpu.models.transformer import TransformerLM, TransformerConfig
+from edl_tpu.models.generate import generate
 
 __all__ = [
     "logical_axes_from_paths",
@@ -28,5 +29,5 @@ __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet50vd",
     "VGG", "VGG16", "WideDeep",
     "BowClassifier", "CnnClassifier", "TextTransformer",
-    "TransformerLM", "TransformerConfig",
+    "TransformerLM", "TransformerConfig", "generate",
 ]

@@ -19,6 +19,13 @@ if [[ "${FULL:-0}" == "1" ]]; then
     python examples/collective/recovery_bench.py
 fi
 
+# bench smoke: the driver's bench entry must always produce its JSON
+# line (tiny CPU knobs; LM/pipeline sections skipped off-TPU)
+EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
+EDL_TPU_BENCH_WIDTH=8 EDL_TPU_BENCH_PIPELINE=0 EDL_TPU_BENCH_LM=0 \
+JAX_PLATFORMS=cpu python bench.py | tail -1 \
+    | python -c "import json,sys; json.loads(sys.stdin.read()); print('bench smoke OK')"
+
 # packaging sanity: console scripts resolve
 edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
