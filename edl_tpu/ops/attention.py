@@ -85,11 +85,13 @@ def _warn_downgrade(lq: int, lk: int, d: int) -> None:
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           sm_scale: float | None = None,
                           mask=None, impl: str = "auto",
-                          mesh=None, sp_axis: str = "sp"):
+                          mesh=None, sp_axis: str = "sp",
+                          ring_kv_chunk: int = 1024):
     """[B, L, H, D] attention with implementation dispatch (see module
     docstring).  ``mask`` (dense-only) broadcasts against [B, H, Lq, Lk];
     ``impl="ring"`` requires ``mesh`` and shards the sequence over
-    ``sp_axis``."""
+    ``sp_axis`` (``ring_kv_chunk`` bounds its inner logits tile; 0
+    disables chunking)."""
     if impl == "auto":
         if _on_tpu() and mask is None and _flash_ok(q, k):
             impl = "flash"
@@ -102,7 +104,8 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
             raise ValueError("impl='ring' needs the mesh")
         from edl_tpu.ops.ring import ring_attention
         return ring_attention(q, k, v, mesh, causal=causal,
-                              sm_scale=sm_scale, sp_axis=sp_axis)
+                              sm_scale=sm_scale, sp_axis=sp_axis,
+                              kv_chunk=ring_kv_chunk)
     if impl == "flash":
         scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
         return _flash(q, k, v, causal, scale)

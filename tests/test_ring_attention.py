@@ -67,3 +67,27 @@ def test_dispatch_requires_mesh_for_ring():
     q, k, v = _qkv(L=8)
     with pytest.raises(ValueError, match="needs the mesh"):
         dot_product_attention(q, k, v, impl="ring")
+
+
+@pytest.mark.parametrize("chunk", [4, 5, 7, 32])
+def test_ring_kv_chunking_exact(chunk):
+    """Chunked inner folds == unchunked == dense, any divisor outcome."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    q, k, v = _qkv()
+    expected = dense_attention(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, causal=True, kv_chunk=chunk))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_kv_chunking_with_masked_tail():
+    """Non-divisor shard lengths use ceil chunks + a masked pad tail."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    q, k, v = _qkv(B=2, L=28, H=2, D=8)  # 7 per shard: 7 = 2*3+1 w/ chunk 3
+    for causal in (False, True):
+        expected = dense_attention(q, k, v, causal=causal)
+        out = jax.jit(lambda a, b, c, cz=causal: ring_attention(
+            a, b, c, mesh, causal=cz, kv_chunk=3))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5)
