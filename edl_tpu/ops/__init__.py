@@ -8,8 +8,11 @@ ring collectives for long-context attention over the ``sp`` mesh axis.
 """
 
 from edl_tpu.ops.attention import dense_attention, dot_product_attention
+from edl_tpu.ops.ce import blockwise_cross_entropy
+from edl_tpu.ops.moe import MoEMLP
 from edl_tpu.ops.pipeline import pipeline_apply
 from edl_tpu.ops.ring import ring_attention
 
-__all__ = ["dense_attention", "dot_product_attention", "pipeline_apply",
+__all__ = ["dense_attention", "dot_product_attention",
+           "blockwise_cross_entropy", "MoEMLP", "pipeline_apply",
            "ring_attention"]
