@@ -1,0 +1,42 @@
+"""Per-pod trainer liveness beats — the hang-detection half the
+reference never had (its failure detection was exit-code watching +
+TTL leases, SURVEY.md §5: a deadlocked trainer holding its process
+alive was invisible).
+
+The trainer's rank-0-in-pod process writes a timestamp after each
+completed step (throttled, ElasticTrainer); the pod's launcher compares
+staleness against ``EDL_TPU_HANG_TIMEOUT`` and restarts its trainers in
+place when the beat goes silent.  The watchdog only engages after the
+FIRST beat, so long XLA compiles before step 1 can never be mistaken
+for a hang.
+"""
+
+from __future__ import annotations
+
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+def _key(job_id: str, pod_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_HEARTBEAT, pod_id)
+
+
+def beat(store, job_id: str, pod_id: str, now: float | None = None) -> None:
+    store.put(_key(job_id, pod_id),
+              repr(time.time() if now is None else now).encode())
+
+
+def last_beat(store, job_id: str, pod_id: str) -> float | None:
+    rec = store.get(_key(job_id, pod_id))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return float(rec.value.decode())
+    except ValueError:
+        return None
+
+
+def clear(store, job_id: str, pod_id: str) -> None:
+    store.delete(_key(job_id, pod_id))

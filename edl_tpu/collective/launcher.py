@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import time
 
+from edl_tpu.cluster import heartbeat
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.env import JobEnv
 from edl_tpu.cluster.pod import Pod
@@ -106,7 +107,7 @@ class Launcher:
                 self._write_recovery(cluster.stage, resize_times)
                 resize_times = None
             try:
-                verdict = self._supervise(watcher)
+                verdict = self._supervise(watcher, cluster)
             finally:
                 watcher.stop()
             if verdict is not None:
@@ -117,6 +118,8 @@ class Launcher:
             logger.info("membership changed; re-barrier + restart trainers")
             resize_times = {"detect": time.time()}
             self._shutdown_trainers()
+            # a pre-resize beat must not look stale to the new stage
+            self._clear_heartbeat()
             resize_times["killed"] = time.time()
             old_pods = set(cluster.pod_ids())
             cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
@@ -129,7 +132,8 @@ class Launcher:
             for dead in old_pods - set(cluster.pod_ids()):
                 self._data_service.mark_pod_dead(dead)
 
-    def _supervise(self, watcher: ClusterWatcher) -> Status | None:
+    def _supervise(self, watcher: ClusterWatcher, cluster: Cluster
+                   ) -> Status | None:
         """Returns final status, or None on membership change (resize).
 
         A nonzero local trainer exit does not fail the job immediately:
@@ -139,8 +143,14 @@ class Launcher:
         watcher).  So a local failure opens a grace window; if a
         membership change arrives inside it, this is collateral damage
         and we take the stop-resume path instead of declaring FAILED.
+
+        Hang watchdog (EDL_TPU_HANG_TIMEOUT > 0): a trainer whose
+        per-step heartbeat goes stale — a silent deadlock that exit-code
+        watching can never see — is killed and respawned in place
+        against the SAME cluster, up to HANG_MAX_RESTARTS per stage.
         """
         fail_deadline = None
+        hang_restarts = 0
         while True:
             local = train_process.watch_procs(self._procs)
             if local == Status.SUCCEED:
@@ -159,7 +169,52 @@ class Launcher:
                     fail_deadline = time.monotonic() + grace
                 elif time.monotonic() >= fail_deadline:
                     return Status.FAILED
+            elif self._hung(cluster):
+                hang_restarts += 1
+                if hang_restarts > constants.HANG_MAX_RESTARTS:
+                    logger.error(
+                        "trainers hung %d times this stage (%d restarts "
+                        "attempted); failing pod", hang_restarts,
+                        constants.HANG_MAX_RESTARTS)
+                    return Status.FAILED
+                logger.error(
+                    "trainer heartbeat stale > %.1fs; in-place restart "
+                    "%d/%d", constants.HANG_TIMEOUT, hang_restarts,
+                    constants.HANG_MAX_RESTARTS)
+                self._shutdown_trainers()
+                self._clear_heartbeat()
+                self._procs = train_process.start_trainers(
+                    self._job_env, self._pod, cluster, self._script,
+                    self._script_args, self._log_dir())
             time.sleep(self._period)
+
+    def _hung(self, cluster: Cluster | None) -> bool:
+        """True when this pod's trainer heartbeat exists and is stale.
+        No beat yet = not engaged (first XLA compile can be long).
+
+        Only engaged for single-pod clusters: in a multi-pod job a hang
+        stalls EVERY pod's collectives, and an uncoordinated local kill
+        would crash the peers (lost coordinator) without any membership
+        change to recover through — that needs a coordinated restart,
+        not a per-pod watchdog."""
+        if constants.HANG_TIMEOUT <= 0:
+            return False
+        if cluster is not None and len(cluster.pods) > 1:
+            return False
+        try:
+            hb = heartbeat.last_beat(self._store, self._job_env.job_id,
+                                     self._pod.pod_id)
+        except Exception:  # noqa: BLE001 — a store blip is not a hang
+            logger.exception("heartbeat read failed")
+            return False
+        return hb is not None and time.time() - hb > constants.HANG_TIMEOUT
+
+    def _clear_heartbeat(self) -> None:
+        try:
+            heartbeat.clear(self._store, self._job_env.job_id,
+                            self._pod.pod_id)
+        except Exception:  # noqa: BLE001 — best-effort, like _hung
+            logger.exception("heartbeat clear failed")
 
     def _fail_grace(self) -> float:
         """Long enough for a peer death to surface as a membership change:

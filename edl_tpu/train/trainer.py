@@ -63,6 +63,10 @@ class TrainConfig:
     # train_with_fleet.py:521-530 profiled batches 100-105
     profile_window: tuple[int, int] | None = None
     profile_dir: str = ""
+    # liveness beat to the coordination store after completed steps
+    # (throttled to this period; consumed by the launcher's hang
+    # watchdog, EDL_TPU_HANG_TIMEOUT); 0 disables
+    heartbeat_every: float = 10.0
 
 
 class ElasticTrainer:
@@ -237,6 +241,7 @@ class ElasticTrainer:
             n_steps += 1
             if self._t_restored is not None:
                 self._report_recovery(metrics)
+            self._heartbeat()
             step = start_step + n_steps
             if self.cfg.log_every and step % self.cfg.log_every == 0:
                 logger.info("epoch %d step %d: %s", epoch, step,
@@ -366,6 +371,35 @@ class ElasticTrainer:
                             "first_step": time.time()}).encode())
         except Exception:  # noqa: BLE001 — metrics must never fail a job
             logger.exception("recovery record write failed")
+
+    _last_beat = 0.0
+
+    def _heartbeat(self) -> None:
+        """Throttled liveness beat after a completed step (rank 0 in
+        the pod) — feeds the launcher's hang watchdog.  The first beat
+        only happens after step 1 finishes, so the watchdog can never
+        mistake the initial XLA compile for a hang.  Best-effort."""
+        if (not self.cfg.heartbeat_every or self.store is None
+                or self.tenv is None or not self.tenv.pod_id
+                or self.tenv.rank_in_pod != 0):
+            return
+        # auto-couple to the watchdog: beat at least 3x faster than the
+        # configured stale threshold, whatever heartbeat_every says —
+        # a HANG_TIMEOUT below the throttle must never kill a healthy
+        # trainer (both sides read the same EDL_TPU_HANG_TIMEOUT env)
+        from edl_tpu.utils import constants as _c
+        every = self.cfg.heartbeat_every
+        if _c.HANG_TIMEOUT > 0:
+            every = min(every, _c.HANG_TIMEOUT / 3.0)
+        now = time.monotonic()
+        if now - self._last_beat < every:
+            return
+        self._last_beat = now
+        try:
+            from edl_tpu.cluster import heartbeat
+            heartbeat.beat(self.store, self.tenv.job_id, self.tenv.pod_id)
+        except Exception:  # noqa: BLE001 — liveness must never fail a job
+            logger.exception("heartbeat write failed")
 
     def _sync_data_checkpoint(self, meta: State) -> None:
         """Before every save, merge all processes' consumed data spans —

@@ -16,6 +16,7 @@ ETCD_READER = "reader"              # distributed-reader registry
 ETCD_STATE = "state"                # train State (data checkpoint etc.)
 ETCD_DIST_READER = "dist_reader"
 ETCD_RECOVERY = "recovery"          # per-stage resize timing records
+ETCD_HEARTBEAT = "heartbeat"        # per-pod trainer liveness beats
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -28,6 +29,7 @@ ALL_TABLES = [
     ETCD_STATE,
     ETCD_DIST_READER,
     ETCD_RECOVERY,
+    ETCD_HEARTBEAT,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
@@ -61,3 +63,15 @@ FAIL_GRACE = _f("EDL_TPU_FAIL_GRACE", -1.0)
 # cap on the leader's wait for member pods' final statuses before it
 # writes the job flag from what it sees (launcher._leader_final_verdict)
 VERDICT_TIMEOUT = _f("EDL_TPU_VERDICT_TIMEOUT", 600.0)
+# hang watchdog: the launcher restarts its trainers when the pod's
+# trainer heartbeat (written per step by ElasticTrainer) goes stale by
+# more than this many seconds.  0 = disabled (the default: exit-code
+# watching catches crashes; this catches silent deadlocks).  Set it
+# comfortably above the longest expected step + XLA compile; the
+# trainer automatically beats at least 3x faster than this threshold,
+# so the throttle can never outpace the watchdog.  Single-pod clusters
+# only (launcher._hung explains why).
+HANG_TIMEOUT = _f("EDL_TPU_HANG_TIMEOUT", 0.0)
+# max in-place trainer restarts per cluster stage before the pod gives
+# up and fails (a trainer that hangs every time is not going to recover)
+HANG_MAX_RESTARTS = int(_f("EDL_TPU_HANG_MAX_RESTARTS", 3))

@@ -25,6 +25,21 @@ def main():
     print(f"demo trainer rank={te.global_rank}/{te.world_size} "
           f"pod={te.pod_id[:8]} stage={te.cluster_stage[:8]}", flush=True)
 
+    if os.environ.get("EDL_TPU_DEMO_HANG_ONCE") and marker:
+        # hang-watchdog fixture: on the FIRST start, write one liveness
+        # beat then go silent (a deadlocked trainer); on restart, exit
+        # normally — the launcher's watchdog must bridge the two
+        with open(marker) as f:
+            starts = sum(1 for _ in f)
+        if starts == 1:
+            from edl_tpu.cluster import heartbeat
+            from edl_tpu.coord.client import connect
+
+            store = connect(te.coord_endpoints)
+            heartbeat.beat(store, te.job_id, te.pod_id)
+            print("demo trainer hanging after one beat", flush=True)
+            time.sleep(600)
+
     sleep = float(os.environ.get("EDL_TPU_DEMO_SLEEP", "1"))
     if te.world_size <= 1:
         sleep = float(os.environ.get("EDL_TPU_DEMO_SLEEP_SOLO", sleep))

@@ -1,0 +1,101 @@
+"""Hang watchdog: heartbeat plumbing + launcher in-place restart.
+
+A deadlocked trainer holds its process alive, so exit-code watching
+never fires; the watchdog bridges it by restarting the trainers when
+the per-step heartbeat goes stale (SURVEY.md §5: the reference had no
+equivalent)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.cluster import heartbeat
+from edl_tpu.cluster.status import Status, load_job_status
+from edl_tpu.coord.client import CoordClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "tests", "helpers", "demo_trainer.py")
+
+
+def test_heartbeat_roundtrip(memkv):
+    assert heartbeat.last_beat(memkv, "j", "p") is None
+    heartbeat.beat(memkv, "j", "p", now=123.5)
+    assert heartbeat.last_beat(memkv, "j", "p") == 123.5
+    heartbeat.clear(memkv, "j", "p")
+    assert heartbeat.last_beat(memkv, "j", "p") is None
+
+
+def test_trainer_beats_after_steps(memkv):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    tenv = TrainerEnv({"EDL_TPU_JOB_ID": "hb", "EDL_TPU_POD_ID": "pod0",
+                       "EDL_TPU_TRAINER_RANK_IN_POD": "0"})
+
+    def loss_fn(params, extra, batch, rng):
+        return ((params["w"] * batch["x"] - batch["y"]) ** 2).mean(), (
+            extra, {})
+
+    tr = ElasticTrainer(loss_fn,
+                        TrainConfig(log_every=0, heartbeat_every=0.001),
+                        store=memkv, tenv=tenv)
+    state = tr.create_state(
+        lambda: ({"w": jnp.ones(())}, None), optax.sgd(0.1))
+
+    def data(_e):
+        for _ in range(3):
+            yield {"x": np.ones((8,), np.float32),
+                   "y": np.full((8,), 3.0, np.float32)}
+
+    before = time.time()
+    tr.fit(state, tr.restore_or_create(
+        lambda: ({"w": jnp.ones(())}, None), optax.sgd(0.1))[1],
+        data, epochs=1)
+    hb = heartbeat.last_beat(memkv, "hb", "pod0")
+    assert hb is not None and hb >= before
+
+
+@pytest.mark.slow
+def test_launcher_restarts_hung_trainer(tmp_path, coord_server):
+    """Demo trainer beats once then hangs; watchdog restarts it; the
+    second run exits cleanly and the job SUCCEEDs."""
+    ep = f"127.0.0.1:{coord_server.port}"
+    marker = str(tmp_path / "marker")
+    env = dict(os.environ)
+    env.update({
+        "EDL_TPU_TTL": "2",
+        "EDL_TPU_GENERATOR_PERIOD": "0.2",
+        "EDL_TPU_WATCHER_PERIOD": "0.2",
+        "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+        "EDL_TPU_BARRIER_TIMEOUT": "40",
+        "EDL_TPU_HANG_TIMEOUT": "2",
+        "EDL_TPU_DEMO_HANG_ONCE": "1",
+        "EDL_TPU_DEMO_MARKER": marker,
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    log = open(tmp_path / "launcher.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", "hang1", "--coord_endpoints", ep,
+         "--nodes_range", "1:1", "--nproc_per_node", "1",
+         "--log_dir", str(tmp_path / "log"), DEMO],
+        env=env, cwd=str(tmp_path), stdout=log, stderr=subprocess.STDOUT)
+    try:
+        ret = proc.wait(timeout=120)
+    finally:
+        log.close()
+    assert ret == 0, open(tmp_path / "launcher.log").read().decode()[-2000:]
+    starts = open(marker).read().strip().splitlines()
+    assert len(starts) == 2, starts       # hung once, restarted once
+    client = CoordClient(ep)
+    try:
+        assert load_job_status(client, "hang1") == Status.SUCCEED
+    finally:
+        client.close()

@@ -99,10 +99,10 @@ def test_supervise_grace_turns_peer_crash_into_resize(monkeypatch):
         watcher.changed = True
     import threading
     threading.Thread(target=flip, daemon=True).start()
-    assert lch._supervise(watcher) is None
+    assert lch._supervise(watcher, None) is None
 
     # no membership change → grace expires → FAILED
     watcher2 = _FakeWatcher()
     start = time.monotonic()
-    assert lch._supervise(watcher2) == Status.FAILED
+    assert lch._supervise(watcher2, None) == Status.FAILED
     assert time.monotonic() - start >= lch._fail_grace()
