@@ -40,3 +40,35 @@ def last_beat(store, job_id: str, pod_id: str) -> float | None:
 
 def clear(store, job_id: str, pod_id: str) -> None:
     store.delete(_key(job_id, pod_id))
+
+
+# -- coordinated multi-pod hang restart ----------------------------------
+# In a multi-pod job a hang stalls EVERY pod's collectives; killing one
+# pod's trainers unilaterally just crashes the peers with no membership
+# change to recover through.  Instead the detecting launcher writes a
+# hang flag under the cluster stage; every launcher polls it in its
+# supervisor loop and takes the stop-resume path together (the barrier
+# at an unchanged stage completes instantly, so downtime is one
+# kill+respawn).  Launchers remember the incident timestamp they have
+# already handled, so a restarted supervise loop ignores its own cause.
+
+def _hang_key(job_id: str, stage: str) -> str:
+    return paths.key(job_id, constants.ETCD_HEARTBEAT, f"hang/{stage}")
+
+
+def flag_hang(store, job_id: str, stage: str, pod_id: str) -> float:
+    """Record 'stage <stage> is hung' (detected by ``pod_id``); returns
+    the incident timestamp all launchers coordinate on."""
+    t = time.time()
+    store.put(_hang_key(job_id, stage), f"{t!r} {pod_id}".encode())
+    return t
+
+
+def get_hang(store, job_id: str, stage: str) -> float | None:
+    rec = store.get(_hang_key(job_id, stage))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return float(rec.value.decode().split()[0])
+    except (ValueError, IndexError):
+        return None

@@ -56,6 +56,8 @@ class Launcher:
         self._elector: LeaderElector | None = None
         self._generator: ClusterGenerator | None = None
         self._procs: list[train_process.TrainerProc] = []
+        self._hang_incident: float | None = None
+        self._hang_counts: dict[str, int] = {}  # stage -> incidents seen
 
     # -- lifecycle -----------------------------------------------------------
     def launch(self) -> Status:
@@ -104,7 +106,11 @@ class Launcher:
                 self._script_args, self._log_dir())
             if resize_times is not None:
                 resize_times["spawn"] = time.time()
-                self._write_recovery(cluster.stage, resize_times)
+                # hang restarts reuse the stage; suffix the record key so
+                # the original resize record of this stage survives (the
+                # trainer half only lands for true resizes)
+                suffix = resize_times.pop("_hang_suffix", "")
+                self._write_recovery(cluster.stage + suffix, resize_times)
                 resize_times = None
             try:
                 verdict = self._supervise(watcher, cluster)
@@ -117,6 +123,10 @@ class Launcher:
             # (BASELINE.md "not published: must be measured")
             logger.info("membership changed; re-barrier + restart trainers")
             resize_times = {"detect": time.time()}
+            if self._hang_incident is not None:
+                resize_times["_hang_suffix"] = \
+                    f"+hang{int(self._hang_incident)}"
+                self._hang_incident = None
             self._shutdown_trainers()
             # a pre-resize beat must not look stale to the new stage
             self._clear_heartbeat()
@@ -147,10 +157,27 @@ class Launcher:
         Hang watchdog (EDL_TPU_HANG_TIMEOUT > 0): a trainer whose
         per-step heartbeat goes stale — a silent deadlock that exit-code
         watching can never see — is killed and respawned in place
-        against the SAME cluster, up to HANG_MAX_RESTARTS per stage.
+        against the SAME cluster (single pod), up to HANG_MAX_RESTARTS
+        per stage.  Multi-pod: the detecting launcher writes a hang
+        flag under the stage; every launcher (this poll) takes the
+        stop-resume path together — see cluster/heartbeat.py.
         """
         fail_deadline = None
-        hang_restarts = 0
+        # incidents at/before this timestamp are already handled (e.g.
+        # the one that caused this very supervise loop to start);
+        # None = unknown (read failed) — adopt the first value SEEN as
+        # the baseline instead of acting on it, so a store blip can
+        # never replay an old incident
+        hang_baseline: float | None = 0.0
+        watchdog = constants.HANG_TIMEOUT > 0 and cluster is not None
+        if watchdog:
+            job_id = self._job_env.job_id
+            try:
+                hang_baseline = heartbeat.get_hang(
+                    self._store, job_id, cluster.stage) or 0.0
+            except Exception:  # noqa: BLE001
+                logger.exception("hang-flag read failed")
+                hang_baseline = None
         while True:
             local = train_process.watch_procs(self._procs)
             if local == Status.SUCCEED:
@@ -160,6 +187,20 @@ class Launcher:
                 return Status.FAILED
             if watcher.changed:
                 return None
+            if watchdog:
+                try:
+                    t = heartbeat.get_hang(self._store, job_id, cluster.stage)
+                except Exception:  # noqa: BLE001
+                    t = None
+                if t and hang_baseline is None:
+                    hang_baseline = t          # first read after a blip
+                elif t and t > hang_baseline:
+                    if self._count_hang(cluster.stage):
+                        return Status.FAILED
+                    logger.error("coordinated hang restart flagged for "
+                                 "stage %s", cluster.stage[:8])
+                    self._hang_incident = t
+                    return None
             if local == Status.FAILED:
                 if fail_deadline is None:
                     grace = self._fail_grace()
@@ -169,17 +210,25 @@ class Launcher:
                     fail_deadline = time.monotonic() + grace
                 elif time.monotonic() >= fail_deadline:
                     return Status.FAILED
-            elif self._hung(cluster):
-                hang_restarts += 1
-                if hang_restarts > constants.HANG_MAX_RESTARTS:
-                    logger.error(
-                        "trainers hung %d times this stage (%d restarts "
-                        "attempted); failing pod", hang_restarts,
-                        constants.HANG_MAX_RESTARTS)
+            elif watchdog and self._hung():
+                if self._count_hang(cluster.stage):
                     return Status.FAILED
+                if len(cluster.pods) > 1:
+                    logger.error("trainer heartbeat stale > %.1fs; "
+                                 "flagging coordinated multi-pod restart",
+                                 constants.HANG_TIMEOUT)
+                    try:
+                        self._hang_incident = heartbeat.flag_hang(
+                            self._store, job_id, cluster.stage,
+                            self._pod.pod_id)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("hang flag write failed")
+                        self._hang_incident = time.time()
+                    return None
                 logger.error(
                     "trainer heartbeat stale > %.1fs; in-place restart "
-                    "%d/%d", constants.HANG_TIMEOUT, hang_restarts,
+                    "%d/%d", constants.HANG_TIMEOUT,
+                    self._hang_counts[cluster.stage],
                     constants.HANG_MAX_RESTARTS)
                 self._shutdown_trainers()
                 self._clear_heartbeat()
@@ -188,18 +237,26 @@ class Launcher:
                     self._script_args, self._log_dir())
             time.sleep(self._period)
 
-    def _hung(self, cluster: Cluster | None) -> bool:
+    def _count_hang(self, stage: str) -> bool:
+        """Count a hang incident against ``stage`` (the count survives
+        across supervise loops — coordinated restarts re-enter
+        _supervise); True = the cap is exhausted and the pod should
+        fail instead of restarting again."""
+        n = self._hang_counts.get(stage, 0) + 1
+        self._hang_counts[stage] = n
+        if n > constants.HANG_MAX_RESTARTS:
+            logger.error("trainers hung %d times at stage %s (%d restarts "
+                         "attempted); failing pod", n, stage[:8],
+                         constants.HANG_MAX_RESTARTS)
+            return True
+        return False
+
+    def _hung(self) -> bool:
         """True when this pod's trainer heartbeat exists and is stale.
         No beat yet = not engaged (first XLA compile can be long).
-
-        Only engaged for single-pod clusters: in a multi-pod job a hang
-        stalls EVERY pod's collectives, and an uncoordinated local kill
-        would crash the peers (lost coordinator) without any membership
-        change to recover through — that needs a coordinated restart,
-        not a per-pod watchdog."""
+        Single-pod: handled by in-place restart; multi-pod: by the
+        coordinated flag (both in _supervise)."""
         if constants.HANG_TIMEOUT <= 0:
-            return False
-        if cluster is not None and len(cluster.pods) > 1:
             return False
         try:
             hb = heartbeat.last_beat(self._store, self._job_env.job_id,

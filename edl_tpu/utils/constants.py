@@ -69,8 +69,9 @@ VERDICT_TIMEOUT = _f("EDL_TPU_VERDICT_TIMEOUT", 600.0)
 # watching catches crashes; this catches silent deadlocks).  Set it
 # comfortably above the longest expected step + XLA compile; the
 # trainer automatically beats at least 3x faster than this threshold,
-# so the throttle can never outpace the watchdog.  Single-pod clusters
-# only (launcher._hung explains why).
+# so the throttle can never outpace the watchdog.  Single-pod: in-place
+# trainer restart; multi-pod: a store flag coordinates a cluster-wide
+# stop-resume (launcher._supervise + cluster/heartbeat.py).
 HANG_TIMEOUT = _f("EDL_TPU_HANG_TIMEOUT", 0.0)
 # max in-place trainer restarts per cluster stage before the pod gives
 # up and fails (a trainer that hangs every time is not going to recover)

@@ -99,3 +99,58 @@ def test_launcher_restarts_hung_trainer(tmp_path, coord_server):
         assert load_job_status(client, "hang1") == Status.SUCCEED
     finally:
         client.close()
+
+
+@pytest.mark.slow
+def test_multipod_coordinated_hang_restart(tmp_path, coord_server):
+    """Both pods' trainers hang after one beat; the hang flag coordinates
+    a cluster-wide stop-resume (same stage, instant re-barrier); the
+    restarted world runs to SUCCEED."""
+    ep = f"127.0.0.1:{coord_server.port}"
+    base = {
+        "EDL_TPU_TTL": "2",
+        "EDL_TPU_GENERATOR_PERIOD": "0.2",
+        "EDL_TPU_WATCHER_PERIOD": "0.2",
+        "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+        "EDL_TPU_BARRIER_TIMEOUT": "40",
+        "EDL_TPU_RESIZE_BARRIER_TIMEOUT": "30",
+        "EDL_TPU_HANG_TIMEOUT": "2",
+        "EDL_TPU_DEMO_HANG_ONCE": "1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    procs, markers, logs = [], [], []
+    for name in ("a", "b"):
+        marker = str(tmp_path / f"marker-{name}")
+        env = dict(os.environ)
+        env.update(base)
+        env["EDL_TPU_DEMO_MARKER"] = marker
+        log = open(tmp_path / f"launcher-{name}.log", "wb")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.collective.launch",
+             "--job_id", "hang2", "--coord_endpoints", ep,
+             "--nodes_range", "2:2", "--nproc_per_node", "1",
+             "--log_dir", str(tmp_path / f"log-{name}"), DEMO],
+            env=env, cwd=str(tmp_path), stdout=log,
+            stderr=subprocess.STDOUT))
+        markers.append(marker)
+        logs.append(log)
+    try:
+        rets = [p.wait(timeout=150) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:      # a regression must not leak procs
+                p.kill()
+        for log in logs:
+            log.close()
+    detail = "".join(open(tmp_path / f"launcher-{n}.log").read()[-1500:]
+                     for n in ("a", "b"))
+    assert rets == [0, 0], detail
+    for marker in markers:
+        starts = open(marker).read().strip().splitlines()
+        assert len(starts) == 2, (marker, starts)   # hung once, restarted
+        assert all("world=2" in s for s in starts)  # same membership
+    client = CoordClient(ep)
+    try:
+        assert load_job_status(client, "hang2") == Status.SUCCEED
+    finally:
+        client.close()
