@@ -154,3 +154,17 @@ def test_multipod_coordinated_hang_restart(tmp_path, coord_server):
         assert load_job_status(client, "hang2") == Status.SUCCEED
     finally:
         client.close()
+
+
+def test_hang_cap_persists_across_supervise_loops(monkeypatch):
+    """The per-stage incident count must survive supervise re-entry
+    (coordinated restarts start a fresh loop) and stay per-stage."""
+    from edl_tpu.collective import launcher as launcher_mod
+
+    monkeypatch.setattr(launcher_mod.constants, "HANG_MAX_RESTARTS", 2)
+    lch = launcher_mod.Launcher.__new__(launcher_mod.Launcher)
+    lch._hang_counts = {}
+    assert not lch._count_hang("s1")
+    assert not lch._count_hang("s1")
+    assert lch._count_hang("s1")       # third incident exceeds cap 2
+    assert not lch._count_hang("s2")   # stages count independently
