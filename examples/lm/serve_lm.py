@@ -86,10 +86,21 @@ def main() -> None:
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--mlp", type=int, default=256)
     p.add_argument("--max_len", type=int, default=512)
+    p.add_argument("--moe", type=int, default=0,
+                   help="serve an MoE checkpoint: experts per block "
+                        "(must match training)")
+    p.add_argument("--moe_top_k", type=int, default=2,
+                   help="experts combined per token (must match "
+                        "training — the param tree cannot catch a "
+                        "mismatch)")
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top_k", type=int, default=0)
     args = p.parse_args()
+
+    if args.moe and args.moe_top_k > args.moe:
+        raise SystemExit(f"--moe_top_k {args.moe_top_k} cannot exceed "
+                         f"--moe {args.moe} experts")
 
     import jax
     import jax.numpy as jnp
@@ -100,6 +111,7 @@ def main() -> None:
     cfg = TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers, embed_dim=args.embed,
         num_heads=args.heads, mlp_dim=args.mlp, max_len=args.max_len,
+        moe_experts=args.moe, moe_top_k=args.moe_top_k,
         remat=False, dtype=jnp.bfloat16
         if jax.devices()[0].platform == "tpu" else jnp.float32)
     model = TransformerLM(cfg)
