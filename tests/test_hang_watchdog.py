@@ -168,3 +168,13 @@ def test_hang_cap_persists_across_supervise_loops(monkeypatch):
     assert not lch._count_hang("s1")
     assert lch._count_hang("s1")       # third incident exceeds cap 2
     assert not lch._count_hang("s2")   # stages count independently
+
+
+def test_hang_flag_roundtrip(memkv):
+    assert heartbeat.get_hang(memkv, "j", "s1") is None
+    t1 = heartbeat.flag_hang(memkv, "j", "s1", "podA")
+    assert heartbeat.get_hang(memkv, "j", "s1") == t1
+    assert heartbeat.get_hang(memkv, "j", "s2") is None  # per-stage
+    t2 = heartbeat.flag_hang(memkv, "j", "s1", "podB")   # overwrite wins
+    assert t2 >= t1
+    assert heartbeat.get_hang(memkv, "j", "s1") == t2
