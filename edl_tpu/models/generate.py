@@ -9,7 +9,8 @@ token per step — O(1) attention work per token instead of re-running
 the full prefix, static shapes throughout.
 
 Sampling: greedy (``temperature=0``), temperature softmax, optional
-top-k truncation.  Deterministic under a fixed ``rng``.
+top-k truncation and/or top-p nucleus.  Deterministic under a fixed
+``rng``.
 """
 
 from __future__ import annotations
@@ -37,12 +38,18 @@ def _split_layer_params(params, num_layers: int):
 
 
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
-             *, rng=None, temperature: float = 1.0, top_k: int = 0):
+             *, rng=None, temperature: float = 1.0, top_k: int = 0,
+             top_p: float = 0.0):
     """Sample ``[B, max_new_tokens]`` continuations of ``prompt [B, P]``.
 
     ``cfg`` is the TRAINING config (``decode`` is overridden here);
     ``params`` the trained parameters.  Call under jit for real use —
-    everything inside is jit-compatible."""
+    everything inside is jit-compatible.
+
+    Sampling: greedy (``temperature=0``), else temperature softmax
+    optionally truncated by ``top_k`` (keep the k best logits) and/or
+    ``top_p`` in (0, 1] (nucleus: keep the smallest set of tokens whose
+    probability mass reaches p; applied after top_k)."""
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [B, P], got {prompt.shape}")
     if max_new_tokens < 1:
@@ -52,6 +59,8 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         raise ValueError(
             f"prompt {P} + new {max_new_tokens} exceeds max_len "
             f"{cfg.max_len} (the KV cache size)")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     # MoE configs decode with per-token expert gather (ops/moe.py
     # decode=True): no capacity machinery, so output matches the
     # training forward exactly whenever training capacity dropped
@@ -86,6 +95,16 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         if top_k:
             kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p and top_p < 1.0:
+            # nucleus: drop tokens outside the smallest prefix (by
+            # descending probability) whose cumulative mass reaches p;
+            # the top token always survives (cumsum-exclusive < p)
+            sorted_ = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_, axis=-1)
+            csum = jnp.cumsum(probs, axis=-1) - probs
+            kept = jnp.where(csum < top_p, sorted_, jnp.inf)
+            cutoff = jnp.min(kept, axis=-1, keepdims=True)
+            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
         return jax.random.categorical(key, scaled).astype(jnp.int32)
 
     rng, k0 = jax.random.split(rng)

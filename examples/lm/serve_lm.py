@@ -46,7 +46,7 @@ def request(endpoint: str, prompts: np.ndarray, timeout: float = 120.0):
 
 
 def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
-                     top_k: int):
+                     top_k: int, top_p: float = 0.0):
     """jitted (params, ids, rng) -> tokens, with a fresh fold per call
     so temperature sampling differs between identical requests."""
     import jax
@@ -56,7 +56,7 @@ def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
     @jax.jit
     def gen(p, ids, rng):
         return generate(cfg, p, ids, max_new_tokens, rng=rng,
-                        temperature=temperature, top_k=top_k)
+                        temperature=temperature, top_k=top_k, top_p=top_p)
 
     counter = {"n": 0}
     lock = threading.Lock()
@@ -96,6 +96,8 @@ def main() -> None:
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=0.0,
+                   help="nucleus sampling mass in (0, 1]; 0 disables")
     args = p.parse_args()
 
     if args.moe and args.moe_top_k > args.moe:
@@ -139,7 +141,7 @@ def main() -> None:
         params = init_params()    # random weights: wiring demo only
 
     predict = build_predict_fn(cfg, params, args.max_new_tokens,
-                               args.temperature, args.top_k)
+                               args.temperature, args.top_k, args.top_p)
     server = TeacherServer(predict, port=args.port)
     if args.coord_endpoints:
         from edl_tpu.coord.client import connect

@@ -124,3 +124,24 @@ def test_moe_cached_greedy_matches_full_recompute_bf16():
     want = _greedy_full_recompute(model, params, prompt, 6)
     got = generate(cfg, params, prompt, 6, temperature=0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_top_p_sampling():
+    """top_p -> tokens restricted to the nucleus; p->1 behaves like
+    plain temperature sampling; p tiny behaves like greedy."""
+    _, params = _model_and_params()
+    prompt = jnp.asarray([[4, 5, 6]], jnp.int32)
+    # a tiny nucleus keeps only the top token -> must equal greedy
+    greedy = generate(CFG, params, prompt, 6, temperature=0)
+    nucleus = generate(CFG, params, prompt, 6, rng=jax.random.key(0),
+                       temperature=0.7, top_p=1e-9)
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+    # p=1 keeps everything: deterministic under a fixed rng, in range
+    full = generate(CFG, params, prompt, 6, rng=jax.random.key(1),
+                    temperature=0.9, top_p=1.0)
+    again = generate(CFG, params, prompt, 6, rng=jax.random.key(1),
+                     temperature=0.9, top_p=1.0)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(again))
+    assert np.asarray(full).max() < CFG.vocab_size
+    with pytest.raises(ValueError, match="top_p"):
+        generate(CFG, params, prompt, 2, top_p=1.5)
