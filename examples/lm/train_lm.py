@@ -46,7 +46,7 @@ def parse_args():
                         "axis (GPipe; forces tp=sp=fsdp=1 in this example)")
     p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--attention", default="auto",
-                   choices=["auto", "dense", "flash", "ring"])
+                   choices=["auto", "dense", "splash", "flash", "ring"])
     p.add_argument("--remat", action="store_true")
     p.add_argument("--moe", type=int, default=0,
                    help=">0 replaces each block's FFN with this many "

@@ -1,0 +1,81 @@
+"""Attention dispatch and numerics (edl_tpu/ops/attention.py).
+
+The pallas kernels (splash/flash) only exist on TPU; CPU covers the
+dense path plus the dispatch decisions themselves.  TPU-only parity
+tests are gated on the platform so the same file runs everywhere.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.ops.attention import (
+    _splash_ok, dense_attention, dot_product_attention,
+)
+
+
+def _ref_attention(q, k, v, causal):
+    """O(L^2) numpy reference, f64 softmax."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    logits = np.einsum("bqhd,bkhd->bhqk", np.float64(q), np.float64(k))
+    logits *= D ** -0.5
+    if causal:
+        mask = np.tril(np.ones((Lq, Lk), bool), k=Lk - Lq)
+        logits = np.where(mask[None, None], logits, -np.inf)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", w, np.float64(v))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dense_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.float32)
+               for _ in range(3))
+    out = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), _ref_attention(q, k, v, causal),
+                               atol=1e-5)
+
+
+def test_auto_on_cpu_is_dense():
+    # no pallas kernels off-TPU: auto must resolve to dense and agree
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+               for _ in range(3))
+    a = dot_product_attention(q, k, v, causal=True, impl="auto")
+    d = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(d), atol=1e-6)
+
+
+def test_splash_gate_shapes():
+    def qk(L, D, Lk=None):
+        q = jnp.zeros((1, L, 2, D))
+        k = jnp.zeros((1, Lk if Lk else L, 2, D))
+        return q, k
+
+    assert _splash_ok(*qk(1024, 128), causal=True)
+    assert _splash_ok(*qk(256, 64), causal=True)
+    assert not _splash_ok(*qk(1024, 128), causal=False)   # causal-only
+    assert not _splash_ok(*qk(100, 128), causal=True)     # L % 128
+    assert not _splash_ok(*qk(1024, 80), causal=True)     # D % 64
+    assert not _splash_ok(*qk(1024, 128, Lk=512), causal=True)  # cross-attn
+
+
+def test_splash_rejects_non_causal():
+    q = jnp.zeros((1, 128, 2, 64))
+    with pytest.raises(ValueError, match="causal-only"):
+        dot_product_attention(q, q, q, causal=False, impl="splash")
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="pallas TPU kernels")
+def test_splash_matches_dense_on_tpu():
+    rng = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 128)), jnp.bfloat16)
+               for _ in range(3))
+    s = dot_product_attention(q, k, v, causal=True, impl="splash")
+    d = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.float32(s), np.float32(d),
+                               atol=2e-2, rtol=2e-2)
