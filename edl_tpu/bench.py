@@ -311,11 +311,12 @@ def _bench_lm(n_dev: int) -> dict:
 
     if os.environ.get("EDL_TPU_BENCH_DECODE", "1") != "0":
         from edl_tpu.models.generate import generate
-        B = min(8, ids.shape[0])
+        B = int(os.environ.get("EDL_TPU_BENCH_DECODE_BS", 64))
         # scale prompt/new to whatever seq the run was configured with
         plen = max(1, min(128, seq // 2))
         new = max(1, min(128, seq - plen))
-        prompt = jnp.asarray(ids[:B, :plen])
+        prompt = jnp.asarray(np.random.default_rng(7).integers(
+            0, vocab, (B, plen)).astype(np.int32))
         g = jax.jit(lambda p, i, r: generate(cfg, p, i, new, rng=r,
                                              temperature=0.8, top_k=40))
         np.asarray(g(state.params, prompt, jax.random.key(4)))  # compile

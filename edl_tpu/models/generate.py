@@ -37,9 +37,39 @@ def _split_layer_params(params, num_layers: int):
     return out
 
 
+def sample_logits(logits, key, *, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0, top_k_recall: float = 0.95):
+    """[B, V] logits -> [B] sampled token ids (the one sampling recipe
+    shared by generate() and the continuous-batching engine — the two
+    serving paths must never diverge).  Greedy at ``temperature<=0``;
+    else temperature softmax, optional top-k truncation (TPU-native
+    ``approx_max_k`` threshold at ``top_k_recall``) then top-p nucleus."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0:
+        return logits.argmax(-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k:
+        kth = jax.lax.approx_max_k(
+            scaled, top_k, recall_target=top_k_recall)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p and top_p < 1.0:
+        # nucleus: drop tokens outside the smallest prefix (by
+        # descending probability) whose cumulative mass reaches p;
+        # the top token always survives (cumsum-exclusive < p)
+        sorted_ = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1) - probs
+        kept = jnp.where(csum < top_p, sorted_, jnp.inf)
+        cutoff = jnp.min(kept, axis=-1, keepdims=True)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              *, rng=None, temperature: float = 1.0, top_k: int = 0,
-             top_p: float = 0.0):
+             top_p: float = 0.0, top_k_recall: float = 0.95):
     """Sample ``[B, max_new_tokens]`` continuations of ``prompt [B, P]``.
 
     ``cfg`` is the TRAINING config (``decode`` is overridden here);
@@ -49,7 +79,14 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     Sampling: greedy (``temperature=0``), else temperature softmax
     optionally truncated by ``top_k`` (keep the k best logits) and/or
     ``top_p`` in (0, 1] (nucleus: keep the smallest set of tokens whose
-    probability mass reaches p; applied after top_k)."""
+    probability mass reaches p; applied after top_k).
+
+    ``top_k_recall``: the top-k threshold uses the TPU-native
+    ``lax.approx_max_k`` at this per-bucket recall (the sort-based
+    exact top-k profiled 1.6 ms/step at [64, 32000] — dwarfing the
+    attention itself).  0.95 is statistically invisible under stochastic
+    sampling (a missed candidate is replaced by a near-tied logit);
+    pass 1.0 for the exact threshold at ~0.5 ms/step extra."""
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [B, P], got {prompt.shape}")
     if max_new_tokens < 1:
@@ -66,8 +103,17 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     # training forward exactly whenever training capacity dropped
     # nothing (ample capacity_factor); when training did drop overflow
     # tokens, decode is the drop-free ideal rather than a replica.
+    #
+    # The KV cache is sized to THIS request (P + new, padded to the
+    # 128-lane tile), not cfg.max_len: every decode step streams the
+    # whole cache through the two attention matmuls, so a 1024-long
+    # cache for a 256-long generation costs 4× the HBM traffic of a
+    # right-sized one (profiled: the cache reads are the decode-loop
+    # floor once sampling is fast).  RoPE uses absolute positions, so
+    # shrinking the cache does not move any embedding.
+    cache_len = min(cfg.max_len, -(-(P + max_new_tokens) // 128) * 128)
     dcfg = dataclasses.replace(cfg, decode=True, attention_impl="dense",
-                               mesh=None)
+                               mesh=None, max_len=cache_len)
     model = TransformerLM(dcfg)
     params = _split_layer_params(params, cfg.num_layers)
     rng = jax.random.key(0) if rng is None else rng
@@ -88,24 +134,9 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     cache = mut["cache"]
 
     def sample(logits_1, key):
-        """[B, V] logits -> [B] token ids."""
-        if temperature <= 0:
-            return logits_1.argmax(-1).astype(jnp.int32)
-        scaled = logits_1 / temperature
-        if top_k:
-            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-        if top_p and top_p < 1.0:
-            # nucleus: drop tokens outside the smallest prefix (by
-            # descending probability) whose cumulative mass reaches p;
-            # the top token always survives (cumsum-exclusive < p)
-            sorted_ = jnp.sort(scaled, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_, axis=-1)
-            csum = jnp.cumsum(probs, axis=-1) - probs
-            kept = jnp.where(csum < top_p, sorted_, jnp.inf)
-            cutoff = jnp.min(kept, axis=-1, keepdims=True)
-            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-        return jax.random.categorical(key, scaled).astype(jnp.int32)
+        return sample_logits(logits_1, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p,
+                             top_k_recall=top_k_recall)
 
     rng, k0 = jax.random.split(rng)
     first = sample(logits[:, -1], k0)

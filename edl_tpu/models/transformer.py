@@ -120,6 +120,16 @@ class Block(nn.Module):
         prefix (the position mask also excludes the not-yet-written
         tail).
 
+        ``cache_index`` is a PER-EXAMPLE ``[B]`` vector so examples in
+        one decode batch may sit at different sequence positions — the
+        contract continuous batching needs (serving/engine.py): each
+        slot advances independently, and the mask is computed per
+        example.  Single-token steps (L == 1) scatter each example's
+        new k/v at its own index; multi-token calls (prefill) require a
+        UNIFORM index across the batch (they dynamic-update one
+        contiguous slab) — generate()/the engine always prefill from a
+        fresh cache at index 0, which satisfies this.
+
         Cache layouts match the two attention matmuls exactly — keys
         ``[B, H, D, max_len]`` (contraction over D, time on the lane
         axis) and values ``[B, H, max_len, D]`` — so reading the cache
@@ -133,26 +143,35 @@ class Block(nn.Module):
         cv = self.variable("cache", "cached_value", jnp.zeros,
                            (B, H, cfg.max_len, Dh), cfg.dtype)
         ci = self.variable("cache", "cache_index",
-                           lambda: jnp.zeros((), jnp.int32))
+                           lambda: jnp.zeros((B,), jnp.int32))
         if not is_initialized:      # init trace: shapes only
             return dot_product_attention(q, k, v, causal=True, impl="dense")
-        idx = ci.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.transpose(0, 2, 3, 1).astype(cfg.dtype),
-            (0, 0, 0, idx))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.transpose(0, 2, 1, 3).astype(cfg.dtype),
-            (0, 0, idx, 0))
+        idx = ci.value                                    # [B]
+        if L == 1:
+            # per-example scatter (tiny update: B×H×D elements)
+            ck.value = ck.value.at[jnp.arange(B), :, :, idx].set(
+                k[:, 0].astype(cfg.dtype))
+            cv.value = cv.value.at[jnp.arange(B), :, idx, :].set(
+                v[:, 0].astype(cfg.dtype))
+        else:
+            # contiguous slab at a batch-uniform index (see docstring)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.transpose(0, 2, 3, 1).astype(cfg.dtype),
+                (0, 0, 0, idx[0]))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.transpose(0, 2, 1, 3).astype(cfg.dtype),
+                (0, 0, idx[0], 0))
         ci.value = idx + L
-        q_pos = idx + jnp.arange(L)
-        mask = jnp.arange(cfg.max_len)[None, :] <= q_pos[:, None]  # [L, max]
+        q_pos = idx[:, None] + jnp.arange(L)              # [B, L]
+        mask = (jnp.arange(cfg.max_len)[None, None, :]
+                <= q_pos[:, :, None])                     # [B, L, max]
         scale = Dh ** -0.5
         # precision recipe matches dense_attention exactly (input-dtype
         # matmuls, f32 softmax) so cached decode stays bit-identical to
         # the full-prefix forward in bf16 too
         logits = jnp.einsum("blhd,bhdk->bhlk", q, ck.value
                             ).astype(jnp.float32) * scale
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        logits = jnp.where(mask[:, None], logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhlk,bhkd->blhd", weights, cv.value)
 

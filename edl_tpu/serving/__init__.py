@@ -1,0 +1,5 @@
+"""TPU LM serving: slot-based continuous batching (engine.py)."""
+
+from edl_tpu.serving.engine import ContinuousBatcher
+
+__all__ = ["ContinuousBatcher"]

@@ -1,0 +1,397 @@
+"""Slot-based continuous batching for TransformerLM decode.
+
+The reference's serving story is batch-at-a-time classification
+(Paddle Serving teachers, distill_worker.py:197-321); an LM server
+that pads every request into one fixed batch wastes the chip whenever
+requests arrive raggedly or finish early.  This engine keeps a fixed
+pool of ``slots`` decode lanes over ONE persistent KV cache:
+
+- a new request **prefills** into any free slot (per-prompt-length
+  bucket, compiled once per bucket) while the other slots keep their
+  state;
+- every decode dispatch advances ALL slots ``steps_per_sync`` tokens
+  under one jitted ``lax.scan`` (host↔device sync once per chunk, not
+  per token — decode is host-driven, so the sync cadence sets the
+  floor);
+- a finished slot (token budget or ``eos_id``) frees immediately and
+  the next queued request takes it — no convoy behind the longest
+  generation in a batch.
+
+Per-slot independence rests on the transformer's per-example
+``cache_index`` contract (transformer.Block._decode_attention): each
+slot's position/mask advances alone, so a slot mid-generation is
+bit-identical to the same request decoded in isolation (the greedy
+parity test in tests/test_serving_engine.py asserts exactly that).
+
+Thread model: callers ``submit()`` from any thread and get a Future;
+one engine thread owns the device state — the same
+single-writer/many-readers split as the TeacherServer coalescer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models.generate import _split_layer_params, sample_logits
+from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_PREFILL_BUCKETS = (32, 64, 128, 256, 512)
+
+
+@dataclass
+class _Slot:
+    request: "_Request | None" = None
+    emitted: list[int] = dataclasses.field(default_factory=list)
+    remaining: int = 0
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+class _Request:
+    __slots__ = ("ids", "max_new", "future", "t_submit")
+
+    def __init__(self, ids: np.ndarray, max_new: int):
+        self.ids = ids
+        self.max_new = max_new
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class ContinuousBatcher:
+    """``submit(prompt_1d) -> Future[np.ndarray]`` over a slot pool.
+
+    ``cfg``/``params`` as for :func:`edl_tpu.models.generate.generate`
+    (training config + trained params — layer stacking is split here).
+    ``max_len`` bounds prompt+generation per slot (defaults to
+    ``cfg.max_len``); the KV cache is [slots, ...] at that length.
+    ``steps_per_sync`` trades scheduling latency for dispatch
+    amortisation: a finished slot wastes at most ``steps_per_sync - 1``
+    lane-steps before the host notices.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, *, slots: int = 8,
+                 max_len: int | None = None,
+                 prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, eos_id: int | None = None,
+                 steps_per_sync: int = 8, rng_seed: int = 20_26):
+        cache_len = max_len or cfg.max_len
+        self.cfg = cfg
+        self._dcfg = dataclasses.replace(
+            cfg, decode=True, attention_impl="dense", mesh=None,
+            max_len=cache_len)
+        self._model = TransformerLM(self._dcfg)
+        self._params = _split_layer_params(params, cfg.num_layers)
+        self._slots = [_Slot() for _ in range(slots)]
+        self._buckets = tuple(sorted(b for b in prefill_buckets
+                                     if b <= cache_len))
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        self._eos = eos_id
+        self._T = max(1, steps_per_sync)
+        self._rng = jax.random.key(rng_seed)
+        self._cache = self._fresh_cache(slots)
+        self._toks = np.zeros((slots,), np.int32)   # last token per slot
+        self._queue: queue.Queue[_Request | None] = queue.Queue()
+        self._stopping = False
+        # makes check-stopping + enqueue atomic vs stop()'s drain (the
+        # TeacherServer guard — without it a submit racing stop() can
+        # land its request in the already-drained queue, stranding the
+        # caller's future forever)
+        self._enqueue_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._done_requests = 0
+        self._emitted_tokens = 0
+        self._lane_steps = 0          # slot-steps actually dispatched
+        self._active_lane_steps = 0   # of those, slots with live requests
+        self._t0 = time.monotonic()
+        self._prefill_cache: dict[int, object] = {}
+        self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,))
+        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="continuous-batcher")
+        self._thread.start()
+
+    # -- public --------------------------------------------------------------
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Future:
+        """Queue one prompt (1-D int32).  The future resolves to the
+        generated tokens (≤ max_new_tokens; truncated at eos_id)."""
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        cache_len = self._dcfg.max_len
+        if len(ids) == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(ids) > (self._buckets[-1] if self._buckets else 0):
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds the largest prefill "
+                f"bucket {self._buckets[-1:]} (cache_len {cache_len})")
+        if len(ids) + max_new_tokens > cache_len:
+            raise ValueError(
+                f"prompt {len(ids)} + new {max_new_tokens} exceeds "
+                f"max_len {cache_len}")
+        req = _Request(ids, max_new_tokens)
+        with self._enqueue_lock:
+            if self._stopping:
+                raise RuntimeError("engine stopping")
+            self._queue.put(req)
+        return req.future
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 timeout: float | None = None) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            dt = max(1e-9, time.monotonic() - self._t0)
+            active = sum(not s.free for s in self._slots)
+            lanes = max(1, self._lane_steps)
+            return {
+                "slots": len(self._slots),
+                "active_slots": active,
+                "queue_depth": self._queue.qsize(),
+                "requests_done": self._done_requests,
+                "tokens_emitted": self._emitted_tokens,
+                "tokens_per_s": round(self._emitted_tokens / dt, 1),
+                # fraction of dispatched lane-steps that served a live
+                # request (the rest is free-slot ballast)
+                "slot_utilization": round(self._active_lane_steps / lanes, 3),
+                "uptime_s": round(dt, 3),
+            }
+
+    def stop(self) -> None:
+        with self._enqueue_lock:
+            self._stopping = True
+        self._queue.put(None)
+        self._thread.join(timeout=30.0)
+        for s in self._slots:
+            if s.request is not None:
+                s.request.future.set_exception(
+                    RuntimeError("engine stopped mid-generation"))
+                s.request = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.future.set_exception(RuntimeError("engine stopped"))
+
+    # -- device state construction -------------------------------------------
+    def _fresh_cache(self, B: int):
+        shapes = jax.eval_shape(
+            lambda: self._model.init(
+                jax.random.key(0), jnp.zeros((B, 1), jnp.int32),
+                positions=jnp.zeros((B, 1), jnp.int32)))
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            shapes["cache"])
+
+    # -- jitted pieces -------------------------------------------------------
+    def _sample(self, logits, key):
+        """[B, V] -> [B]; THE generate() sampling recipe (shared
+        helper — the two serving paths must never diverge)."""
+        return sample_logits(logits, key, temperature=self._temperature,
+                             top_k=self._top_k, top_p=self._top_p)
+
+    def _prefill_fn(self, P: int):
+        """Compiled per prompt bucket: fresh 1-lane cache, prompt kv,
+        sampled next token."""
+        cached = self._prefill_cache.get(P)
+        if cached is not None:
+            return cached
+        model = self._model
+
+        def prefill(params, ids, true_len, key):
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    lambda: model.init(
+                        jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
+                        positions=jnp.zeros((1, 1), jnp.int32)))["cache"])
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, ids,
+                positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),
+                                           ids.shape),
+                mutable=["cache"])
+            # padded prompt: sample at the LAST REAL position; the pad
+            # queries wrote kv past true_len, which insertion resets
+            # (cache_index := true_len) and masks never reach
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_len - 1, axis=1, keepdims=False)
+            tok = self._sample(last, key)
+            return mut["cache"], tok
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[P] = fn
+        return fn
+
+    @staticmethod
+    def _insert_impl(cache, slab, slot, true_len):
+        """Copy a 1-lane prefill cache into slot ``slot`` of the pool
+        cache and reset that slot's index to ``true_len``."""
+        def put(big, small):
+            if small.ndim == 1:                       # cache_index [1]
+                return big.at[slot].set(true_len)
+            # kv buffers: [1, ...small_len...] -> [slots, ...cache_len...]
+            # at the slot, offset 0 along the time axis
+            starts = [slot] + [0] * (big.ndim - 1)
+            return jax.lax.dynamic_update_slice(big, small, tuple(starts))
+        return jax.tree.map(put, cache, slab)
+
+    def _step_impl(self, cache, toks, key):
+        """Advance every slot ``self._T`` tokens (one dispatch)."""
+        model, params = self._model, self._params
+
+        def one(carry, k):
+            cache, tok = carry
+            # per-slot positions come from the cache itself
+            pos = self._positions(cache)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                positions=pos[:, None], mutable=["cache"])
+            nxt = self._sample(logits[:, -1], k)
+            return (mut["cache"], nxt), nxt
+
+        keys = jax.random.split(key, self._T)
+        (cache, _), out = jax.lax.scan(one, (cache, toks), keys)
+        return cache, out.T                            # [slots, T]
+
+    @staticmethod
+    def _positions(cache):
+        """Current per-slot sequence positions: any layer's cache_index."""
+        for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+            if leaf.ndim == 1:
+                return leaf
+        raise AssertionError("no cache_index leaf found")
+
+    # -- the loop ------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            try:
+                filled = self._fill_slots(block=not self._any_active())
+            except Exception as e:  # noqa: BLE001 — never die silently
+                # a prefill blew up in a way _prefill_into didn't
+                # absorb: fail everything live so no caller hangs
+                logger.exception("engine fill failed")
+                self._fail_all(e)
+                filled = False
+            if self._stopping:
+                return
+            if not self._any_active():
+                if filled:
+                    continue
+                return  # stop signal drained and nothing active
+            try:
+                self._advance()
+            except Exception as e:  # noqa: BLE001 — fail all live futures
+                logger.exception("engine step failed")
+                self._fail_all(e)
+
+    def _fail_all(self, e: Exception) -> None:
+        for s in self._slots:
+            if s.request is not None:
+                s.request.future.set_exception(e)
+                s.request = None
+
+    def _any_active(self) -> bool:
+        return any(not s.free for s in self._slots)
+
+    def _fill_slots(self, block: bool) -> bool:
+        """Move queued requests into free slots; returns True if any
+        prefill happened.  Blocks for the first request when idle."""
+        filled = False
+        while True:
+            free = next((i for i, s in enumerate(self._slots) if s.free),
+                        None)
+            if free is None:
+                return filled
+            try:
+                req = self._queue.get(block=block and not filled
+                                      and not self._stopping)
+            except queue.Empty:
+                return filled
+            if req is None:                            # stop signal
+                self._stopping = True
+                return filled
+            self._prefill_into(free, req)
+            filled = True
+
+    def _prefill_into(self, slot: int, req: _Request) -> None:
+        try:
+            P = next(b for b in self._buckets if len(req.ids) <= b)
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :len(req.ids)] = req.ids
+            self._rng, key = jax.random.split(self._rng)
+            slab, tok = self._prefill_fn(P)(
+                self._params, jnp.asarray(ids),
+                jnp.asarray(len(req.ids), jnp.int32), key)
+            self._cache = self._insert_jit(
+                self._cache, slab, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(len(req.ids), jnp.int32))
+            tok = int(np.asarray(tok)[0])
+        except Exception as e:  # noqa: BLE001 — fail THIS request only
+            logger.exception("prefill failed for prompt len %d",
+                             len(req.ids))
+            req.future.set_exception(e)
+            return
+        s = self._slots[slot]
+        s.request = req
+        s.emitted = [tok]
+        s.remaining = req.max_new - 1
+        self._toks[slot] = tok
+        if s.remaining == 0 or tok == self._eos:
+            self._finish(slot)
+
+    def _advance(self) -> None:
+        self._rng, key = jax.random.split(self._rng)
+        active_before = sum(not s.free for s in self._slots)
+        self._cache, toks = self._step_jit(
+            self._cache, jnp.asarray(self._toks), key)
+        toks = np.asarray(toks)                        # [slots, T] sync point
+        with self._stats_lock:
+            self._lane_steps += len(self._slots) * self._T
+            self._active_lane_steps += active_before * self._T
+        for i, s in enumerate(self._slots):
+            if s.free:
+                continue
+            for t in range(self._T):
+                if s.remaining <= 0:
+                    break
+                tok = int(toks[i, t])
+                s.emitted.append(tok)
+                s.remaining -= 1
+                if tok == self._eos or s.remaining == 0:
+                    self._finish(i)
+                    break
+            else:
+                self._toks[i] = int(toks[i, self._T - 1])
+
+    def _finish(self, slot: int) -> None:
+        s = self._slots[slot]
+        req = s.request
+        assert req is not None
+        out = np.asarray(s.emitted, np.int32)
+        if self._eos is not None and self._eos in s.emitted:
+            out = out[:s.emitted.index(self._eos) + 1]
+        with self._stats_lock:
+            self._done_requests += 1
+            self._emitted_tokens += len(out)
+        s.request = None
+        s.emitted = []
+        req.future.set_result(out)

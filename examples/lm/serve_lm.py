@@ -72,6 +72,68 @@ def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
     return predict
 
 
+class _ContinuousServer:
+    """TeacherClient-compatible RPC front over a ContinuousBatcher.
+
+    Unlike TeacherServer there is NO single inference thread to queue
+    behind: the RPC layer is thread-per-connection, every request
+    submits its rows to the engine and blocks on futures, and the
+    engine batches across whatever is in flight — requests join and
+    leave the running decode batch at token granularity."""
+
+    def __init__(self, engine, max_new_tokens: int, port: int = 0):
+        from edl_tpu.distill.predict_client import decode_array, encode_array
+        from edl_tpu.rpc.server import RpcServer
+        from edl_tpu.utils.network import local_ip
+
+        self._engine = engine
+        self._max_new = max_new_tokens
+
+        def predict(feed: dict, fetch: list[str]) -> dict:
+            ids = decode_array(feed["ids"])
+            if len(ids) == 0:
+                return {"out": {"tokens": encode_array(
+                    np.zeros((0, 0), np.int32))}}
+            futs = [engine.submit(row, self._max_new) for row in ids]
+            outs = [f.result() for f in futs]
+            width = max(len(o) for o in outs)
+            toks = np.full((len(outs), width), -1, np.int32)
+            for i, o in enumerate(outs):       # ragged under eos: -1 pad
+                toks[i, :len(o)] = o
+            return {"out": {"tokens": encode_array(toks)}}
+
+        self._rpc = RpcServer(host="0.0.0.0", port=port)
+        self._rpc.register("predict", predict)
+        self._rpc.register("ping", lambda: {"pong": True})
+        self._rpc.register("stats", engine.stats)
+        self._rpc.start()
+        self.endpoint = f"{local_ip()}:{self._rpc.port}"
+        self._register = None
+
+    def register(self, store, service: str):
+        from edl_tpu.coord.register import Register
+        from edl_tpu.distill.balance import server_key
+        self._register = Register(store, server_key(service, self.endpoint),
+                                  self.endpoint.encode())
+        return self
+
+    def stop(self) -> None:
+        if self._register is not None:
+            self._register.stop()
+        self._rpc.stop()
+        self._engine.stop()
+
+
+def _continuous_server(cfg, params, args) -> _ContinuousServer:
+    from edl_tpu.serving import ContinuousBatcher
+
+    engine = ContinuousBatcher(
+        cfg, params, slots=args.continuous,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        eos_id=None if args.eos_id < 0 else args.eos_id)
+    return _ContinuousServer(engine, args.max_new_tokens, port=args.port)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--coord_endpoints", default="",
@@ -98,6 +160,15 @@ def main() -> None:
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--top_p", type=float, default=0.0,
                    help="nucleus sampling mass in (0, 1]; 0 disables")
+    p.add_argument("--continuous", type=int, default=0, metavar="SLOTS",
+                   help="serve with slot-based continuous batching over "
+                        "this many decode lanes (edl_tpu/serving): "
+                        "requests join/leave the running batch per "
+                        "prompt, no convoy behind the longest "
+                        "generation; 0 = batch-at-a-time TeacherServer")
+    p.add_argument("--eos_id", type=int, default=-1,
+                   help="stop generation at this token (continuous "
+                        "mode); -1 disables")
     args = p.parse_args()
 
     if args.moe and args.moe_top_k > args.moe:
@@ -140,14 +211,18 @@ def main() -> None:
     else:
         params = init_params()    # random weights: wiring demo only
 
-    predict = build_predict_fn(cfg, params, args.max_new_tokens,
-                               args.temperature, args.top_k, args.top_p)
-    server = TeacherServer(predict, port=args.port)
+    if args.continuous:
+        server = _continuous_server(cfg, params, args)
+    else:
+        predict = build_predict_fn(cfg, params, args.max_new_tokens,
+                                   args.temperature, args.top_k, args.top_p)
+        server = TeacherServer(predict, port=args.port)
     if args.coord_endpoints:
         from edl_tpu.coord.client import connect
         server.register(connect(args.coord_endpoints), args.service)
     print(f"[serve_lm] serving on {server.endpoint} "
-          f"(max_new_tokens={args.max_new_tokens})", flush=True)
+          f"(max_new_tokens={args.max_new_tokens}, "
+          f"continuous={args.continuous})", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

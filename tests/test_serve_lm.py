@@ -63,6 +63,35 @@ def test_serve_sampling_varies_between_requests():
         server.stop()
 
 
+def test_continuous_server_roundtrip():
+    """--continuous wire: concurrent TeacherClient requests share the
+    engine's decode batch; greedy output matches the batch server."""
+    from serve_lm import _ContinuousServer
+
+    from edl_tpu.serving import ContinuousBatcher
+
+    params = _params()
+    engine = ContinuousBatcher(CFG, params, slots=2, temperature=0.0,
+                               prefill_buckets=(8, 16), steps_per_sync=4)
+    server = _ContinuousServer(engine, max_new_tokens=6)
+    try:
+        prompts = np.asarray([[3, 1, 4], [1, 5, 9]], np.int32)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(3) as pool:   # concurrent clients
+            results = list(pool.map(
+                lambda _: request(server.endpoint, prompts), range(3)))
+        from edl_tpu.models.generate import generate
+        want = np.asarray(generate(CFG, params, jnp.asarray(prompts), 6,
+                                   temperature=0.0))
+        for toks in results:
+            np.testing.assert_array_equal(toks, want)
+        stats = server._engine.stats()
+        assert stats["requests_done"] == 6
+        assert stats["tokens_emitted"] == 36
+    finally:
+        server.stop()
+
+
 @pytest.mark.slow
 def test_serve_lm_cli_restores_checkpoint(tmp_path):
     """Save a TrainState, boot the CLI against it, query, SIGTERM."""
