@@ -137,17 +137,24 @@ class ImageBatches:
     """Iterate ``{"image": (B,S,S,3) f32, "label": (B,) i32}`` batches.
 
     A reader thread streams records (shuffled through the native window
-    for training), a cv2 thread pool decodes/augments them (cv2 drops
-    the GIL, so the pool scales), and up to ``prefetch`` assembled
-    batches wait in a queue — the host-side double-buffering the
-    reference got from DALI's pipelined stages.
+    for training); decode+augment runs through the native C++ batch
+    decoder when available (csrc/imagedec.cc — libjpeg with DCT-domain
+    downscaling, real threads, zero Python per record) and falls back
+    to a cv2 thread pool (cv2 drops the GIL, so the pool still scales).
+    Up to ``prefetch`` assembled batches wait in a queue — the
+    host-side double-buffering the reference got from DALI's pipelined
+    stages.
+
+    ``use_native``: None = auto (native when built), False = cv2 path,
+    True = require native.  Augmentation rngs differ between the two
+    (identical distributions, different draws).
     """
 
     def __init__(self, paths: list[str], batch_size: int,
                  image_size: int = 224, train: bool = True, seed: int = 0,
                  num_workers: int = 8, prefetch: int = 4,
                  shuffle_buffer: int = 4096, drop_remainder: bool = True,
-                 normalize: bool = True):
+                 normalize: bool = True, use_native: bool | None = None):
         self._paths = list(paths)
         self._bs = batch_size
         self._size = image_size
@@ -159,6 +166,14 @@ class ImageBatches:
         self._drop = drop_remainder
         # normalize=False emits uint8 BGR batches for device_normalize
         self._normalize = normalize
+        from edl_tpu.native import imagedec
+        if use_native is None:
+            self._native = imagedec.available()
+        else:
+            if use_native and not imagedec.available():
+                raise RuntimeError("use_native=True but the native image "
+                                   "decoder is unavailable (no libjpeg?)")
+            self._native = use_native
 
     def _records(self) -> Iterator[bytes]:
         if self._train:
@@ -183,6 +198,22 @@ class ImageBatches:
         def produce():
             rngs = [np.random.default_rng((self._seed, i))
                     for i in range(self._bs)]
+            batch_no = 0
+
+            def decode_native(records: list[bytes]) -> dict:
+                from edl_tpu.native import imagedec
+                imgs, labels, failed = imagedec.decode_batch(
+                    records, self._size,
+                    seed=self._seed * 1_000_003 + batch_no,
+                    train=self._train, threads=self._workers)
+                if failed:
+                    raise ValueError(f"{failed} undecodable image records")
+                if self._normalize:
+                    # native emits uint8 BGR; match the cv2 path's
+                    # normalized RGB float32 (vectorized, not per-record)
+                    imgs = (imgs[..., ::-1].astype(np.float32)
+                            - IMAGENET_MEAN) / IMAGENET_STD
+                return {"image": imgs, "label": labels}
 
             def decode_batch(pool, records: list[bytes]) -> dict:
                 # contiguous chunks per worker, decoded straight into
@@ -222,10 +253,13 @@ class ImageBatches:
                             return
                         chunk.append(rec)
                         if len(chunk) == self._bs:
-                            out.put(decode_batch(pool, chunk))
+                            out.put(decode_native(chunk) if self._native
+                                    else decode_batch(pool, chunk))
+                            batch_no += 1
                             chunk = []
                     if chunk and not self._drop:
-                        out.put(decode_batch(pool, chunk))
+                        out.put(decode_native(chunk) if self._native
+                                else decode_batch(pool, chunk))
             except Exception as e:  # noqa: BLE001 — surface in consumer
                 out.put(e)
                 return

@@ -29,6 +29,11 @@ _failed = False
 # standalone binaries (own main()), not part of the shared library
 _STANDALONE = {"coordd.cc"}
 
+# sources with extra link deps, dropped (with their flags) when the dep
+# is missing on the host — the library still builds without them and
+# the Python wrappers fall back (imagedec -> cv2 path)
+_OPTIONAL = {"imagedec.cc": ["-ljpeg"]}
+
 
 def _sources() -> list[str]:
     if not os.path.isdir(_SRC_DIR):
@@ -57,7 +62,24 @@ def ensure_built() -> ctypes.CDLL | None:
             return None
         try:
             if _stale(sources):
-                _compile(["-O3", "-shared", "-fPIC", *sources], _OUT)
+                extra = sorted({f for s in sources
+                                for f in _OPTIONAL.get(os.path.basename(s),
+                                                       [])})
+                try:
+                    _compile(["-O3", "-shared", "-fPIC", *sources, *extra],
+                             _OUT)
+                except subprocess.CalledProcessError as e:
+                    # retry without the optional sources (missing dep,
+                    # e.g. no libjpeg): the core library must still build
+                    core = [s for s in sources
+                            if os.path.basename(s) not in _OPTIONAL]
+                    if core == sources:
+                        raise
+                    logger.warning(
+                        "optional native sources dropped (%s); %s",
+                        ", ".join(sorted(_OPTIONAL)),
+                        (getattr(e, "stderr", "") or str(e)).strip()[:300])
+                    _compile(["-O3", "-shared", "-fPIC", *core], _OUT)
             _lib = ctypes.CDLL(_OUT)
         except (subprocess.CalledProcessError, OSError) as e:
             detail = getattr(e, "stderr", "") or str(e)

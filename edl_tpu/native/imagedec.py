@@ -1,0 +1,51 @@
+"""Native JPEG decode + augment bindings (csrc/imagedec.cc).
+
+One call decodes a WHOLE batch of recordio samples with a C++ thread
+pool (libjpeg with DCT-domain downscaling) — no Python per record, no
+GIL.  Falls back to None when the host has no libjpeg (the cv2 path in
+edl_tpu/data/images.py remains the reference implementation; output
+format is identical: uint8 BGR [n, size, size, 3] + int32 labels).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from edl_tpu.native.build import ensure_built
+
+
+def available() -> bool:
+    lib = ensure_built()
+    return lib is not None and hasattr(lib, "edl_imgdec_batch")
+
+
+def decode_batch(records: list[bytes], size: int, *, seed: int = 0,
+                 train: bool = True, threads: int = 8,
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Decode+augment ``records`` -> (images u8 BGR [n,s,s,3],
+    labels i32 [n], failed_count).  Failed records have zero images and
+    label -1 (mirrors the C side).  Raises RuntimeError when the native
+    library is unavailable — call :func:`available` first."""
+    lib = ensure_built()
+    if lib is None or not hasattr(lib, "edl_imgdec_batch"):
+        raise RuntimeError("native imagedec unavailable (no libjpeg?)")
+    n = len(records)
+    imgs = np.empty((n, size, size, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
+    if n == 0:
+        return imgs, labels, 0
+    bufs = (ctypes.c_char_p * n)(*records)
+    lens = np.asarray([len(r) for r in records], np.int64)
+    fn = lib.edl_imgdec_batch
+    fn.restype = ctypes.c_int
+    failed = fn(
+        ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int(n), ctypes.c_int(size),
+        ctypes.c_uint64(np.uint64(seed & (2**64 - 1))),
+        ctypes.c_int(1 if train else 0), ctypes.c_int(threads),
+        imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return imgs, labels, int(failed)

@@ -1,0 +1,102 @@
+"""Host image-decode throughput: the cores -> img/s curve.
+
+The reference killed its host-decode bottleneck with DALI on GPU
+(example/collective/resnet50/dali.py:19-322); the TPU-host answer is
+the native batch decoder (csrc/imagedec.cc) with a real thread pool.
+This tool measures what the input path can sustain at 1..N workers for
+both implementations, so capacity planning ("how many host cores does
+a v5e chip at 2500 img/s need?") is a measurement, not a guess.
+
+    python examples/collective/decode_bench.py             # synthetic
+    python examples/collective/decode_bench.py --data_dir /data/imagenet-rec
+
+Prints one JSON line: {"impl": {workers: img_s, ...}, ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+
+def measure(records: list[bytes], size: int, workers: int, native: bool,
+            repeats: int = 3) -> float:
+    import numpy as np
+
+    if native:
+        from edl_tpu.native import imagedec
+        t0 = time.perf_counter()
+        for r in range(repeats):
+            imagedec.decode_batch(records, size, seed=r, train=True,
+                                  threads=workers)
+        return len(records) * repeats / (time.perf_counter() - t0)
+    from concurrent.futures import ThreadPoolExecutor
+
+    from edl_tpu.data import images
+    rngs = [np.random.default_rng(i) for i in range(workers)]
+    n = len(records)
+    spans = [(w * n // workers, (w + 1) * n // workers, w)
+             for w in range(workers)]
+
+    def work(span):
+        lo, hi, w = span
+        for i in range(lo, hi):
+            images.decode_train(records[i], size, rngs[w], normalize=False)
+
+    with ThreadPoolExecutor(workers) as pool:
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            list(pool.map(work, spans))
+        return n * repeats / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", default="",
+                   help="recordio shards (default: synthetic 224px)")
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--records", type=int, default=256)
+    p.add_argument("--max_workers", type=int, default=0,
+                   help="0 = 2x cpu_count")
+    args = p.parse_args()
+
+    from edl_tpu.data import images
+    from edl_tpu.native import imagedec
+    from edl_tpu.native.recordio import RecordReader
+
+    if args.data_dir:
+        paths = sorted(glob.glob(os.path.join(args.data_dir, "*.rec")))
+    else:
+        paths = images.write_synthetic_imagenet(
+            os.path.join(os.environ.get("TMPDIR", "/tmp"), "edl-decode-bench"),
+            n_files=2, per_file=max(128, args.records // 2),
+            size=args.image_size, classes=100)
+    records: list[bytes] = []
+    for path in paths:
+        r = RecordReader(path)
+        records.extend(r)
+        r.close()
+        if len(records) >= args.records:
+            break
+    records = records[:args.records]
+
+    cores = os.cpu_count() or 1
+    cap = args.max_workers or 2 * cores
+    points = sorted({w for w in (1, 2, 4, 8, 16, 32) if w <= cap})
+    out: dict = {"host_cores": cores, "image_size": args.image_size,
+                 "records": len(records)}
+    impls = [("cv2_threads", False)]
+    if imagedec.available():
+        impls.append(("native", True))
+    for name, native in impls:
+        out[name] = {str(w): round(measure(records, args.image_size, w,
+                                           native), 1)
+                     for w in points}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Native batch image decoder (csrc/imagedec.cc) vs the cv2 path."""
+
+import numpy as np
+import pytest
+
+from edl_tpu.data import images
+from edl_tpu.native import imagedec
+from edl_tpu.native.recordio import RecordReader
+
+pytestmark = pytest.mark.skipif(not imagedec.available(),
+                                reason="native imagedec not built")
+
+
+@pytest.fixture(scope="module")
+def records(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rec")
+    paths = images.write_synthetic_imagenet(str(d), n_files=1, per_file=32,
+                                            size=96, classes=7)
+    r = RecordReader(paths[0])
+    recs = list(r)
+    r.close()
+    return recs
+
+
+def test_train_batch_format(records):
+    imgs, labels, failed = imagedec.decode_batch(records, 64, seed=3,
+                                                 train=True, threads=2)
+    assert failed == 0
+    assert imgs.shape == (32, 64, 64, 3) and imgs.dtype == np.uint8
+    assert labels.dtype == np.int32
+    assert (labels >= 0).all() and (labels < 7).all()
+    # augmentation actually varies between seeds
+    imgs2, _, _ = imagedec.decode_batch(records, 64, seed=4, train=True)
+    assert (imgs != imgs2).any()
+
+
+def test_eval_matches_cv2_path(records):
+    # labels exact; pixels within JPEG-decoder/resampler tolerance.
+    # The striped synthetic images are adversarial for resampling-phase
+    # differences (high-frequency edges), so the tight pixel assertion
+    # uses a smooth gradient photo; the stripes get a loose bound.
+    import cv2
+    imgs, labels, failed = imagedec.decode_batch(records, 64, train=False)
+    assert failed == 0
+    ref = [images.decode_eval(rec, 64, normalize=False) for rec in records]
+    ref_imgs = np.stack([x[0] for x in ref])
+    ref_labels = np.asarray([x[1] for x in ref], np.int32)
+    np.testing.assert_array_equal(labels, ref_labels)
+    diff = np.abs(imgs.astype(np.int32) - ref_imgs.astype(np.int32)).mean()
+    assert diff < 15.0, f"native eval diverged from cv2: mean |diff| {diff}"
+
+    y, x = np.mgrid[0:300, 0:400]
+    smooth = np.stack([(x * 255 / 400), (y * 255 / 300),
+                       ((x + y) * 255 / 700)], -1).astype(np.uint8)
+    ok, enc = cv2.imencode(".jpg", smooth, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    assert ok
+    rec = images.encode_sample(enc.tobytes(), 3)
+    nat, lab, failed = imagedec.decode_batch([rec], 224, train=False)
+    assert failed == 0 and lab[0] == 3
+    want = images.decode_eval(rec, 224, normalize=False)[0]
+    d = np.abs(nat[0].astype(np.int32) - want.astype(np.int32)).mean()
+    assert d < 3.0, f"smooth-image eval diverged: mean |diff| {d}"
+
+
+def test_bad_record_isolated(records):
+    bad = b"\x01\x00\x00\x00not-a-jpeg"
+    imgs, labels, failed = imagedec.decode_batch([bad, records[0]], 64,
+                                                 train=False)
+    assert failed == 1
+    assert labels[0] == -1 and labels[1] >= 0
+    assert (imgs[0] == 0).all() and (imgs[1] != 0).any()
+
+
+def test_image_batches_native_path(records, tmp_path):
+    paths = images.write_synthetic_imagenet(str(tmp_path), n_files=1,
+                                            per_file=24, size=96, classes=5)
+    for normalize in (False, True):
+        batches = list(images.ImageBatches(paths, 8, image_size=64,
+                                           train=True, num_workers=2,
+                                           normalize=normalize,
+                                           use_native=True))
+        assert len(batches) == 3
+        b = batches[0]
+        assert b["image"].shape == (8, 64, 64, 3)
+        assert b["image"].dtype == (np.float32 if normalize else np.uint8)
+        assert b["label"].shape == (8,)
