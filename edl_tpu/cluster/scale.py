@@ -1,0 +1,60 @@
+"""Desired-size record: the controller -> generator scaling channel.
+
+The reference's elastic controller was an external k8s binary that
+resized TrainingJob replicas (k8s/edl_controller.yaml:21,
+``-max_load_desired 0.9``); the launcher side only ever saw pods appear
+and disappear.  Here the channel is explicit: the controller writes
+``desired nodes`` for a job into the coordination store, and
+
+- the leader's :class:`ClusterGenerator` treats it as a live cap —
+  scale-in rebuilds the cluster without the highest-rank pods,
+  scale-out headroom opens up to ``min(desired, max_nodes)``;
+- an excluded launcher sees the record and exits cleanly as DESCALED
+  (exit 0) instead of failing its barrier — under k8s the replica
+  controller then reaps it, standalone it just ends;
+- the job's ``nodes_range`` is published here by the generator so the
+  controller never needs the launcher CLI's flags.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+def save_desired_nodes(store, job_id: str, nodes: int,
+                       by: str = "controller") -> None:
+    store.put(paths.key(job_id, constants.ETCD_SCALE, "desired"),
+              json.dumps({"nodes": int(nodes), "by": by,
+                          "at": time.time()}).encode())
+
+
+def load_desired_nodes(store, job_id: str) -> int | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_SCALE, "desired"))
+    if rec is None:
+        return None
+    return int(json.loads(rec.value.decode())["nodes"])
+
+
+def clear_desired_nodes(store, job_id: str) -> None:
+    store.delete(paths.key(job_id, constants.ETCD_SCALE, "desired"))
+
+
+def save_nodes_range(store, job_id: str, min_nodes: int,
+                     max_nodes: int) -> None:
+    """Published by the generator so controllers can read the job's
+    elasticity bounds from the store."""
+    store.put(paths.key(job_id, constants.ETCD_SCALE, "range"),
+              json.dumps({"min": int(min_nodes),
+                          "max": int(max_nodes)}).encode())
+
+
+def load_nodes_range(store, job_id: str) -> tuple[int, int] | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_SCALE, "range"))
+    if rec is None:
+        return None
+    d = json.loads(rec.value.decode())
+    return int(d["min"]), int(d["max"])

@@ -21,6 +21,10 @@ class Status(str, enum.Enum):
     PENDING = "pending"
     SUCCEED = "succeed"
     FAILED = "failed"
+    # scaled out of the cluster by the controller's desired-size record
+    # (cluster/scale.py): a clean exit-0 departure, not a failure and
+    # not job completion
+    DESCALED = "descaled"
 
 
 def save_pod_status(store, job_id: str, pod_id: str, status: Status) -> None:

@@ -8,10 +8,17 @@ cluster record:
   (cluster_generator.py:95-134);
 - a member vanished (TTL expiry) or FAILED → rebuild from the alive
   set, new stage (:179-192);
-- new INITIAL pods, room under ``max_nodes``, and train status still
+- new INITIAL pods, room under the live cap, and train status still
   INITIAL/RUNNING → append them with new ranks, new stage (:136-153,
   :200-215) — the NEARTHEEND anti-meaningless-scaling rule;
 - alive membership below ``min_nodes`` → log and wait (:255-264).
+
+The live cap is ``min(max_nodes, desired)`` where ``desired`` is the
+controller's desired-size record (cluster/scale.py) — beyond the
+reference, whose controller could only add/remove k8s replicas and
+wait for the TTL machinery.  When the alive membership EXCEEDS the
+cap, the generator rebuilds without the highest-rank pods (scale-in);
+the excluded launchers exit cleanly as DESCALED.
 
 Every write is the guarded transaction "leader seat still mine"
 (:223-250) so a deposed leader can never clobber its successor.
@@ -21,6 +28,7 @@ from __future__ import annotations
 
 import threading
 
+from edl_tpu.cluster import scale
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.pod import Pod
 from edl_tpu.cluster.status import Status, load_pods_status
@@ -67,16 +75,21 @@ class ClusterGenerator(threading.Thread):
             return None  # our own advert hasn't landed / expired; wait
         statuses = load_pods_status(self._store, self._job_id)
         current = Cluster.load_from_store(self._store, self._job_id)
+        self._publish_range()
 
         if current is None:
             return self._write(self._build_initial(resource))
 
+        cap = self._cap()
         alive = [p for p in current.pods
                  if p.pod_id in resource and statuses.get(p.pod_id) != Status.FAILED]
         gone = [p for p in current.pods if p.pod_id not in {a.pod_id for a in alive}]
-        # a pod that left after SUCCEEDing is job completion, not a failure —
-        # rebuilding would pointlessly restart the survivors mid-finish
-        lost = any(statuses.get(p.pod_id) != Status.SUCCEED for p in gone)
+        # a pod that left after SUCCEEDing (job completion) or DESCALED
+        # (controller scale-in) is not a failure — rebuilding would
+        # pointlessly restart the survivors
+        lost = any(statuses.get(p.pod_id) not in (Status.SUCCEED,
+                                                  Status.DESCALED)
+                   for p in gone)
 
         # only *members'* SUCCEED blocks scale-out (job is finishing); a
         # stale unleased SUCCEED left by a previous run of this job_id is
@@ -87,22 +100,57 @@ class ClusterGenerator(threading.Thread):
                    and statuses.get(pid, Status.INITIAL) == Status.INITIAL]
         joiners: list[Pod] = []
         if new_ids and not any_succeeded and self._scaling_allowed():
-            room = self._max_nodes - len(alive)
+            room = cap - len(alive)
             joiners = [resource[pid] for pid in sorted(new_ids)[:max(0, room)]]
 
-        if not lost and not joiners:
+        # controller scale-in: alive membership above the cap and the
+        # job can still legally resize -> drop the highest ranks (the
+        # leader is rank 0 and always survives)
+        shrink = (len(alive) > cap and not any_succeeded
+                  and self._scaling_allowed())
+
+        if not lost and not joiners and not shrink:
             return current
 
         pods = self._leader_first(alive + joiners, resource)
+        if shrink and len(pods) > cap:     # _cap() already floors at min_nodes
+            pods = pods[:cap]
         if len(pods) < self._min_nodes:
             logger.error("alive pods %d below min_nodes %d; waiting",
                          len(pods), self._min_nodes)
             return current
         cluster = Cluster.from_pods(pods)
-        logger.info("cluster stage %s: %d pods (%s%s)", cluster.stage[:8], len(pods),
+        logger.info("cluster stage %s: %d pods (%s%s%s)", cluster.stage[:8],
+                    len(pods),
                     f"-{len(current.pods) - len(alive)} lost " if lost else "",
-                    f"+{len(joiners)} joined" if joiners else "")
+                    f"+{len(joiners)} joined" if joiners else "",
+                    f"capped at {cap}" if shrink else "")
         return self._write(cluster)
+
+    def _cap(self) -> int:
+        """Live membership cap: max_nodes bounded below by min_nodes and
+        overridden downward by the controller's desired record."""
+        desired = None
+        try:
+            desired = scale.load_desired_nodes(self._store, self._job_id)
+        except Exception:  # noqa: BLE001 — a bad record must not kill us
+            logger.exception("desired-nodes record unreadable; ignoring")
+        if desired is None:
+            return self._max_nodes
+        return max(self._min_nodes, min(self._max_nodes, desired))
+
+    _range_published = False
+
+    def _publish_range(self) -> None:
+        """One-time nodes_range advert for external controllers."""
+        if self._range_published:
+            return
+        try:
+            scale.save_nodes_range(self._store, self._job_id,
+                                   self._min_nodes, self._max_nodes)
+            self._range_published = True
+        except Exception:  # noqa: BLE001 — advisory only
+            logger.exception("nodes_range publish failed")
 
     def _scaling_allowed(self) -> bool:
         """Only scale out while training is INITIAL/RUNNING (NEARTHEEND rule)."""
@@ -114,7 +162,7 @@ class ClusterGenerator(threading.Thread):
             logger.info("waiting for pods: %d/%d registered",
                         len(resource), self._min_nodes)
             return None
-        pods = self._leader_first(list(resource.values()), resource)[:self._max_nodes]
+        pods = self._leader_first(list(resource.values()), resource)[:self._cap()]
         cluster = Cluster.from_pods(pods)
         logger.info("initial cluster stage %s with %d pods", cluster.stage[:8], len(pods))
         return cluster

@@ -77,7 +77,10 @@ def clear_stale_job_tables(store, job_id: str) -> None:
         return  # another relaunching pod claimed the cleanup
     for table in (constants.ETCD_POD_STATUS, constants.ETCD_TRAIN_STATUS,
                   constants.ETCD_CLUSTER, constants.ETCD_READER,
-                  constants.ETCD_DIST_READER):
+                  constants.ETCD_DIST_READER, constants.ETCD_SCALE):
+        # ETCD_SCALE: a stale desired-nodes record from the previous
+        # incarnation would permanently cap the relaunched job's
+        # cluster below its nodes_range (a live controller re-writes it)
         store.delete_prefix(paths.table_prefix(job_id, table))
 
 
@@ -100,7 +103,9 @@ def run(argv: list[str] | None = None) -> int:
     final = Launcher(job_env, pod, store, args.training_script,
                      args.script_args).launch()
     logger.info("pod %s finished with %s", pod.pod_id, final.value)
-    return 0 if final == Status.SUCCEED else 1
+    # DESCALED = scaled out by the controller: a clean departure (the
+    # job continues on the remaining pods), not a failure
+    return 0 if final in (Status.SUCCEED, Status.DESCALED) else 1
 
 
 def main():  # pragma: no cover - thin wrapper

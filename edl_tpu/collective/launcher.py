@@ -29,6 +29,7 @@ from edl_tpu.collective.pod_server import start_pod_server
 from edl_tpu.collective.watcher import ClusterWatcher
 from edl_tpu.data.data_server import DataService
 from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlDescaledError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -74,6 +75,11 @@ class Launcher:
         self._pod.port = self._server.port
         try:
             final = self._run()
+        except EdlDescaledError as e:
+            # surplus to the controller's desired size (barrier client
+            # detected it): a clean departure, not a failure
+            logger.info("descaled: %s", e)
+            final = Status.DESCALED
         except Exception:
             logger.exception("launcher failed")
             final = Status.FAILED
@@ -132,6 +138,10 @@ class Launcher:
             self._clear_heartbeat()
             resize_times["killed"] = time.time()
             old_pods = set(cluster.pod_ids())
+            if self._descaled(old_pods):
+                logger.info("scaled out of the cluster by the controller's "
+                            "desired-size record; exiting cleanly")
+                return Status.DESCALED
             cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
                                          timeout=self._resize_barrier_timeout)
             resize_times["barrier"] = time.time()
@@ -281,6 +291,28 @@ class Launcher:
         return self._ttl + 2 * constants.GENERATOR_PERIOD + 2 * constants.WATCHER_PERIOD
 
     # -- helpers -------------------------------------------------------------
+    def _descaled(self, old_pods: set[str]) -> bool:
+        """True when THIS pod was scaled out of the cluster by the
+        controller: the new cluster record excludes it, a desired-size
+        record below the old membership explains why, and this pod's
+        OLD rank is one the cap retires (ranks >= desired — the
+        generator drops highest ranks).  A pod excluded for any other
+        reason (e.g. its own lease blipped during the same tick) keeps
+        the barrier path and rejoins; the barrier's surplus grace
+        still bounds a genuinely-descaled pod's wait."""
+        from edl_tpu.cluster import scale
+        try:
+            cur = Cluster.load_from_store(self._store, self._job_env.job_id)
+            if cur is None or cur.get_pod(self._pod.pod_id) is not None:
+                return False
+            desired = scale.load_desired_nodes(self._store,
+                                               self._job_env.job_id)
+        except Exception:  # noqa: BLE001 — on doubt, take the barrier
+            logger.exception("descale check failed")
+            return False
+        return (desired is not None and desired < len(old_pods)
+                and self._pod.rank >= desired)
+
     def _sync_pod_from(self, cluster: Cluster) -> None:
         me = cluster.get_pod(self._pod.pod_id)
         assert me is not None, "barrier returned a cluster without this pod"

@@ -13,17 +13,43 @@ import time
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.collective.leader import load_leader_pod
 from edl_tpu.rpc.client import RpcClient
-from edl_tpu.utils.exceptions import EdlBarrierError, EdlCoordError
+from edl_tpu.utils.exceptions import (
+    EdlBarrierError, EdlCoordError, EdlDescaledError,
+)
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
 
+def _surplus(store, job_id: str, pod_id: str) -> bool:
+    """True when the controller's desired-size record makes this pod
+    surplus: the current cluster is at/over ``desired`` WITHOUT it.
+    Covers both the resize path (a member scaled out mid-run) and the
+    initial barrier (a pod that arrived after — or was excluded during
+    — a scale-in): either way the pod must not keep barriering against
+    a cluster that will never include it."""
+    from edl_tpu.cluster import scale
+    cluster = Cluster.load_from_store(store, job_id)
+    if cluster is None or cluster.get_pod(pod_id) is not None:
+        return False
+    desired = scale.load_desired_nodes(store, job_id)
+    return desired is not None and len(cluster.pods) >= desired
+
+
 def barrier(store, job_id: str, pod_id: str, timeout: float,
             period: float = 1.0) -> Cluster:
+    from edl_tpu.utils import constants
+
     deadline = time.monotonic() + timeout
     last_err: Exception = EdlBarrierError("barrier never attempted")
     client: RpcClient | None = None  # pooled across polls; leader rarely moves
+    # surplus must PERSIST past a lease-TTL + generator window before we
+    # declare DESCALED: right after a member crash the cluster record
+    # still lists the dead pod, so a freshly relaunched replacement
+    # transiently looks surplus even though the rebuild will seat it
+    surplus_since: float | None = None
+    surplus_grace = (constants.ETCD_TTL + 2 * constants.GENERATOR_PERIOD
+                     + 2.0)
     try:
         while time.monotonic() < deadline:
             try:
@@ -37,6 +63,22 @@ def barrier(store, job_id: str, pod_id: str, timeout: float,
                 r = client.call("barrier", job_id=job_id, pod_id=pod_id)
                 return Cluster().from_json(r["cluster"])
             except (EdlBarrierError, EdlCoordError) as e:
+                try:
+                    if _surplus(store, job_id, pod_id):
+                        now = time.monotonic()
+                        if surplus_since is None:
+                            surplus_since = now
+                        elif now - surplus_since > surplus_grace:
+                            raise EdlDescaledError(
+                                f"pod {pod_id[:8]} surplus to the desired "
+                                f"cluster size for {now - surplus_since:.0f}s"
+                            ) from e
+                    else:
+                        surplus_since = None
+                except EdlDescaledError:
+                    raise
+                except Exception:  # noqa: BLE001 — check is best-effort
+                    logger.exception("surplus check failed")
                 last_err = e
                 time.sleep(period)
         raise EdlBarrierError(f"barrier timed out after {timeout}s: {last_err}")

@@ -1,0 +1,7 @@
+"""Elastic controller: the in-tree replacement for the reference's k8s
+TrainingJob controller/autoscaler (k8s/edl_controller.yaml)."""
+
+from edl_tpu.controller.controller import Controller
+from edl_tpu.controller.policy import JobView, compute_desired
+
+__all__ = ["Controller", "JobView", "compute_desired"]

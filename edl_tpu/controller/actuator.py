@@ -1,0 +1,60 @@
+"""Replica actuators: make the infra match a desired pod count.
+
+The desired-size RECORD (cluster/scale.py) handles the in-band half —
+the generator shrinks/permits-growth and excluded launchers exit
+DESCALED.  An actuator handles the out-of-band half: actually creating
+or destroying pod replicas.  Standalone process deployments need none
+(operators start/stop launchers); under k8s the controller patches the
+workload's replica count, which is exactly what the reference's
+controller binary did to its TrainingJob TPR.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class NullActuator:
+    """Record-only deployments: the store record is the whole signal."""
+
+    def scale(self, job_id: str, replicas: int) -> bool:
+        return True
+
+
+class KubectlActuator:
+    """``kubectl scale`` on the workload backing a job.
+
+    ``workload_of(job_id)`` maps job ids to k8s workload refs
+    (``statefulset/edl-train``); by default the job id IS the workload
+    name of a StatefulSet, matching k8s/train-job.yaml.  StatefulSets
+    terminate the highest ordinals first on scale-in — the same
+    highest-rank-first order the generator's cap uses, so the record
+    and the replica patch agree about WHICH pods leave.
+    """
+
+    def __init__(self, namespace: str = "default", kubectl: str = "kubectl",
+                 workload_of=None):
+        self._ns = namespace
+        self._kubectl = kubectl
+        self._workload_of = workload_of or (lambda job_id: f"statefulset/{job_id}")
+
+    def scale(self, job_id: str, replicas: int) -> bool:
+        ref = self._workload_of(job_id)
+        cmd = [self._kubectl, "-n", self._ns, "scale", ref,
+               f"--replicas={replicas}"]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            logger.error("kubectl scale failed: %s (%s)", cmd, e)
+            return False
+        if r.returncode != 0:
+            logger.error("kubectl scale failed (%d): %s", r.returncode,
+                         r.stderr.strip()[:300])
+            return False
+        logger.info("scaled %s to %d replicas", ref, replicas)
+        return True

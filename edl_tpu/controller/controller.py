@@ -1,0 +1,169 @@
+"""The controller loop: observe jobs in the coordination store, compute
+desired sizes, write the scaling records, drive the replica actuator.
+
+Reference parity: the k8s TrainingJob controller+autoscaler
+(k8s/edl_controller.yaml, doc/usage.md "Auto-scaling experiment") —
+the one reference subsystem with no in-tree analogue until now.  The
+difference in design: the reference controller could only patch k8s
+replica counts and let TTL expiry do the rest; this controller speaks
+the SAME coordination store as the launchers, so scale-in is an
+explicit record the generator honors deterministically (highest ranks
+leave, leader survives) and scale-out headroom opens before the new
+replicas even boot.
+
+Job discovery: jobs publish their ``nodes_range`` via the generator
+(cluster/scale.py save_nodes_range); the controller scans the store
+root for them, so ``--job_id`` lists are optional.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from edl_tpu.cluster import paths, scale
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.status import Status, load_job_status
+from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
+from edl_tpu.controller.actuator import NullActuator
+from edl_tpu.controller.policy import JobView, compute_desired
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class Controller:
+    def __init__(self, store, *, capacity: int,
+                 max_load_desired: float = 0.9,
+                 job_ids: list[str] | None = None,
+                 actuator=None, period: float = 5.0,
+                 cooldown: float = 30.0):
+        """``capacity``: schedulable pod slots across the cluster (the
+        k8s node budget; the thing ``max_load_desired`` scales).
+        ``job_ids``: explicit jobs to manage; None = discover every job
+        that published a nodes_range.  ``cooldown``: minimum seconds
+        between desired-size changes per job — resizes cost a
+        stop-resume, so flapping is worse than lag."""
+        self._store = store
+        self._capacity = capacity
+        self._max_load = max_load_desired
+        self._job_ids = job_ids
+        self._actuator = actuator or NullActuator()
+        self._period = period
+        self._cooldown = cooldown
+        self._last_change: dict[str, float] = {}
+        self._reaped: set[str] = set()
+        self._halt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- observation ---------------------------------------------------------
+    def discover_jobs(self) -> list[str]:
+        if self._job_ids is not None:
+            return list(self._job_ids)
+        # every job that published a nodes_range owns a
+        # /<root>/<job>/scale/range key
+        recs, _ = self._store.get_prefix(paths.ROOT + "/")
+        jobs = set()
+        suffix = f"/{constants.ETCD_SCALE}/range"
+        for r in recs:
+            if r.key.endswith(suffix):
+                jobs.add(r.key[len(paths.ROOT) + 1:-len(suffix)])
+        return sorted(jobs)
+
+    def _terminal(self, job_id: str) -> bool:
+        """SUCCEED is always terminal; FAILED only counts once no pod
+        holds a live resource lease — the launcher writes a PROVISIONAL
+        job FAILED on any pod death (launcher.py _report_and_cleanup)
+        that an elastic recovery overwrites, and reaping a recovering
+        job would kill it."""
+        status = load_job_status(self._store, job_id)
+        if status == Status.SUCCEED:
+            return True
+        if status != Status.FAILED:
+            return False
+        from edl_tpu.collective.resource import load_resource_pods
+        return not load_resource_pods(self._store, job_id)
+
+    def job_view(self, job_id: str) -> JobView | None:
+        """None = job is terminal or not observable (skip it)."""
+        rng = scale.load_nodes_range(self._store, job_id)
+        if rng is None:
+            return None
+        if self._terminal(job_id):
+            return None
+        cluster = Cluster.load_from_store(self._store, job_id)
+        current = len(cluster.pods) if cluster else 0
+        ts = load_train_statuses(self._store, job_id)
+        scalable = all(s in SCALABLE for s in ts.values())
+        return JobView(job_id=job_id, min_nodes=rng[0], max_nodes=rng[1],
+                       current_nodes=current, scalable=scalable)
+
+    # -- one reconciliation tick (unit-test entry point) ---------------------
+    def reconcile_once(self) -> dict[str, int]:
+        """Returns the desired sizes it ACTED on this tick."""
+        jobs = self.discover_jobs()
+        self._reap_finished(jobs)
+        views = [v for v in (self.job_view(j) for j in jobs)
+                 if v is not None]
+        desired = compute_desired(views, self._capacity, self._max_load)
+        acted: dict[str, int] = {}
+        now = time.monotonic()
+        for v in views:
+            want = desired[v.job_id]
+            if want == v.current_nodes:
+                continue
+            last = self._last_change.get(v.job_id, -float("inf"))
+            if now - last < self._cooldown:
+                continue
+            prev = None
+            try:
+                prev = scale.load_desired_nodes(self._store, v.job_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("desired record unreadable for %s", v.job_id)
+            if prev == want and v.current_nodes != want:
+                # record already says so; the cluster just hasn't
+                # converged (e.g. waiting for replicas) — don't re-stamp
+                # the cooldown, but do re-drive the actuator
+                self._actuator.scale(v.job_id, want)
+                continue
+            logger.info("job %s: %d -> %d pods (range %d:%d, capacity %d)",
+                        v.job_id, v.current_nodes, want, v.min_nodes,
+                        v.max_nodes, self._capacity)
+            scale.save_desired_nodes(self._store, v.job_id, want)
+            self._actuator.scale(v.job_id, want)
+            self._last_change[v.job_id] = now
+            acted[v.job_id] = want
+        return acted
+
+    def _reap_finished(self, jobs: list[str]) -> None:
+        """Scale terminal jobs' workloads to zero, once — the reference
+        controller reaped finished TrainingJobs; without this a
+        SUCCEEDed StatefulSet restart-loops its exit-0 launchers."""
+        for job_id in jobs:
+            if job_id in self._reaped:
+                continue
+            if self._terminal(job_id):
+                logger.info("job %s terminal; scaling workload to 0", job_id)
+                if self._actuator.scale(job_id, 0):
+                    self._reaped.add(job_id)
+
+    # -- the loop ------------------------------------------------------------
+    def run_forever(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("controller tick failed")
+            self._halt.wait(self._period)
+
+    def start(self) -> "Controller":
+        self._thread = threading.Thread(target=self.run_forever, daemon=True,
+                                        name="edl-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)

@@ -1,0 +1,69 @@
+"""``edl-controller`` CLI: the elastic autoscaler daemon.
+
+    edl-controller --coord_endpoints host:2379 --capacity 16
+    edl-controller --coord_endpoints host:2379 --capacity 16 \
+        --k8s_namespace training   # also patch StatefulSet replicas
+
+Reference: the TrainingJob controller deployment
+(/root/reference/k8s/edl_controller.yaml) with ``-max_load_desired``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="EDL-TPU elastic controller")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--capacity", type=int, required=True,
+                   help="schedulable pod slots across the cluster")
+    p.add_argument("--max_load_desired", type=float, default=0.9,
+                   help="fill the cluster to at most this fraction "
+                        "(reference edl_controller.yaml:21)")
+    p.add_argument("--job_id", action="append", default=None,
+                   help="manage only these jobs (repeatable); default: "
+                        "discover every job that published a nodes_range")
+    p.add_argument("--period", type=float, default=5.0)
+    p.add_argument("--cooldown", type=float, default=30.0,
+                   help="min seconds between resizes per job")
+    p.add_argument("--k8s_namespace", default="",
+                   help="when set, also `kubectl scale` the job's "
+                        "StatefulSet in this namespace")
+    p.add_argument("--kubectl", default="kubectl")
+    return p
+
+
+def run(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+
+    from edl_tpu.controller.actuator import KubectlActuator, NullActuator
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.coord.client import connect
+
+    actuator = (KubectlActuator(namespace=args.k8s_namespace,
+                                kubectl=args.kubectl)
+                if args.k8s_namespace else NullActuator())
+    ctl = Controller(connect(args.coord_endpoints), capacity=args.capacity,
+                     max_load_desired=args.max_load_desired,
+                     job_ids=args.job_id, actuator=actuator,
+                     period=args.period, cooldown=args.cooldown)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    ctl.start()
+    stop.wait()
+    ctl.stop()
+    return 0
+
+
+def main():  # pragma: no cover - thin wrapper
+    import sys
+
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

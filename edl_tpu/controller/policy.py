@@ -1,0 +1,63 @@
+"""Autoscaling policy: cluster capacity -> per-job desired pod counts.
+
+The reference controller's contract (k8s/edl_controller.yaml:21,
+``-max_load_desired 0.9``; doc/usage.md): keep the cluster filled to at
+most ``max_load_desired`` of its schedulable capacity, splitting the
+budget fairly across running elastic jobs, each clamped to its own
+``nodes_range``.  This module is the PURE half — no store, no k8s —
+so the policy is unit-testable against fabricated job views.
+
+Rules (reference behavior + the repo's own scaling gates):
+
+- budget = floor(capacity * max_load_desired), at least one pod;
+- fair share: each active job gets budget // n_jobs, remainder to the
+  earliest jobs (stable by job_id) — the reference's fragment-avoiding
+  fair division;
+- clamp to [min_nodes, max_nodes] per job;
+- a job whose train status is not scalable (NEARTHEEND — the
+  anti-meaningless-scaling rule, train_status.py) keeps its current
+  size;
+- never scale a terminal (SUCCEED/FAILED) job — it leaves the view.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class JobView:
+    """What the controller knows about one live job."""
+
+    job_id: str
+    min_nodes: int
+    max_nodes: int
+    current_nodes: int
+    scalable: bool = True     # train status INITIAL/RUNNING (SCALABLE set)
+
+
+def compute_desired(jobs: list[JobView], capacity: int,
+                    max_load_desired: float = 0.9) -> dict[str, int]:
+    """Desired pod count per job_id (only jobs whose target differs
+    from ``current_nodes`` need acting on; all are returned)."""
+    if not jobs:
+        return {}
+    budget = max(1, int(capacity * max_load_desired))
+    out: dict[str, int] = {}
+    # frozen (NEARTHEEND etc.) jobs keep their pods AND their pods keep
+    # consuming the budget — otherwise total desired could exceed the
+    # max_load_desired contract while a job finishes
+    flexible = []
+    for job in sorted(jobs, key=lambda j: j.job_id):
+        if job.scalable:
+            flexible.append(job)
+        else:
+            out[job.job_id] = job.current_nodes
+            budget -= job.current_nodes
+    if not flexible:
+        return out
+    base, rem = divmod(max(0, budget), len(flexible))
+    for i, job in enumerate(flexible):
+        share = base + (1 if i < rem else 0)
+        out[job.job_id] = max(job.min_nodes, min(job.max_nodes, share))
+    return out

@@ -17,6 +17,7 @@ ETCD_STATE = "state"                # train State (data checkpoint etc.)
 ETCD_DIST_READER = "dist_reader"
 ETCD_RECOVERY = "recovery"          # per-stage resize timing records
 ETCD_HEARTBEAT = "heartbeat"        # per-pod trainer liveness beats
+ETCD_SCALE = "scale"                # controller desired-size + nodes_range
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -30,6 +31,7 @@ ALL_TABLES = [
     ETCD_DIST_READER,
     ETCD_RECOVERY,
     ETCD_HEARTBEAT,
+    ETCD_SCALE,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)

@@ -42,6 +42,12 @@ class EdlRegisterError(EdlRetryableError):
     """TTL-leased registration could not be established/refreshed."""
 
 
+class EdlDescaledError(EdlError):
+    """This pod is surplus to the controller's desired size: the cluster
+    is at/over the desired-nodes record without it.  Not retryable —
+    the launcher exits cleanly (DESCALED)."""
+
+
 class EdlStopIteration(EdlError):
     """Remote signals end-of-data (maps to StopIteration client-side)."""
 

@@ -1,0 +1,273 @@
+"""Elastic controller/autoscaler (edl_tpu/controller) + the
+desired-size scaling channel (cluster/scale.py, generator cap,
+launcher DESCALED exit).
+
+Reference parity target: the k8s TrainingJob controller
+(/root/reference/k8s/edl_controller.yaml, -max_load_desired 0.9) —
+policy unit tests against fabricated views, store-level reconcile
+tests on MemoryKV, and a live two-launcher scale-in e2e driven by a
+real Controller.
+"""
+
+import os
+import time
+
+import pytest
+
+from edl_tpu.cluster import scale
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.status import Status, save_job_status, save_pod_status
+from edl_tpu.cluster.train_status import TrainStatus, save_train_status
+from edl_tpu.collective.generator import ClusterGenerator
+from edl_tpu.collective.resource import load_resource_pods, register_pod
+from edl_tpu.controller import Controller, JobView, compute_desired
+from edl_tpu.utils import constants
+from tests.test_cluster_model import make_pod
+from tests.test_elastic_control import JOB, wait_for
+
+
+class FakeActuator:
+    def __init__(self, ok: bool = True):
+        self.calls: list[tuple[str, int]] = []
+        self.ok = ok
+
+    def scale(self, job_id: str, replicas: int) -> bool:
+        self.calls.append((job_id, replicas))
+        return self.ok
+
+
+# -- policy (pure) -----------------------------------------------------------
+def test_policy_fair_share_and_clamps():
+    jobs = [JobView("a", 1, 8, 2), JobView("b", 2, 3, 2),
+            JobView("c", 1, 2, 1)]
+    # capacity 10 @ 0.9 -> budget 9 -> shares 3/3/3, clamped per range
+    out = compute_desired(jobs, capacity=10, max_load_desired=0.9)
+    assert out == {"a": 3, "b": 3, "c": 2}
+
+
+def test_policy_remainder_goes_to_earliest_jobs():
+    jobs = [JobView("a", 1, 8, 1), JobView("b", 1, 8, 1),
+            JobView("c", 1, 8, 1)]
+    out = compute_desired(jobs, capacity=7, max_load_desired=1.0)
+    assert out == {"a": 3, "b": 2, "c": 2}         # 7 = 3+2+2
+
+
+def test_policy_min_nodes_floor_even_over_budget():
+    out = compute_desired([JobView("a", 4, 8, 4)], capacity=2,
+                          max_load_desired=1.0)
+    assert out == {"a": 4}      # the job's own floor wins over the budget
+
+
+def test_policy_non_scalable_freezes():
+    jobs = [JobView("a", 1, 8, 5, scalable=False)]
+    out = compute_desired(jobs, capacity=100)
+    assert out == {"a": 5}
+
+
+def test_policy_frozen_jobs_consume_budget():
+    # a NEARTHEEND job holding 8 pods leaves only 1 of the 9-pod budget
+    # for the flexible job — total desired must respect max_load_desired
+    jobs = [JobView("a", 1, 8, 8, scalable=False), JobView("b", 1, 8, 2)]
+    out = compute_desired(jobs, capacity=10, max_load_desired=0.9)
+    assert out == {"a": 8, "b": 1}
+    assert sum(out.values()) <= 9
+
+
+def test_policy_empty():
+    assert compute_desired([], capacity=8) == {}
+
+
+# -- generator honors the desired record -------------------------------------
+@pytest.fixture
+def three_pods(memkv):
+    pods = [make_pod(f"10.0.0.{i}") for i in range(3)]
+    regs = [register_pod(memkv, JOB, p, ttl=0.8) for p in pods]
+    from edl_tpu.cluster import paths
+    memkv.put(paths.key(JOB, constants.ETCD_POD_RANK, "0"),
+              pods[0].pod_id.encode())
+    yield pods, regs
+    for r in regs:
+        r.stop()
+
+
+def test_generator_scale_in_to_desired(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=1,
+                           max_nodes=3, period=0.1)
+    c1 = gen.reconcile_once()
+    assert len(c1.pods) == 3
+    # the generator published the job's range for controllers
+    assert scale.load_nodes_range(memkv, JOB) == (1, 3)
+
+    scale.save_desired_nodes(memkv, JOB, 2)
+    c2 = gen.reconcile_once()
+    assert c2.stage != c1.stage
+    assert len(c2.pods) == 2
+    assert c2.pods[0].pod_id == pods[0].pod_id     # leader survives
+    assert c2.pod_ids() == c1.pod_ids()[:2]        # highest rank dropped
+
+    # idempotent at the target
+    c3 = gen.reconcile_once()
+    assert c3.stage == c2.stage
+
+
+def test_generator_desired_caps_joiners_and_clamps_to_min(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=2,
+                           max_nodes=3, period=0.1)
+    scale.save_desired_nodes(memkv, JOB, 1)        # below min_nodes
+    c1 = gen.reconcile_once()
+    assert len(c1.pods) == 2                       # clamped to min_nodes
+
+    # the pod the cap excluded also competes for re-admission; retire
+    # its advert so the NEW pod is the only joiner candidate
+    excluded = [p for p in pods if p.pod_id not in c1.pod_ids()]
+    for p, r in zip(pods, regs):
+        if p in excluded:
+            r.stop_heartbeat_only()
+    assert wait_for(lambda: all(p.pod_id not in load_resource_pods(memkv, JOB)
+                                for p in excluded), 5.0)
+
+    pod_new = make_pod("10.0.0.9")
+    reg_new = register_pod(memkv, JOB, pod_new, ttl=0.8)
+    assert wait_for(lambda: pod_new.pod_id in load_resource_pods(memkv, JOB))
+    c2 = gen.reconcile_once()
+    assert pod_new.pod_id not in c2.pod_ids()      # desired blocks joiners
+    scale.save_desired_nodes(memkv, JOB, 3)
+    c3 = gen.reconcile_once()
+    assert pod_new.pod_id in c3.pod_ids()          # raised desired admits
+    reg_new.stop()
+
+
+def test_generator_no_scale_in_near_end(memkv, three_pods):
+    pods, regs = three_pods
+    gen = ClusterGenerator(memkv, JOB, pods[0].pod_id, min_nodes=1,
+                           max_nodes=3, period=0.1)
+    c1 = gen.reconcile_once()
+    save_train_status(memkv, JOB, pods[0].pod_id, TrainStatus.NEARTHEEND)
+    scale.save_desired_nodes(memkv, JOB, 1)
+    c2 = gen.reconcile_once()
+    assert c2.stage == c1.stage and len(c2.pods) == 3
+
+
+# -- controller reconcile against the store ----------------------------------
+def _publish_job(store, job_id, pods, min_n, max_n):
+    scale.save_nodes_range(store, job_id, min_n, max_n)
+    cluster = Cluster.from_pods(pods)
+    # cluster writes are leader-guarded; stamp the record directly
+    from edl_tpu.cluster import paths
+    store.put(paths.key(job_id, constants.ETCD_CLUSTER, "cluster"),
+              cluster.to_json().encode())
+    return cluster
+
+
+def _put_cluster(store, job_id, pods):
+    from edl_tpu.cluster import paths
+    cluster = Cluster.from_pods(pods)
+    store.put(paths.key(job_id, constants.ETCD_CLUSTER, "cluster"),
+              cluster.to_json().encode())
+    return cluster
+
+
+def test_controller_reconcile_writes_record_and_actuates(memkv):
+    pods = [make_pod(f"10.1.0.{i}") for i in range(2)]
+    _publish_job(memkv, "j1", pods, 1, 8)
+    act = FakeActuator()
+    ctl = Controller(memkv, capacity=10, max_load_desired=0.9,
+                     actuator=act, cooldown=0.0)
+    assert ctl.discover_jobs() == ["j1"]
+    acted = ctl.reconcile_once()
+    assert acted == {"j1": 8}                      # budget 9, clamped to max 8
+    assert scale.load_desired_nodes(memkv, "j1") == 8
+    assert act.calls == [("j1", 8)]
+
+    # converged cluster -> no further action
+    _put_cluster(memkv, "j1", [make_pod(f"10.1.1.{i}") for i in range(8)])
+    assert ctl.reconcile_once() == {}
+
+
+def test_controller_cooldown_blocks_flapping(memkv):
+    pods = [make_pod("10.2.0.1")]
+    _publish_job(memkv, "j2", pods, 1, 8)
+    act = FakeActuator()
+    ctl = Controller(memkv, capacity=4, max_load_desired=1.0,
+                     actuator=act, cooldown=60.0)
+    assert ctl.reconcile_once() == {"j2": 4}
+    # capacity changes -> new target, but inside the cooldown window
+    ctl._capacity = 2
+    assert ctl.reconcile_once() == {}
+    assert scale.load_desired_nodes(memkv, "j2") == 4
+
+
+def test_controller_redrives_actuator_while_unconverged(memkv):
+    pods = [make_pod("10.3.0.1")]
+    _publish_job(memkv, "j3", pods, 1, 4)
+    act = FakeActuator()
+    ctl = Controller(memkv, capacity=4, max_load_desired=1.0,
+                     actuator=act, cooldown=0.0)
+    assert ctl.reconcile_once() == {"j3": 4}
+    # record in place but replicas haven't appeared: actuator re-driven,
+    # no new record stamp
+    assert ctl.reconcile_once() == {}
+    assert act.calls == [("j3", 4), ("j3", 4)]
+
+
+def test_controller_skips_near_end_and_reaps_terminal(memkv):
+    pods = [make_pod("10.4.0.1"), make_pod("10.4.0.2")]
+    _publish_job(memkv, "j4", pods, 1, 8)
+    save_train_status(memkv, "j4", pods[0].pod_id, TrainStatus.NEARTHEEND)
+    act = FakeActuator()
+    ctl = Controller(memkv, capacity=16, max_load_desired=1.0,
+                     actuator=act, cooldown=0.0)
+    assert ctl.reconcile_once() == {}              # frozen near the end
+
+    save_job_status(memkv, "j4", Status.SUCCEED)
+    ctl.reconcile_once()
+    assert ("j4", 0) in act.calls                  # terminal job reaped
+    n_calls = len(act.calls)
+    ctl.reconcile_once()
+    assert len(act.calls) == n_calls               # reaped once only
+
+
+# -- live scale-in e2e --------------------------------------------------------
+@pytest.mark.slow
+def test_controller_scales_in_live_job(coord_server, tmp_path):
+    """Two launchers running; a real Controller (capacity 1) writes
+    desired=1; the generator shrinks the cluster; the descaled launcher
+    exits 0 with pod status DESCALED; the survivor SUCCEEDs the job."""
+    from edl_tpu.cluster.status import load_job_status, load_pods_status
+    from edl_tpu.coord.client import CoordClient
+    from tests.test_launch_integration import finish, spawn_launcher
+
+    ep = f"127.0.0.1:{coord_server.port}"
+    client = CoordClient(ep)
+    tmp = str(tmp_path)
+    env = {"EDL_TPU_DEMO_SLEEP": "25", "EDL_TPU_DEMO_SLEEP_SOLO": "4"}
+    a = spawn_launcher("j-scale", ep, tmp, "a", "1:2", env)
+    b = spawn_launcher("j-scale", ep, tmp, "b", "1:2", env)
+    try:
+        assert wait_for(
+            lambda: (c := Cluster.load_from_store(client, "j-scale"))
+            is not None and len(c.pods) == 2, 30.0), "cluster never formed"
+
+        ctl = Controller(client, capacity=1, max_load_desired=1.0,
+                         cooldown=0.0, period=0.5).start()
+        try:
+            assert wait_for(
+                lambda: len(Cluster.load_from_store(client,
+                                                    "j-scale").pods) == 1,
+                30.0), "controller never shrank the cluster"
+        finally:
+            ctl.stop()
+
+        rets = sorted([finish(a, 90), finish(b, 90)])
+        assert rets == [0, 0], f"launcher exit codes {rets}"
+        statuses = sorted(load_pods_status(client, "j-scale").values(),
+                          key=lambda s: s.value)
+        assert Status.DESCALED in statuses
+        assert load_job_status(client, "j-scale") == Status.SUCCEED
+    finally:
+        for proc in (a, b):
+            if proc.poll() is None:
+                proc.kill()
+        client.close()
