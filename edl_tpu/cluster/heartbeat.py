@@ -5,10 +5,17 @@ alive was invisible).
 
 The trainer's rank-0-in-pod process writes a timestamp after each
 completed step (throttled, ElasticTrainer); the pod's launcher compares
-staleness against ``EDL_TPU_HANG_TIMEOUT`` and restarts its trainers in
+staleness against the stale threshold and restarts its trainers in
 place when the beat goes silent.  The watchdog only engages after the
 FIRST beat, so long XLA compiles before step 1 can never be mistaken
 for a hang.
+
+The threshold is ON BY DEFAULT and self-tuning: the trainer publishes
+``max(10 × EMA step time, 120 s)`` alongside each beat (a magic global
+timeout either false-kills slow steps or sleeps through fast ones), and
+the launcher uses the published value.  ``EDL_TPU_HANG_TIMEOUT`` > 0
+overrides it globally; < 0 disables the watchdog entirely; 0 (default)
+= auto.
 """
 
 from __future__ import annotations
@@ -18,24 +25,68 @@ import time
 from edl_tpu.cluster import paths
 from edl_tpu.utils import constants
 
+# auto-threshold shape: generous multiple of the observed step time,
+# floored high enough that checkpoint saves / eval passes between
+# beats can never look like hangs
+AUTO_MULT = 10.0
+AUTO_FLOOR = 120.0
+
+
+def auto_threshold(ema_step_s: float | None) -> float:
+    """Stale threshold derived from the observed (EMA) step time."""
+    if ema_step_s is None or ema_step_s <= 0:
+        return AUTO_FLOOR
+    return max(AUTO_MULT * ema_step_s, AUTO_FLOOR)
+
 
 def _key(job_id: str, pod_id: str) -> str:
     return paths.key(job_id, constants.ETCD_HEARTBEAT, pod_id)
 
 
-def beat(store, job_id: str, pod_id: str, now: float | None = None) -> None:
-    store.put(_key(job_id, pod_id),
-              repr(time.time() if now is None else now).encode())
+def beat(store, job_id: str, pod_id: str, now: float | None = None,
+         threshold: float | None = None) -> None:
+    """Record liveness; ``threshold`` is the trainer's self-derived
+    stale bound, published so the launcher needs no configuration."""
+    val = repr(time.time() if now is None else now)
+    if threshold is not None:
+        val += f" {threshold!r}"
+    store.put(_key(job_id, pod_id), val.encode())
 
 
 def last_beat(store, job_id: str, pod_id: str) -> float | None:
+    info = last_beat_info(store, job_id, pod_id)
+    return info[0] if info else None
+
+
+def last_beat_info(store, job_id: str, pod_id: str
+                   ) -> tuple[float, float | None] | None:
+    """(timestamp, published threshold or None), or None if no beat."""
     rec = store.get(_key(job_id, pod_id))
     if rec is None or not rec.value:
         return None
+    parts = rec.value.decode().split()
     try:
-        return float(rec.value.decode())
-    except ValueError:
+        ts = float(parts[0])
+    except (ValueError, IndexError):
         return None
+    thr = None
+    if len(parts) > 1:
+        try:
+            thr = float(parts[1])
+        except ValueError:
+            thr = None
+    return ts, thr
+
+
+def stale_threshold(published: float | None) -> float | None:
+    """Effective threshold for a pod: the env override when set (> 0),
+    else the trainer-published value; None = watchdog not engaged for
+    this pod (disabled, or the trainer never published one)."""
+    if constants.HANG_TIMEOUT > 0:
+        return constants.HANG_TIMEOUT
+    if constants.HANG_TIMEOUT < 0:
+        return None
+    return published
 
 
 def clear(store, job_id: str, pod_id: str) -> None:

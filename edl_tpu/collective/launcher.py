@@ -164,13 +164,14 @@ class Launcher:
         membership change arrives inside it, this is collateral damage
         and we take the stop-resume path instead of declaring FAILED.
 
-        Hang watchdog (EDL_TPU_HANG_TIMEOUT > 0): a trainer whose
-        per-step heartbeat goes stale — a silent deadlock that exit-code
-        watching can never see — is killed and respawned in place
-        against the SAME cluster (single pod), up to HANG_MAX_RESTARTS
-        per stage.  Multi-pod: the detecting launcher writes a hang
-        flag under the stage; every launcher (this poll) takes the
-        stop-resume path together — see cluster/heartbeat.py.
+        Hang watchdog (ON by default; EDL_TPU_HANG_TIMEOUT < 0
+        disables, > 0 overrides the trainer-published auto threshold):
+        a trainer whose per-step heartbeat goes stale — a silent
+        deadlock that exit-code watching can never see — is killed and
+        respawned in place against the SAME cluster (single pod), up to
+        HANG_MAX_RESTARTS per stage.  Multi-pod: the detecting launcher
+        writes a hang flag under the stage; every launcher (this poll)
+        takes the stop-resume path together — see cluster/heartbeat.py.
         """
         fail_deadline = None
         # incidents at/before this timestamp are already handled (e.g.
@@ -179,7 +180,7 @@ class Launcher:
         # the baseline instead of acting on it, so a store blip can
         # never replay an old incident
         hang_baseline: float | None = 0.0
-        watchdog = constants.HANG_TIMEOUT > 0 and cluster is not None
+        watchdog = constants.HANG_TIMEOUT >= 0 and cluster is not None
         if watchdog:
             job_id = self._job_env.job_id
             try:
@@ -224,9 +225,8 @@ class Launcher:
                 if self._count_hang(cluster.stage):
                     return Status.FAILED
                 if len(cluster.pods) > 1:
-                    logger.error("trainer heartbeat stale > %.1fs; "
-                                 "flagging coordinated multi-pod restart",
-                                 constants.HANG_TIMEOUT)
+                    logger.error("trainer heartbeat stale; flagging "
+                                 "coordinated multi-pod restart")
                     try:
                         self._hang_incident = heartbeat.flag_hang(
                             self._store, job_id, cluster.stage,
@@ -236,9 +236,8 @@ class Launcher:
                         self._hang_incident = time.time()
                     return None
                 logger.error(
-                    "trainer heartbeat stale > %.1fs; in-place restart "
-                    "%d/%d", constants.HANG_TIMEOUT,
-                    self._hang_counts[cluster.stage],
+                    "trainer heartbeat stale; in-place restart "
+                    "%d/%d", self._hang_counts[cluster.stage],
                     constants.HANG_MAX_RESTARTS)
                 self._shutdown_trainers()
                 self._clear_heartbeat()
@@ -263,18 +262,25 @@ class Launcher:
 
     def _hung(self) -> bool:
         """True when this pod's trainer heartbeat exists and is stale.
-        No beat yet = not engaged (first XLA compile can be long).
+        No beat yet = not engaged (first XLA compile can be long); the
+        stale bound is the trainer's published auto threshold unless
+        EDL_TPU_HANG_TIMEOUT overrides (>0) or disables (<0) it.
         Single-pod: handled by in-place restart; multi-pod: by the
         coordinated flag (both in _supervise)."""
-        if constants.HANG_TIMEOUT <= 0:
+        if constants.HANG_TIMEOUT < 0:
             return False
         try:
-            hb = heartbeat.last_beat(self._store, self._job_env.job_id,
-                                     self._pod.pod_id)
+            info = heartbeat.last_beat_info(self._store,
+                                            self._job_env.job_id,
+                                            self._pod.pod_id)
         except Exception:  # noqa: BLE001 — a store blip is not a hang
             logger.exception("heartbeat read failed")
             return False
-        return hb is not None and time.time() - hb > constants.HANG_TIMEOUT
+        if info is None:
+            return False
+        ts, published = info
+        threshold = heartbeat.stale_threshold(published)
+        return threshold is not None and time.time() - ts > threshold
 
     def _clear_heartbeat(self) -> None:
         try:

@@ -373,31 +373,65 @@ class ElasticTrainer:
             logger.exception("recovery record write failed")
 
     _last_beat = 0.0
+    _last_step_t: float | None = None
+    _step_ema: float | None = None
+    _warned_no_beat = False
+
+    def _observe_step_time(self) -> None:
+        """EMA of the wall time between completed-step observations.
+        Steps dispatch asynchronously, but with a bounded dispatch
+        queue the steady-state loop rate equals the device step rate,
+        so the EMA converges on the true step time (the first gaps —
+        compile — are absorbed by the EMA and the threshold floor)."""
+        now = time.monotonic()
+        if self._last_step_t is not None:
+            dt = now - self._last_step_t
+            self._step_ema = (dt if self._step_ema is None
+                              else 0.9 * self._step_ema + 0.1 * dt)
+        self._last_step_t = now
 
     def _heartbeat(self) -> None:
         """Throttled liveness beat after a completed step (rank 0 in
         the pod) — feeds the launcher's hang watchdog.  The first beat
         only happens after step 1 finishes, so the watchdog can never
-        mistake the initial XLA compile for a hang.  Best-effort."""
-        if (not self.cfg.heartbeat_every or self.store is None
-                or self.tenv is None or not self.tenv.pod_id
+        mistake the initial XLA compile for a hang.  Publishes the
+        self-derived stale threshold (max(10x EMA step, 120 s)) so the
+        watchdog is on by default with no tuning.  Best-effort."""
+        if (self.store is None or self.tenv is None or not self.tenv.pod_id
                 or self.tenv.rank_in_pod != 0):
             return
-        # auto-couple to the watchdog: beat at least 3x faster than the
-        # configured stale threshold, whatever heartbeat_every says —
-        # a HANG_TIMEOUT below the throttle must never kill a healthy
-        # trainer (both sides read the same EDL_TPU_HANG_TIMEOUT env)
         from edl_tpu.utils import constants as _c
+        if not self.cfg.heartbeat_every:
+            # heartbeat disabled while the watchdog is enabled (auto,
+            # the default, or an explicit HANG_TIMEOUT>0): the launcher
+            # would (correctly) never engage — say so loudly once,
+            # because the docs promise on-by-default hang protection
+            if _c.HANG_TIMEOUT >= 0 and not self._warned_no_beat:
+                self._warned_no_beat = True
+                logger.warning(
+                    "heartbeat_every=0 disables the liveness beat, so the "
+                    "hang watchdog (EDL_TPU_HANG_TIMEOUT=%s%s) never "
+                    "engages for this trainer", _c.HANG_TIMEOUT,
+                    " = auto" if _c.HANG_TIMEOUT == 0 else "")
+            return
+        self._observe_step_time()
+        from edl_tpu.cluster import heartbeat
+        threshold = (heartbeat.auto_threshold(self._step_ema)
+                     if _c.HANG_TIMEOUT == 0 else None)
+        # auto-couple the throttle: beat at least 3x faster than the
+        # effective stale threshold, whatever heartbeat_every says — a
+        # threshold below the throttle must never kill a healthy trainer
         every = self.cfg.heartbeat_every
-        if _c.HANG_TIMEOUT > 0:
-            every = min(every, _c.HANG_TIMEOUT / 3.0)
+        effective = _c.HANG_TIMEOUT if _c.HANG_TIMEOUT > 0 else threshold
+        if effective:
+            every = min(every, effective / 3.0)
         now = time.monotonic()
         if now - self._last_beat < every:
             return
         self._last_beat = now
         try:
-            from edl_tpu.cluster import heartbeat
-            heartbeat.beat(self.store, self.tenv.job_id, self.tenv.pod_id)
+            heartbeat.beat(self.store, self.tenv.job_id, self.tenv.pod_id,
+                           threshold=threshold)
         except Exception:  # noqa: BLE001 — liveness must never fail a job
             logger.exception("heartbeat write failed")
 

@@ -66,14 +66,15 @@ FAIL_GRACE = _f("EDL_TPU_FAIL_GRACE", -1.0)
 # writes the job flag from what it sees (launcher._leader_final_verdict)
 VERDICT_TIMEOUT = _f("EDL_TPU_VERDICT_TIMEOUT", 600.0)
 # hang watchdog: the launcher restarts its trainers when the pod's
-# trainer heartbeat (written per step by ElasticTrainer) goes stale by
-# more than this many seconds.  0 = disabled (the default: exit-code
-# watching catches crashes; this catches silent deadlocks).  Set it
-# comfortably above the longest expected step + XLA compile; the
-# trainer automatically beats at least 3x faster than this threshold,
-# so the throttle can never outpace the watchdog.  Single-pod: in-place
-# trainer restart; multi-pod: a store flag coordinates a cluster-wide
-# stop-resume (launcher._supervise + cluster/heartbeat.py).
+# trainer heartbeat (written per step by ElasticTrainer) goes stale.
+# 0 (the default) = AUTO: the trainer publishes its own threshold,
+# max(10 x EMA step time, 120 s), with each beat — on by default, no
+# tuning.  > 0 = explicit override in seconds (set it comfortably
+# above the longest expected step; the trainer automatically beats at
+# least 3x faster than the threshold, so the throttle can never
+# outpace the watchdog).  < 0 = disabled entirely.  Single-pod:
+# in-place trainer restart; multi-pod: a store flag coordinates a
+# cluster-wide stop-resume (launcher._supervise + cluster/heartbeat.py).
 HANG_TIMEOUT = _f("EDL_TPU_HANG_TIMEOUT", 0.0)
 # max in-place trainer restarts per cluster stage before the pod gives
 # up and fails (a trainer that hangs every time is not going to recover)

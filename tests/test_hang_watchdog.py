@@ -28,6 +28,73 @@ def test_heartbeat_roundtrip(memkv):
     assert heartbeat.last_beat(memkv, "j", "p") is None
 
 
+def test_heartbeat_publishes_threshold(memkv):
+    heartbeat.beat(memkv, "j", "p", now=10.0, threshold=240.0)
+    assert heartbeat.last_beat_info(memkv, "j", "p") == (10.0, 240.0)
+    assert heartbeat.last_beat(memkv, "j", "p") == 10.0
+    # threshold-less (legacy / explicit-override) beats still parse
+    heartbeat.beat(memkv, "j", "p", now=11.0)
+    assert heartbeat.last_beat_info(memkv, "j", "p") == (11.0, None)
+
+
+def test_auto_threshold_shape():
+    assert heartbeat.auto_threshold(None) == heartbeat.AUTO_FLOOR
+    assert heartbeat.auto_threshold(0.5) == heartbeat.AUTO_FLOOR
+    assert heartbeat.auto_threshold(30.0) == 300.0     # 10x EMA past floor
+
+
+def test_stale_threshold_env_semantics(monkeypatch):
+    from edl_tpu.utils import constants
+    # auto (default 0): use the published value; none published = off
+    monkeypatch.setattr(constants, "HANG_TIMEOUT", 0.0)
+    assert heartbeat.stale_threshold(200.0) == 200.0
+    assert heartbeat.stale_threshold(None) is None
+    # explicit override
+    monkeypatch.setattr(constants, "HANG_TIMEOUT", 42.0)
+    assert heartbeat.stale_threshold(200.0) == 42.0
+    # disabled
+    monkeypatch.setattr(constants, "HANG_TIMEOUT", -1.0)
+    assert heartbeat.stale_threshold(200.0) is None
+
+
+def test_trainer_publishes_auto_threshold(memkv):
+    """The default-config trainer's beat carries a derived threshold —
+    the watchdog engages with zero configuration."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    tenv = TrainerEnv({"EDL_TPU_JOB_ID": "hb2", "EDL_TPU_POD_ID": "podA",
+                       "EDL_TPU_TRAINER_RANK_IN_POD": "0"})
+
+    def loss_fn(params, extra, batch, rng):
+        return ((params["w"] * batch["x"] - batch["y"]) ** 2).mean(), (
+            extra, {})
+
+    tr = ElasticTrainer(loss_fn,
+                        TrainConfig(log_every=0, heartbeat_every=0.001),
+                        store=memkv, tenv=tenv)
+    state = tr.create_state(
+        lambda: ({"w": jnp.ones(())}, None), optax.sgd(0.1))
+
+    def data(_e):
+        for _ in range(4):
+            yield {"x": np.ones((8,), np.float32),
+                   "y": np.full((8,), 3.0, np.float32)}
+
+    tr.fit(state, tr.restore_or_create(
+        lambda: ({"w": jnp.ones(())}, None), optax.sgd(0.1))[1],
+        data, epochs=1)
+    info = heartbeat.last_beat_info(memkv, "hb2", "podA")
+    assert info is not None
+    ts, thr = info
+    # steps are sub-second, so the floor dominates
+    assert thr == heartbeat.AUTO_FLOOR
+
+
 def test_trainer_beats_after_steps(memkv):
     import jax.numpy as jnp
     import numpy as np
