@@ -60,8 +60,12 @@ class TeacherServer:
     def __init__(self, predict_fn: Callable[[dict], dict],
                  host: str | None = None, port: int = 0,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS,
-                 coalesce_wait_ms: float = 2.0):
+                 coalesce_wait_ms: float = 2.0,
+                 extra_stats: Callable[[], dict] | None = None):
         self._predict_fn = predict_fn
+        # model-specific observability (e.g. serve_lm's MoE overflow
+        # counter) merged into the stats() RPC
+        self._extra_stats = extra_stats
         self._buckets = tuple(sorted(buckets))
         self._wait = coalesce_wait_ms / 1000.0
         self._queue: queue.Queue[_Request | None] = queue.Queue()
@@ -206,11 +210,17 @@ class TeacherServer:
         """Live QPS record (the reference never measured its teachers)."""
         with self._stats_lock:
             dt = max(1e-9, time.monotonic() - self._t0)
-            return {"rows": self._rows, "requests": self._requests,
-                    "forward_passes": self._forwards,
-                    "busy_s": round(self._busy_s, 3),
-                    "uptime_s": round(dt, 3),
-                    "rows_per_s": round(self._rows / dt, 1)}
+            out = {"rows": self._rows, "requests": self._requests,
+                   "forward_passes": self._forwards,
+                   "busy_s": round(self._busy_s, 3),
+                   "uptime_s": round(dt, 3),
+                   "rows_per_s": round(self._rows / dt, 1)}
+        if self._extra_stats is not None:
+            try:
+                out.update(self._extra_stats())
+            except Exception:  # noqa: BLE001 — stats must never fail
+                logger.exception("extra_stats failed")
+        return out
 
     def stop(self) -> None:
         if self._register is not None:

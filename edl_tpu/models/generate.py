@@ -69,7 +69,8 @@ def sample_logits(logits, key, *, temperature: float = 1.0, top_k: int = 0,
 
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              *, rng=None, temperature: float = 1.0, top_k: int = 0,
-             top_p: float = 0.0, top_k_recall: float = 0.95):
+             top_p: float = 0.0, top_k_recall: float = 0.95,
+             return_drops: bool = False):
     """Sample ``[B, max_new_tokens]`` continuations of ``prompt [B, P]``.
 
     ``cfg`` is the TRAINING config (``decode`` is overridden here);
@@ -86,7 +87,14 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     exact top-k profiled 1.6 ms/step at [64, 32000] — dwarfing the
     attention itself).  0.95 is statistically invisible under stochastic
     sampling (a missed candidate is replaced by a near-tied logit);
-    pass 1.0 for the exact threshold at ~0.5 ms/step extra."""
+    pass 1.0 for the exact threshold at ~0.5 ms/step extra.
+
+    ``return_drops=True`` additionally returns the MoE prefill's
+    capacity-overflow count (scalar i32; always 0 for dense configs and
+    for the decode steps, whose per-token gather cannot drop) —
+    ``(tokens, drops)``.  A serving path with an under-provisioned
+    ``capacity_factor`` silently degrades on long prompts; this makes
+    it measurable (ops/moe.py ``moe_drops``)."""
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [B, P], got {prompt.shape}")
     if max_new_tokens < 1:
@@ -127,11 +135,13 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
                          shapes["cache"])
 
     # prefill: write the prompt's k/v, take the next-token logits
+    # (intermediates carries the MoE capacity-overflow count)
     logits, mut = model.apply(
         {"params": params, "cache": cache}, prompt,
         positions=jnp.broadcast_to(jnp.arange(P), (B, P)),
-        mutable=["cache"])
+        mutable=["cache", "intermediates"])
     cache = mut["cache"]
+    drops = _sum_drops(mut.get("intermediates"))
 
     def sample(logits_1, key):
         return sample_logits(logits_1, key, temperature=temperature,
@@ -153,4 +163,18 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     (_, last, _, _), toks = jax.lax.scan(
         step, (cache, first, jnp.asarray(P, jnp.int32), rng), None,
         length=max_new_tokens - 1)    # length 0 is fine for 1 new token
-    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return (out, drops) if return_drops else out
+
+
+def _sum_drops(intermediates) -> "jax.Array":
+    """Total ``moe_drops`` over all layers (0 for dense configs)."""
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.int32)
+    if not intermediates:
+        return total
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        if any(getattr(k, "key", None) == "moe_drops" for k in path):
+            total = total + jnp.asarray(leaf, jnp.int32).sum()
+    return total

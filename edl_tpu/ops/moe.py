@@ -34,9 +34,12 @@ def compute_routing(probs, top_k: int, capacity: int):
     """Routing tensors from router probabilities ``[B, S, E]``.
 
     Returns ``(dispatch [B, S, E, C] in {0,1}, combine [B, S, E, C]
-    f32, aux_loss scalar)``.  Slot priority is k-major (every token's
-    first choice is placed before any token's second choice), positions
-    within an expert are sequence-ordered — deterministic, no RNG.
+    f32, aux_loss scalar, drops scalar i32)``.  Slot priority is
+    k-major (every token's first choice is placed before any token's
+    second choice), positions within an expert are sequence-ordered —
+    deterministic, no RNG.  ``drops`` counts (token, expert)
+    assignments that overflowed capacity — the silent-quality-loss
+    signal a serving path must be able to observe.
     """
     B, S, E = probs.shape
     gates, idx = jax.lax.top_k(probs, top_k)              # [B, S, K]
@@ -47,6 +50,8 @@ def compute_routing(probs, top_k: int, capacity: int):
     slots = onehot.transpose(0, 2, 1, 3).reshape(B, top_k * S, E)
     pos = (jnp.cumsum(slots, axis=1) * slots).astype(jnp.int32) - 1
     kept = (pos >= 0) & (pos < capacity)
+    drops = (B * S * top_k
+             - kept.sum().astype(jnp.int32))             # overflowed slots
     pos_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * kept[..., None]
     # back to token-major [B, S, K, E, C]; merge k (distinct (e, c) each)
     pos_c = pos_c.reshape(B, top_k, S, E, capacity).transpose(0, 2, 1, 3, 4)
@@ -59,7 +64,7 @@ def compute_routing(probs, top_k: int, capacity: int):
     frac_tokens = top1.mean(axis=(0, 1))                  # [E]
     frac_prob = probs.mean(axis=(0, 1))                   # [E]
     aux = E * jnp.sum(frac_tokens * frac_prob)
-    return dispatch, combine, aux
+    return dispatch, combine, aux, drops
 
 
 class MoEMLP(nn.Module):
@@ -70,11 +75,17 @@ class MoEMLP(nn.Module):
     default rules (LOGICAL_RULES in models/transformer.py adds the
     matching param-path entries).
 
-    ``decode=True`` (incremental generation, S small) switches to
-    per-token expert gather: each token reads exactly its top-k
-    experts' weights, no capacity machinery and therefore no drops —
-    identical to the training forward whenever training capacity
-    dropped nothing."""
+    ``decode=True`` (incremental generation) switches to per-token
+    expert gather for the actual decode steps (S <= 2): each token
+    reads exactly its top-k experts' weights, no capacity machinery
+    and therefore no drops — identical to the training forward
+    whenever training capacity dropped nothing.  The gather
+    materialises ``[B, S, K, M, H]`` weight slices, so memory scales
+    with ``top_k``; at S <= 2 that is fine for any realistic top_k.
+    Prefill (decode=True with a long S) takes the capacity path and
+    CAN drop on overflow; the drop count is sown into the
+    ``intermediates`` collection as ``moe_drops`` so serving paths can
+    surface it (pass ``mutable=["cache", "intermediates"]``)."""
 
     num_experts: int
     mlp_dim: int
@@ -98,12 +109,15 @@ class MoEMLP(nn.Module):
         probs = jax.nn.softmax(x.astype(jnp.float32) @ gate_w, axis=-1)
         dtype = self.dtype
 
-        # per-token gather only for the incremental steps (S tiny): it
-        # materialises [B, S, K, M, H] gathered weights, ruinous at
-        # prefill length.  Prefill (decode=True, S = prompt) falls
-        # through to the capacity path — the training forward's exact
-        # semantics, which is what the prompt pass should be anyway.
-        if self.decode and S * self.top_k <= 8:
+        # per-token gather only for the incremental steps (S <= 2,
+        # whatever top_k is — gating on S*top_k silently sent
+        # large-top_k single-token steps down the capacity path,
+        # breaking the drop-free decode promise): the gather
+        # materialises [B, S, K, M, H] weights, ruinous at prefill
+        # length.  Prefill (decode=True, S = prompt) falls through to
+        # the capacity path — the training forward's exact semantics,
+        # which is what the prompt pass should be anyway.
+        if self.decode and S <= 2:
             gates, idx = jax.lax.top_k(probs, self.top_k)     # [B, S, K]
             gates = gates / jnp.maximum(
                 gates.sum(-1, keepdims=True), 1e-9)
@@ -120,7 +134,14 @@ class MoEMLP(nn.Module):
 
         capacity = max(1, math.ceil(
             self.top_k * S * self.capacity_factor / E))
-        dispatch, combine, aux = compute_routing(probs, self.top_k, capacity)
+        dispatch, combine, aux, drops = compute_routing(probs, self.top_k,
+                                                        capacity)
+        # observable overflow: serving reads this via the intermediates
+        # collection (training ignores it at zero cost — sow is a no-op
+        # unless the caller asks for the collection)
+        self.sow("intermediates", "moe_drops", drops,
+                 init_fn=lambda: jnp.zeros((), jnp.int32),
+                 reduce_fn=lambda a, b: a + b)
 
         expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype),
                                x.astype(dtype))

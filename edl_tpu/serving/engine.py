@@ -117,6 +117,7 @@ class ContinuousBatcher:
         self._stats_lock = threading.Lock()
         self._done_requests = 0
         self._emitted_tokens = 0
+        self._moe_drops = 0       # MoE prefill capacity overflow (see stats)
         self._lane_steps = 0          # slot-steps actually dispatched
         self._active_lane_steps = 0   # of those, slots with live requests
         self._t0 = time.monotonic()
@@ -172,6 +173,9 @@ class ContinuousBatcher:
                 # fraction of dispatched lane-steps that served a live
                 # request (the rest is free-slot ballast)
                 "slot_utilization": round(self._active_lane_steps / lanes, 3),
+                # MoE prefill capacity overflow (always 0 for dense
+                # configs; nonzero = raise capacity_factor)
+                "moe_prefill_drops": self._moe_drops,
                 "uptime_s": round(dt, 3),
             }
 
@@ -218,6 +222,7 @@ class ContinuousBatcher:
         model = self._model
 
         def prefill(params, ids, true_len, key):
+            from edl_tpu.models.generate import _sum_drops
             cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 jax.eval_shape(
@@ -228,14 +233,15 @@ class ContinuousBatcher:
                 {"params": params, "cache": cache}, ids,
                 positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),
                                            ids.shape),
-                mutable=["cache"])
+                mutable=["cache", "intermediates"])
             # padded prompt: sample at the LAST REAL position; the pad
             # queries wrote kv past true_len, which insertion resets
             # (cache_index := true_len) and masks never reach
             last = jax.lax.dynamic_index_in_dim(
                 logits, true_len - 1, axis=1, keepdims=False)
             tok = self._sample(last, key)
-            return mut["cache"], tok
+            # MoE capacity overflow at prefill (0 for dense configs)
+            return mut["cache"], tok, _sum_drops(mut.get("intermediates"))
 
         fn = jax.jit(prefill)
         self._prefill_cache[P] = fn
@@ -338,13 +344,17 @@ class ContinuousBatcher:
             ids = np.zeros((1, P), np.int32)
             ids[0, :len(req.ids)] = req.ids
             self._rng, key = jax.random.split(self._rng)
-            slab, tok = self._prefill_fn(P)(
+            slab, tok, drops = self._prefill_fn(P)(
                 self._params, jnp.asarray(ids),
                 jnp.asarray(len(req.ids), jnp.int32), key)
             self._cache = self._insert_jit(
                 self._cache, slab, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(len(req.ids), jnp.int32))
             tok = int(np.asarray(tok)[0])
+            drops = int(np.asarray(drops))
+            if drops:
+                with self._stats_lock:
+                    self._moe_drops += drops
         except Exception as e:  # noqa: BLE001 — fail THIS request only
             logger.exception("prefill failed for prompt len %d",
                              len(req.ids))

@@ -48,17 +48,26 @@ def request(endpoint: str, prompts: np.ndarray, timeout: float = 120.0):
 def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
                      top_k: int, top_p: float = 0.0):
     """jitted (params, ids, rng) -> tokens, with a fresh fold per call
-    so temperature sampling differs between identical requests."""
+    so temperature sampling differs between identical requests.
+
+    The returned fn carries a ``stats()`` attribute: for MoE configs it
+    reports cumulative ``moe_prefill_drops`` (capacity-overflow on
+    prompt passes — an under-provisioned capacity_factor silently
+    degrades long prompts; here it's a counter the TeacherServer stats
+    RPC exposes)."""
     import jax
 
     from edl_tpu.models.generate import generate
 
+    moe = bool(cfg.moe_experts)
+
     @jax.jit
     def gen(p, ids, rng):
         return generate(cfg, p, ids, max_new_tokens, rng=rng,
-                        temperature=temperature, top_k=top_k, top_p=top_p)
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        return_drops=moe)
 
-    counter = {"n": 0}
+    counter = {"n": 0, "drops": 0}
     lock = threading.Lock()
 
     def predict(feed: dict) -> dict:
@@ -66,9 +75,20 @@ def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
             counter["n"] += 1
             n = counter["n"]
         rng = jax.random.fold_in(jax.random.key(20_26), n)
-        toks = gen(params, feed["ids"].astype(np.int32), rng)
+        out = gen(params, feed["ids"].astype(np.int32), rng)
+        if moe:
+            toks, drops = out
+            with lock:
+                counter["drops"] += int(drops)
+        else:
+            toks = out
         return {"tokens": np.asarray(toks)}
 
+    def stats() -> dict:
+        with lock:
+            return ({"moe_prefill_drops": counter["drops"]} if moe else {})
+
+    predict.stats = stats
     return predict
 
 
@@ -216,7 +236,8 @@ def main() -> None:
     else:
         predict = build_predict_fn(cfg, params, args.max_new_tokens,
                                    args.temperature, args.top_k, args.top_p)
-        server = TeacherServer(predict, port=args.port)
+        server = TeacherServer(predict, port=args.port,
+                               extra_stats=predict.stats)
     if args.coord_endpoints:
         from edl_tpu.coord.client import connect
         server.register(connect(args.coord_endpoints), args.service)

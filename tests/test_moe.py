@@ -17,7 +17,7 @@ def test_routing_no_drops_with_ample_capacity():
     probs = _probs()
     B, S, E = probs.shape
     K = 2
-    dispatch, combine, aux = compute_routing(probs, K, capacity=S * K)
+    dispatch, combine, aux, drops = compute_routing(probs, K, capacity=S * K)
     # every (token, k) slot placed exactly once
     assert float(dispatch.sum()) == B * S * K
     # each slot in a distinct (e, c) cell
@@ -30,7 +30,7 @@ def test_routing_no_drops_with_ample_capacity():
 
 def test_routing_drops_over_capacity():
     probs = _probs(S=16)
-    dispatch, combine, _ = compute_routing(probs, 2, capacity=2)
+    dispatch, combine, _, _ = compute_routing(probs, 2, capacity=2)
     B, S, E = probs.shape
     assert float(dispatch.sum()) < B * S * 2       # overflow dropped
     assert float(dispatch.sum(axis=(1, 3)).max()) <= 2 * 1  # per-expert cap
@@ -41,7 +41,7 @@ def test_routing_drops_over_capacity():
 def test_routing_position_bound():
     probs = _probs(B=1, S=32, E=2, seed=3)
     C = 5
-    dispatch, _, _ = compute_routing(probs, 1, capacity=C)
+    dispatch, _, _, drops = compute_routing(probs, 1, capacity=C)
     per_expert = dispatch.sum(axis=(0, 1))          # [E, C]
     assert per_expert.shape == (2, C)
     assert float(per_expert.max()) <= 1.0           # one token per cell
@@ -127,3 +127,63 @@ def test_moe_transformer_trains_on_ep_mesh(ep):
     last = float(metrics["loss"])
     assert np.isfinite(last) and np.isfinite(float(metrics["moe_aux"]))
     assert last < first, f"loss did not drop: {first} -> {last}"
+
+
+def test_routing_reports_drop_count():
+    # 1 expert, capacity 2, 6 tokens top-1: 4 assignments must drop
+    probs = jnp.asarray(np.full((1, 6, 1), 1.0, np.float32))
+    _, _, _, drops = compute_routing(probs, 1, capacity=2)
+    assert int(drops) == 4
+    _, _, _, no_drops = compute_routing(probs, 1, capacity=6)
+    assert int(no_drops) == 0
+
+
+def _moe_cfg(capacity_factor):
+    from edl_tpu.models import TransformerConfig
+    return TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
+                             num_heads=4, mlp_dim=64, max_len=32,
+                             dtype=jnp.float32, attention_impl="dense",
+                             remat=False, moe_experts=4, moe_top_k=2,
+                             moe_capacity=capacity_factor)
+
+
+def test_generate_reports_prefill_drops():
+    """Serving guardrail: an under-provisioned capacity_factor yields a
+    NONZERO observable drop count at prefill; ample capacity reports 0
+    (and decode steps never drop by construction)."""
+    import jax as _jax
+
+    from edl_tpu.models import TransformerLM
+    from edl_tpu.models.generate import generate
+
+    starving, ample = _moe_cfg(0.05), _moe_cfg(4.0)
+    params = TransformerLM(starving).init(
+        _jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt = jnp.asarray(np.random.default_rng(1).integers(
+        0, 64, (2, 16)), jnp.int32)
+
+    _, drops = generate(starving, params, prompt, 4, temperature=0.0,
+                        return_drops=True)
+    assert int(drops) > 0, "starved capacity must report drops"
+    toks, no_drops = generate(ample, params, prompt, 4, temperature=0.0,
+                              return_drops=True)
+    assert int(no_drops) == 0
+    assert toks.shape == (2, 4)
+
+
+def test_decode_gather_any_top_k():
+    """The drop-free gather path gates on S alone: a single-token step
+    with top_k > 8 must still use it (module promise), verified against
+    the capacity path with ample capacity."""
+    E, K, M = 12, 10, 16
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 1, M)),
+                    jnp.float32)
+    m = MoEMLP(num_experts=E, mlp_dim=32, top_k=K, capacity_factor=100.0,
+               dtype=jnp.float32, decode=True)
+    params = m.init(jax.random.key(0), x)
+    y_gather, _ = m.apply(params, x)
+    m2 = MoEMLP(num_experts=E, mlp_dim=32, top_k=K, capacity_factor=100.0,
+                dtype=jnp.float32, decode=False)
+    y_cap, _ = m2.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y_gather), np.asarray(y_cap),
+                               atol=1e-5)

@@ -69,6 +69,38 @@ def test_queue_deeper_than_slots(small):
     assert stats["requests_done"] == 9
     assert stats["queue_depth"] == 0
     assert 0.0 < stats["slot_utilization"] <= 1.0
+    assert stats["moe_prefill_drops"] == 0     # dense config never drops
+
+
+def test_engine_counts_moe_prefill_drops():
+    """Continuous-batching prefill surfaces MoE capacity overflow."""
+    import dataclasses
+
+    from edl_tpu.models import TransformerLM
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
+                            num_heads=4, mlp_dim=64, max_len=64,
+                            remat=False, dtype=jnp.float32,
+                            moe_experts=4, moe_top_k=2, moe_capacity=0.05)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt = np.random.default_rng(3).integers(1, 64, (16,)).astype(np.int32)
+    eng = _engine(cfg, params, slots=1)
+    try:
+        out = eng.generate(prompt, 3, timeout=120)
+        assert len(out) == 3
+        starved = eng.stats()["moe_prefill_drops"]
+    finally:
+        eng.stop()
+    assert starved > 0, "starved capacity_factor must report drops"
+
+    ample = dataclasses.replace(cfg, moe_capacity=4.0)
+    eng2 = _engine(ample, params, slots=1)
+    try:
+        eng2.generate(prompt, 3, timeout=120)
+        assert eng2.stats()["moe_prefill_drops"] == 0
+    finally:
+        eng2.stop()
 
 
 def test_eos_truncates(small):
