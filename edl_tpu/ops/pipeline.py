@@ -10,10 +10,17 @@ a statically-unrolled loop of ``M + S - 1`` ticks inside one
 transpose is the reverse rotation), so the backward schedule falls out
 of AD instead of hand-written send/recv pairs.
 
+Composability is the property beyond naive GPipe: the shard_map is
+manual over ``pp`` ONLY — every other mesh axis (dp, fsdp, tp, ep)
+stays in XLA's automatic (GSPMD) partitioning, so tensor-parallel
+stage matmuls, fsdp parameter sharding and data-parallel batches
+compose with the pipeline without hand-written collectives
+(pp=2 × tp=2 × fsdp=2 is tested in tests/test_lm_example.py).
+
 The bubble is the classic GPipe (S-1)/(M+S-1); raise
-``n_microbatches`` to amortise.  Collectives ride the ``pp`` axis only,
-so this composes with data parallelism on the same mesh (batch axes
-sharded as usual outside the shard_map).
+``n_microbatches`` to amortise.  Idle stages compute on garbage in
+lockstep (see the in-body NOTE for why branching it away is unsound
+with tp collectives inside the stage).
 """
 
 from __future__ import annotations
@@ -33,12 +40,17 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
     - ``stage_fn(params_s, h) -> h``: one stage's computation; must
       preserve the activation shape (classic equal-width pipeline).
     - ``stage_params``: pytree whose leaves have a leading ``S`` dim,
-      sharded over ``axis`` (use logical axis "stage").
-    - ``x``: [B, ...] activations; B must divide by
-      ``n_microbatches * (product of live batch axes)``.
+      sharded over ``axis`` (use logical axis "stage"); the remaining
+      dims may carry tp/fsdp shardings — they stay under GSPMD.
+    - ``x``: [B, ...] activations; the GLOBAL batch must divide by
+      ``n_microbatches`` (and, as always, by the live batch axes).
+
+    ``batch_axes`` is kept for call compatibility; batch partitioning
+    now rides GSPMD (auto axes), not manual specs.
 
     Returns [B, ...] outputs, batch-sharded like ``x``.
     """
+    del batch_axes
     S = mesh.shape[axis]
     M = n_microbatches
     if S == 1:  # no pipeline axis: just run the stages sequentially
@@ -46,11 +58,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
                               x, stage_params)
         return out
 
-    live_batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-    bspec = P(live_batch if live_batch else None)
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def per_device(params_local, x_local):
+    def per_device(params_local, x_mb):
         # params_local: this shard's stage slice — leading dim
         # n_layers/S; multiple layers per shard chain sequentially
         # (a "superstage"), so any layer count pipelines over any S
@@ -61,16 +71,19 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
                 h = stage_fn(jax.tree.map(lambda a: a[j], params_local), h)
             return h
 
-        B = x_local.shape[0]
-        assert B % M == 0, \
-            f"local batch {B} not divisible by {M} microbatches"
-        mbs = x_local.reshape((M, B // M) + x_local.shape[1:])
         stage_idx = jax.lax.axis_index(axis)
-        carry = jnp.zeros_like(mbs[0])      # activation arriving from prev
-        outs = jnp.zeros_like(mbs)          # filled on the LAST stage
+        carry = jnp.zeros_like(x_mb[0])     # activation arriving from prev
+        outs = jnp.zeros_like(x_mb)         # filled on the LAST stage
         for t in range(M + S - 1):
+            # NOTE: stages outside their active window compute on
+            # garbage rather than branching it away — a lax.cond whose
+            # predicate varies per pp shard deadlocks XLA's collective
+            # rendezvous when the active branch contains tp collectives
+            # (devices disagree about which channel comes next).  The
+            # lockstep schedule's wall-clock is set by the active
+            # stages either way; the garbage ticks cost only energy.
             # stage 0 injects microbatch t; later stages consume the wire
-            inject = mbs[min(t, M - 1)]
+            inject = x_mb[min(t, M - 1)]
             h_in = jnp.where(stage_idx == 0, inject, carry)
             h_out = superstage(h_in)
             # last stage emits microbatch t-(S-1) at tick t
@@ -84,13 +97,19 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
         # one-hot-by-stage contributions)
         outs = jnp.where(jax.lax.axis_index(axis) == S - 1, outs,
                          jnp.zeros_like(outs))
-        outs = jax.lax.psum(outs, axis)
-        return outs.reshape((B,) + x_local.shape[1:])
+        return jax.lax.psum(outs, axis)
+
+    B = x.shape[0]
+    assert B % M == 0, f"global batch {B} not divisible by {M} microbatches"
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
 
     from jax import shard_map  # public API (jax >= 0.6, per pyproject)
-    return shard_map(
+    # manual over pp only; every other axis stays automatic (GSPMD)
+    out_mb = shard_map(
         per_device, mesh=mesh,
-        in_specs=(P(axis), bspec),
-        out_specs=bspec,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
         check_vma=False,
-    )(stage_params, x)
+        axis_names=frozenset({axis}),
+    )(stage_params, x_mb)
+    return out_mb.reshape((B,) + x.shape[1:])

@@ -43,7 +43,8 @@ def parse_args():
                    help="zero-style parameter sharding axis size")
     p.add_argument("--pp", type=int, default=1,
                    help=">1 pipelines the decoder blocks over the pp mesh "
-                        "axis (GPipe; forces tp=sp=fsdp=1 in this example)")
+                        "axis (GPipe over ppermute; composes with "
+                        "--tp/--fsdp — not with --sp/ring or --moe)")
     p.add_argument("--pp_microbatches", type=int, default=4)
     p.add_argument("--attention", default="auto",
                    choices=["auto", "dense", "splash", "flash", "ring"])
@@ -155,12 +156,38 @@ class _PipelinedLM:
         return self.head.apply({"params": p["head"]}, x).astype(jnp.float32)
 
     def logical_axes(self, params_shape):
-        """Stage dim of the stacked layers on pp; everything else DP."""
+        """Stage dim of the stacked layers on pp; within each stage the
+        block weights keep the transformer's megatron/fsdp axes (the
+        pipeline shard_map is manual over pp only, so tp/fsdp stay
+        under GSPMD and compose)."""
         import jax
+
+        from edl_tpu.models import transformer as tf_mod
+        from edl_tpu.models.logical import logical_axes_from_paths
+
         repl = jax.tree.map(lambda l: (None,) * l.ndim, params_shape)
-        repl["layers"] = jax.tree.map(
-            lambda l: ("stage",) + (None,) * (l.ndim - 1),
-            params_shape["layers"])
+        block_axes = logical_axes_from_paths(
+            {"layers": params_shape["layers"]}, tf_mod.LOGICAL_RULES)
+
+        def stage_first(axes, leaf):
+            if axes is None or all(a is None for a in axes):
+                return ("stage",) + (None,) * (leaf.ndim - 1)
+            return ("stage",) + tuple(axes[1:])
+
+        def is_axes(x):  # stop tree.map at the axes TUPLES, not inside
+            return x is None or (isinstance(x, tuple) and all(
+                a is None or isinstance(a, str) for a in x))
+
+        repl["layers"] = jax.tree.map(stage_first, block_axes["layers"],
+                                      params_shape["layers"],
+                                      is_leaf=is_axes)
+        # embed/head follow the unstacked model's layout
+        repl["embed"] = jax.tree.map(
+            lambda l: ("vocab", "embed") if l.ndim == 2 else (None,) * l.ndim,
+            params_shape["embed"])
+        repl["head"] = jax.tree.map(
+            lambda l: ("embed", "vocab") if l.ndim == 2 else (None,) * l.ndim,
+            params_shape["head"])
         return repl
 
 
@@ -188,28 +215,29 @@ def main() -> None:
 
     n_dev = len(jax.devices())
     if args.pp > 1:
-        tp = sp = 1  # this example pipelines pure-dp blocks
-        if args.fsdp != 1:
-            raise SystemExit("--pp pipelines pure-dp blocks in this "
-                             "example; it cannot combine with --fsdp")
-        if args.attention == "ring":
-            raise SystemExit("--pp cannot combine with --attention ring "
-                             "(ring's shard_map cannot nest inside the "
-                             "pipeline's); use auto/dense/flash")
+        if args.sp > 1 or args.attention == "ring":
+            raise SystemExit("--pp cannot combine with --sp/--attention "
+                             "ring (ring's shard_map cannot nest inside "
+                             "the pipeline's); use auto/dense/flash")
         if args.layers % args.pp:
             raise SystemExit(f"--layers {args.layers} must divide evenly "
                              f"over --pp {args.pp} stages")
-        spec = MeshSpec(dp=-1, pp=args.pp, dcn_dp=args.dcn_dp)
-        # microbatches must divide the per-dp-shard local batch; clamp to
-        # the largest divisor <= requested so defaults never crash
-        dp_size = max(1, n_dev // args.pp)
-        local_batch = args.batch_size // dp_size or 1
-        m = min(args.pp_microbatches, local_batch)
-        while local_batch % m:
+        # pp composes with tp/fsdp: the pipeline shard_map is manual
+        # over pp only, everything else stays under GSPMD
+        free = max(1, n_dev // (args.pp * args.fsdp))
+        tp = args.tp or (2 if free % 2 == 0 else 1)
+        sp = 1
+        spec = MeshSpec(dp=-1, pp=args.pp, tp=tp, fsdp=args.fsdp,
+                        dcn_dp=args.dcn_dp)
+        # microbatches must divide the GLOBAL batch (the pipeline body
+        # sees the global microbatch; GSPMD splits it over dp/fsdp);
+        # clamp to the largest divisor <= requested
+        m = min(args.pp_microbatches, args.batch_size)
+        while args.batch_size % m:
             m -= 1
         if m != args.pp_microbatches:
             print(f"[train_lm] pp_microbatches clamped {args.pp_microbatches}"
-                  f" -> {m} (local batch {local_batch})", flush=True)
+                  f" -> {m} (global batch {args.batch_size})", flush=True)
         args.pp_microbatches = m
     else:
         if args.fsdp < 1 or args.sp < 1 or args.ep < 1:
