@@ -1,0 +1,110 @@
+"""ImageNet tree -> recordio converter
+(examples/collective/imagenet_to_recordio.py): real JPEGs in a class
+tree, deterministic shard membership, resumability, and that the
+output feeds the training pipeline unchanged."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples", "collective"))
+
+from imagenet_to_recordio import convert, shard_of  # noqa: E402
+
+from edl_tpu.data import images  # noqa: E402
+from edl_tpu.native.recordio import RecordReader  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tree(tmp_path_factory):
+    """3 wnid classes x 5 real JPEGs, varied sizes like a camera dump."""
+    import cv2
+    root = tmp_path_factory.mktemp("imagenet") / "train"
+    rng = np.random.default_rng(0)
+    wnids = ["n01440764", "n01443537", "n02102040"]
+    for ci, wnid in enumerate(wnids):
+        d = root / wnid
+        d.mkdir(parents=True)
+        for i in range(5):
+            h, w = int(rng.integers(80, 200)), int(rng.integers(80, 200))
+            img = np.full((h, w, 3), 40 * ci, np.uint8)
+            img += rng.integers(0, 40, img.shape).astype(np.uint8)
+            ok, enc = cv2.imencode(".jpg", img)
+            assert ok
+            (d / f"img_{i}.JPEG").write_bytes(enc.tobytes())
+    return str(root), wnids
+
+
+def _read_all(paths):
+    out = []
+    for p in sorted(paths):
+        r = RecordReader(p)
+        for rec in r:
+            jpg, label = images.decode_sample(rec)
+            out.append((len(jpg), label))
+        r.close()
+    return out
+
+
+def test_convert_roundtrip(tree, tmp_path):
+    src, wnids = tree
+    out = str(tmp_path / "rec")
+    written = convert(src, out, "train", shards=4, verbose=False)
+    assert len(written) <= 4 and written
+    samples = _read_all(written)
+    assert len(samples) == 15
+    # labels are sorted-wnid indices 0..2, 5 each
+    labels = sorted(lab for _, lab in samples)
+    assert labels == sorted([0] * 5 + [1] * 5 + [2] * 5)
+    # class mapping file written
+    classes = open(os.path.join(out, "train-classes.txt")).read().split()
+    assert classes == sorted(wnids)
+
+
+def test_convert_resumable(tree, tmp_path):
+    src, _ = tree
+    out = str(tmp_path / "rec")
+    first = convert(src, out, "train", shards=4, verbose=False)
+    before = _read_all(first)
+    # wipe one shard: re-run must rewrite ONLY it, identically
+    victim = first[0]
+    os.unlink(victim)
+    second = convert(src, out, "train", shards=4, verbose=False)
+    assert second == [victim]
+    assert sorted(_read_all(first)) == sorted(before)
+    # fully complete -> no-op
+    assert convert(src, out, "train", shards=4, verbose=False) == []
+
+
+def test_more_shards_than_samples_still_completes(tree, tmp_path):
+    # empty shards must finalize too, or re-runs re-stream forever
+    src, _ = tree
+    out = str(tmp_path / "rec")
+    written = convert(src, out, "train", shards=64, verbose=False)
+    assert len(written) == 64
+    assert len(_read_all(written)) == 15
+    assert convert(src, out, "train", shards=64, verbose=False) == []
+
+
+def test_shard_membership_stable(tree):
+    src, _ = tree
+    # membership is a pure function of relpath: resuming can't shuffle
+    assert shard_of("n01440764/img_0.JPEG", 8) == shard_of(
+        "n01440764/img_0.JPEG", 8)
+
+
+def test_output_feeds_training_pipeline(tree, tmp_path):
+    src, _ = tree
+    out = str(tmp_path / "rec")
+    convert(src, out, "train", shards=2, verbose=False)
+    import glob
+    paths = sorted(glob.glob(os.path.join(out, "train-*.rec")))
+    batches = list(images.ImageBatches(paths, 4, image_size=64, train=True,
+                                       num_workers=2, drop_remainder=False))
+    n = sum(len(b["label"]) for b in batches)
+    assert n == 15
+    assert batches[0]["image"].shape[1:] == (64, 64, 3)
+    assert all(0 <= int(l) < 3 for b in batches for l in b["label"])
