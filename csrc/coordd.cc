@@ -14,8 +14,9 @@
 // (python/edl/discovery/etcd_client.py:15, scripts/build.sh:67-74
 // booted one per test run); coordd is the in-tree native equivalent.
 // The Python test-suite runs its coordination tests against this
-// daemon as a second backend (tests/test_coordd_native.py), proving
-// the KVStore interface is genuinely pluggable.
+// daemon as a second backend (the "native" param of
+// tests/test_coord.py), proving the KVStore interface is genuinely
+// pluggable.
 //
 // Build:  g++ -O2 -std=c++17 -pthread -o coordd coordd.cc
 // Run:    ./coordd --host 0.0.0.0 --port 2379   (port 0 = ephemeral;

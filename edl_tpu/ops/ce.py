@@ -137,7 +137,16 @@ def blockwise_cross_entropy(hidden, weight, targets, *,
 
     ``hidden``: ``[..., D]`` (bf16 or f32), ``weight``: ``[D, V]``,
     ``targets``: ``[...]`` int — returns f32 NLL of ``targets``' shape.
-    Differentiable in ``hidden`` and ``weight``."""
+    Differentiable in ``hidden`` and ``weight``.
+
+    Targets MUST be valid ids in ``[0, V)``: an out-of-range id (e.g. a
+    -1 padding sentinel that was not masked out) gathers a zero logit
+    from the padded block and returns a huge (~1e30-scale) NLL instead
+    of raising — inside jit there is nothing to raise with.  Mask
+    padding via the ``mask`` argument of ``lm_loss_fused``/your loss,
+    never by feeding sentinel ids."""
+    if not jnp.issubdtype(targets.dtype, jnp.integer):
+        raise TypeError(f"targets must be integer ids, got {targets.dtype}")
     lead = targets.shape
     h2 = hidden.reshape(-1, hidden.shape[-1])
     t2 = targets.reshape(-1)

@@ -33,10 +33,11 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
     Call under jit; shard_map is applied internally.
 
     ``kv_chunk`` bounds the logits tile WITHIN each ring hop: the local
-    k/v block is folded in chunks of at most this many keys (largest
-    divisor of the shard length), so per-hop memory is O(Lq × chunk)
-    instead of O(Lq × L/shards) — what keeps very long shards (few
-    devices, long context) inside VMEM-friendly tiles.  0 disables."""
+    k/v block is folded ceil(Lk / chunk) chunks at a time, the final
+    chunk zero-padded and masked (never a degenerate divisor), so
+    per-hop memory is O(Lq × chunk) instead of O(Lq × L/shards) — what
+    keeps very long shards (few devices, long context) inside
+    VMEM-friendly tiles.  0 disables."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch, sp_axis, head_axis if mesh.shape.get(head_axis, 1) > 1 else None, None)

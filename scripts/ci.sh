@@ -29,4 +29,18 @@ JAX_PLATFORMS=cpu python bench.py | tail -1 \
 # packaging sanity: console scripts resolve
 edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
+edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
+
+# doc drift: every CLI the operator guide teaches must exist
+for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench; do
+    grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
+done
+for f in examples/lm/serve_lm.py examples/collective/collector.py \
+         examples/collective/recovery_bench.py \
+         examples/collective/imagenet_to_recordio.py \
+         examples/collective/decode_bench.py; do
+    [[ -f "$f" ]] || { echo "missing $f"; exit 1; }
+    grep -q "$(basename "$f")" doc/usage.md \
+        || { echo "doc/usage.md missing $(basename "$f")"; exit 1; }
+done
 echo "CI OK"
