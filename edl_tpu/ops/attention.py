@@ -62,7 +62,12 @@ def _flash(q, k, v, causal, sm_scale):
 
 
 # splash kernels are built per (L, H, block) — construction walks the
-# mask lazily but still costs Python time, so memoise
+# mask lazily but still costs Python time, so memoise.  Construction
+# runs under ensure_compile_time_eval: the kernel materialises mask
+# block info as arrays on first build, and if that first build happens
+# inside a trace (e.g. flax nn.remat under nn.scan), the CACHED kernel
+# would otherwise hold that trace's tracers and poison every later
+# trace (UnexpectedTracerError).
 @functools.cache
 def _splash_kernel(L: int, H: int, blk: int):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
@@ -74,8 +79,9 @@ def _splash_kernel(L: int, H: int, blk: int):
         block_q=blk, block_kv=blk, block_kv_compute=blk,
         block_q_dkv=blk, block_kv_dkv=blk, block_kv_dkv_compute=blk,
         block_q_dq=blk, block_kv_dq=blk)
-    return sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1,
-                              block_sizes=sizes)
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1,
+                                  block_sizes=sizes)
 
 
 def _splash(q, k, v, sm_scale):

@@ -71,6 +71,34 @@ def test_splash_rejects_non_causal():
 
 @pytest.mark.skipif(jax.devices()[0].platform != "tpu",
                     reason="pallas TPU kernels")
+def test_splash_under_remat_scan():
+    """Regression: the memoised splash kernel must not capture tracers
+    when first built inside flax's nn.remat-under-nn.scan trace — the
+    cached kernel poisoned every later trace (UnexpectedTracerError)
+    until construction was moved under ensure_compile_time_eval."""
+    from edl_tpu.models import TransformerConfig, TransformerLM
+    from edl_tpu.models.transformer import lm_loss
+
+    from edl_tpu.ops.attention import _splash_kernel
+    _splash_kernel.cache_clear()   # force a fresh IN-TRACE kernel build
+
+    cfg = TransformerConfig(vocab_size=128, num_layers=2, embed_dim=256,
+                            num_heads=2, mlp_dim=256, max_len=256,
+                            remat=True)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 257)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), ids[:1, :8])["params"]
+
+    def loss(p):
+        return lm_loss(model.apply({"params": p}, ids[:, :-1]), ids[:, 1:])
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert np.isfinite(float(jax.tree.leaves(g)[0].astype(jnp.float32).sum()))
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                    reason="pallas TPU kernels")
 def test_splash_matches_dense_on_tpu():
     rng = np.random.default_rng(2)
     q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 128)), jnp.bfloat16)
