@@ -376,10 +376,15 @@ def _bench_lm(n_dev: int) -> dict:
         g = jax.jit(lambda p, i, r: generate(cfg, p, i, new, rng=r,
                                              temperature=0.8, top_k=40))
         np.asarray(g(state.params, prompt, jax.random.key(4)))  # compile
-        t0 = time.perf_counter()
-        np.asarray(g(state.params, prompt, jax.random.key(5)))
-        out["lm_decode_tokens_s"] = round(
-            B * new / (time.perf_counter() - t0))
+        # best of 3: one generate() is a single ~0.4s dispatch+sync, so
+        # the tunnel's ~0.1s RTT jitter is material; min is the honest
+        # device-throughput estimator here
+        best = float("inf")
+        for rep in (5, 6, 7):
+            t0 = time.perf_counter()
+            np.asarray(g(state.params, prompt, jax.random.key(rep)))
+            best = min(best, time.perf_counter() - t0)
+        out["lm_decode_tokens_s"] = round(B * new / best)
         out["lm_decode_batch"] = B
     return out
 
