@@ -56,6 +56,13 @@ class TransformerConfig:
     num_heads: int = 6
     mlp_dim: int = 3072
     max_len: int = 2048
+    # grouped-query attention: number of K/V heads (0 = num_heads, i.e.
+    # plain MHA).  Serving-side win: the decode KV cache shrinks by
+    # num_heads/num_kv_heads — every decode step streams the whole
+    # cache, so GQA directly multiplies decode throughput and slots
+    # per chip (models/generate.py, serving/engine.py need no changes:
+    # cache shapes follow the config).
+    num_kv_heads: int = 0
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"      # auto | dense | splash | flash | ring
     mesh: Any = None                  # required for attention_impl="ring"
@@ -83,6 +90,10 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.embed_dim // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
 
 def rope(x, positions, theta: float):
@@ -131,24 +142,33 @@ class Block(nn.Module):
         fresh cache at index 0, which satisfies this.
 
         Cache layouts match the two attention matmuls exactly — keys
-        ``[B, H, D, max_len]`` (contraction over D, time on the lane
-        axis) and values ``[B, H, max_len, D]`` — so reading the cache
+        ``[B, Hk, D, max_len]`` (contraction over D, time on the lane
+        axis) and values ``[B, Hk, max_len, D]`` — so reading the cache
         each step is a straight matmul operand with NO full-cache
-        transpose; only the tiny new slab is rearranged on write."""
+        transpose; only the tiny new slab is rearranged on write.
+        Under GQA (``num_kv_heads < num_heads``) the cache holds only
+        the Hk K/V heads — the whole point: decode streams the cache
+        every step, so the cache shrinks (and decode speeds up) by the
+        group factor — and the query heads attend in groups of
+        ``G = H // Hk`` (q head h uses kv head h // G)."""
         cfg = self.cfg
         B, L, H, Dh = q.shape
+        Hk = k.shape[2]
+        G = H // Hk
         is_initialized = self.has_variable("cache", "cached_key")
         ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (B, H, Dh, cfg.max_len), cfg.dtype)
+                           (B, Hk, Dh, cfg.max_len), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (B, H, cfg.max_len, Dh), cfg.dtype)
+                           (B, Hk, cfg.max_len, Dh), cfg.dtype)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((B,), jnp.int32))
         if not is_initialized:      # init trace: shapes only
-            return dot_product_attention(q, k, v, causal=True, impl="dense")
+            return dot_product_attention(
+                q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2),
+                causal=True, impl="dense")
         idx = ci.value                                    # [B]
         if L == 1:
-            # per-example scatter (tiny update: B×H×D elements)
+            # per-example scatter (tiny update: B×Hk×D elements)
             ck.value = ck.value.at[jnp.arange(B), :, :, idx].set(
                 k[:, 0].astype(cfg.dtype))
             cv.value = cv.value.at[jnp.arange(B), :, idx, :].set(
@@ -169,27 +189,35 @@ class Block(nn.Module):
         # precision recipe matches dense_attention exactly (input-dtype
         # matmuls, f32 softmax) so cached decode stays bit-identical to
         # the full-prefix forward in bf16 too
-        logits = jnp.einsum("blhd,bhdk->bhlk", q, ck.value
+        qg = q.reshape(B, L, Hk, G, Dh)
+        logits = jnp.einsum("blhgd,bhdk->bhglk", qg, ck.value
                             ).astype(jnp.float32) * scale
-        logits = jnp.where(mask[:, None], logits, -jnp.inf)
+        logits = jnp.where(mask[:, None, None], logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhlk,bhkd->blhd", weights, cv.value)
+        out = jnp.einsum("bhglk,bhkd->blhgd", weights, cv.value)
+        return out.reshape(B, L, H, Dh)
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
         H, Dh = cfg.num_heads, cfg.head_dim
+        Hk = cfg.kv_heads
+        assert H % Hk == 0, f"num_heads {H} not divisible by kv heads {Hk}"
         y = RMSNorm(cfg.dtype, name="attn_norm")(x)
-        qkv = nn.DenseGeneral((3 * H * Dh,), use_bias=False, dtype=cfg.dtype,
-                              param_dtype=jnp.float32, name="attn_qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = nn.DenseGeneral(((H + 2 * Hk) * Dh,), use_bias=False,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="attn_qkv")(y)
+        q, k, v = jnp.split(qkv, [H * Dh, (H + Hk) * Dh], axis=-1)
         B, L = x.shape[:2]
         q = rope(q.reshape(B, L, H, Dh), positions, cfg.rope_theta)
-        k = rope(k.reshape(B, L, H, Dh), positions, cfg.rope_theta)
-        v = v.reshape(B, L, H, Dh)
+        k = rope(k.reshape(B, L, Hk, Dh), positions, cfg.rope_theta)
+        v = v.reshape(B, L, Hk, Dh)
         if cfg.decode:
             attn = self._decode_attention(q, k, v)
         else:
+            if Hk != H:      # GQA: share each kv head across its group
+                k = jnp.repeat(k, H // Hk, axis=2)
+                v = jnp.repeat(v, H // Hk, axis=2)
             attn = dot_product_attention(q, k, v, causal=True,
                                          impl=cfg.attention_impl,
                                          mesh=cfg.mesh)

@@ -166,6 +166,8 @@ def main() -> None:
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--embed", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv_heads", type=int, default=0,
+                   help="must match training (GQA)")
     p.add_argument("--mlp", type=int, default=256)
     p.add_argument("--max_len", type=int, default=512)
     p.add_argument("--moe", type=int, default=0,
@@ -203,7 +205,8 @@ def main() -> None:
 
     cfg = TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers, embed_dim=args.embed,
-        num_heads=args.heads, mlp_dim=args.mlp, max_len=args.max_len,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        mlp_dim=args.mlp, max_len=args.max_len,
         moe_experts=args.moe, moe_top_k=args.moe_top_k,
         remat=False, dtype=jnp.bfloat16
         if jax.devices()[0].platform == "tpu" else jnp.float32)

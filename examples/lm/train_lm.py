@@ -35,6 +35,9 @@ def parse_args():
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--embed", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv_heads", type=int, default=0,
+                   help="grouped-query attention: K/V heads (0 = --heads, "
+                        "i.e. MHA); decode cache shrinks by heads/kv_heads")
     p.add_argument("--mlp", type=int, default=256)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--tp", type=int, default=0, help="0 = auto (2 if even)")
@@ -258,6 +261,7 @@ def main() -> None:
                          f"--moe {args.moe} experts")
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,
+                            num_kv_heads=args.kv_heads,
                             mlp_dim=args.mlp, max_len=args.seq_len,
                             attention_impl=args.attention,
                             remat=args.remat,

@@ -42,6 +42,32 @@ def test_cached_greedy_matches_full_recompute():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_cached_greedy_matches_full_recompute(kv_heads):
+    """GQA/MQA decode (grouped cache, H/Hk-smaller) must be exact vs
+    the training forward — the training path repeats kv heads, the
+    decode path groups queries; both must implement the same map."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, num_kv_heads=kv_heads)
+    model, params = _model_and_params(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 61, (2, 5)), jnp.int32)
+    want = _greedy_full_recompute(model, params, prompt, 8)
+    got = generate(cfg, params, prompt, 8, temperature=0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gqa_cache_is_smaller():
+    import dataclasses
+    cfg = dataclasses.replace(CFG, num_kv_heads=1, decode=True)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 1), jnp.int32),
+                           positions=jnp.zeros((2, 1), jnp.int32))
+    ck = variables["cache"]["layer_0"]["cached_key"]
+    # MQA: one kv head instead of 4 -> cache 4x smaller
+    assert ck.shape == (2, 1, CFG.head_dim, CFG.max_len)
+
+
 def test_generate_single_token_and_jit():
     _, params = _model_and_params()
     prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
