@@ -163,9 +163,7 @@ class Block(nn.Module):
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((B,), jnp.int32))
         if not is_initialized:      # init trace: shapes only
-            return dot_product_attention(
-                q, jnp.repeat(k, G, axis=2), jnp.repeat(v, G, axis=2),
-                causal=True, impl="dense")
+            return dot_product_attention(q, k, v, causal=True, impl="dense")
         idx = ci.value                                    # [B]
         if L == 1:
             # per-example scatter (tiny update: B×Hk×D elements)
@@ -215,9 +213,8 @@ class Block(nn.Module):
         if cfg.decode:
             attn = self._decode_attention(q, k, v)
         else:
-            if Hk != H:      # GQA: share each kv head across its group
-                k = jnp.repeat(k, H // Hk, axis=2)
-                v = jnp.repeat(v, H // Hk, axis=2)
+            # GQA is handled by the dispatch: dense attends grouped
+            # K/V without materialising repeats; kernels expand inside
             attn = dot_product_attention(q, k, v, causal=True,
                                          impl=cfg.attention_impl,
                                          mesh=cfg.mesh)

@@ -38,18 +38,34 @@ def dense_attention(q, k, v, *, causal: bool = False,
                     sm_scale: float | None = None,
                     mask=None):
     """Plain XLA attention; softmax statistics in f32 regardless of the
-    input dtype (bf16-safe)."""
+    input dtype (bf16-safe).
+
+    Grouped-query attention is native: ``k``/``v`` may carry fewer
+    heads than ``q`` (``Hk`` divides ``H``; q head h uses kv head
+    h // (H//Hk)) — the grouped einsum attends without materialising
+    repeated K/V."""
     B, Lq, H, D = q.shape
+    Hk = k.shape[2]
     scale = sm_scale if sm_scale is not None else D ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if Hk == H:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k
+                            ).astype(jnp.float32) * scale
+    else:
+        assert H % Hk == 0, f"q heads {H} not divisible by kv heads {Hk}"
+        qg = q.reshape(B, Lq, Hk, H // Hk, D)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k
+                            ).astype(jnp.float32) * scale
     if causal:
         Lk = k.shape[1]
         causal_mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
-        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+        logits = jnp.where(causal_mask, logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    if Hk == H:
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return out.reshape(B, Lq, H, D)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
@@ -156,6 +172,13 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
             if _on_tpu() and mask is None:
                 _warn_downgrade(q.shape[1], k.shape[1], q.shape[3])
             impl = "dense"
+    # grouped-query attention: dense attends grouped K/V natively (no
+    # repeated materialisation); the pallas kernels and ring want MHA
+    # shapes, so the group expansion happens HERE, not at every caller
+    if k.shape[2] != q.shape[2] and impl != "dense":
+        groups = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     if impl == "ring":
         if mesh is None:
             raise ValueError("impl='ring' needs the mesh")

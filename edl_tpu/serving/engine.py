@@ -260,9 +260,15 @@ class ContinuousBatcher:
             return jax.lax.dynamic_update_slice(big, small, tuple(starts))
         return jax.tree.map(put, cache, slab)
 
-    def _step_impl(self, cache, toks, key):
-        """Advance every slot ``self._T`` tokens (one dispatch)."""
-        model, params = self._model, self._params
+    def _step_impl(self, cache, toks, key, params):
+        """Advance every slot ``self._T`` tokens (one dispatch).
+
+        ``params`` is an ARGUMENT, not a closure capture: a captured
+        param tree would be baked into the jaxpr as constants — 124M
+        f32 literals at the flagship config — and backends that ship
+        the program to a remote compiler choke on it (observed: step
+        compile never finishing through the tunneled TPU)."""
+        model = self._model
 
         def one(carry, k):
             cache, tok = carry
@@ -372,7 +378,7 @@ class ContinuousBatcher:
         self._rng, key = jax.random.split(self._rng)
         active_before = sum(not s.free for s in self._slots)
         self._cache, toks = self._step_jit(
-            self._cache, jnp.asarray(self._toks), key)
+            self._cache, jnp.asarray(self._toks), key, self._params)
         toks = np.asarray(toks)                        # [slots, T] sync point
         with self._stats_lock:
             self._lane_steps += len(self._slots) * self._T

@@ -39,6 +39,20 @@ def test_dense_matches_reference(causal):
                                atol=1e-5)
 
 
+def test_dense_grouped_kv_matches_repeat():
+    # GQA: grouped einsum == explicit kv-head repetition
+    rng = np.random.default_rng(3)
+    H, Hk = 6, 2
+    q = jnp.asarray(rng.normal(size=(2, 16, H, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 16, Hk, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 16, Hk, 8)), jnp.float32)
+    grouped = dense_attention(q, k, v, causal=True)
+    repeated = dense_attention(q, jnp.repeat(k, H // Hk, axis=2),
+                               jnp.repeat(v, H // Hk, axis=2), causal=True)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(repeated),
+                               atol=1e-5)
+
+
 def test_auto_on_cpu_is_dense():
     # no pallas kernels off-TPU: auto must resolve to dense and agree
     rng = np.random.default_rng(1)
