@@ -121,7 +121,7 @@ class ContinuousBatcher:
         self._lane_steps = 0          # slot-steps actually dispatched
         self._active_lane_steps = 0   # of those, slots with live requests
         self._t0 = time.monotonic()
-        self._prefill_cache: dict[int, object] = {}
+        self._prefill_cache: dict[tuple[int, int], object] = {}
         self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,))
         self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -213,51 +213,56 @@ class ContinuousBatcher:
         return sample_logits(logits, key, temperature=self._temperature,
                              top_k=self._top_k, top_p=self._top_p)
 
-    def _prefill_fn(self, P: int):
-        """Compiled per prompt bucket: fresh 1-lane cache, prompt kv,
-        sampled next token."""
-        cached = self._prefill_cache.get(P)
+    # prefill sub-batch sizes: any group of waiting same-bucket
+    # requests splits greedily into these (8+4+2+1 covers any n), so
+    # prefill DISPATCHES amortise across requests instead of paying a
+    # host sync each — compile count stays bounded at buckets × 4
+    PREFILL_KS = (8, 4, 2, 1)
+
+    def _prefill_fn(self, P: int, K: int):
+        """Compiled per (prompt bucket, sub-batch size): fresh K-lane
+        cache, prompt kv, one sampled next token per lane."""
+        cached = self._prefill_cache.get((P, K))
         if cached is not None:
             return cached
         model = self._model
 
-        def prefill(params, ids, true_len, key):
+        def prefill(params, ids, true_lens, key):
             from edl_tpu.models.generate import _sum_drops
             cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 jax.eval_shape(
                     lambda: model.init(
-                        jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
-                        positions=jnp.zeros((1, 1), jnp.int32)))["cache"])
+                        jax.random.key(0), jnp.zeros((K, 1), jnp.int32),
+                        positions=jnp.zeros((K, 1), jnp.int32)))["cache"])
             logits, mut = model.apply(
                 {"params": params, "cache": cache}, ids,
                 positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),
                                            ids.shape),
                 mutable=["cache", "intermediates"])
-            # padded prompt: sample at the LAST REAL position; the pad
-            # queries wrote kv past true_len, which insertion resets
-            # (cache_index := true_len) and masks never reach
-            last = jax.lax.dynamic_index_in_dim(
-                logits, true_len - 1, axis=1, keepdims=False)
-            tok = self._sample(last, key)
+            # padded prompts: sample each lane at ITS last real
+            # position; the pad queries wrote kv past true_len, which
+            # insertion resets (cache_index := true_len) and masks
+            # never reach
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+            toks = self._sample(last, key)
             # MoE capacity overflow at prefill (0 for dense configs)
-            return mut["cache"], tok, _sum_drops(mut.get("intermediates"))
+            return mut["cache"], toks, _sum_drops(mut.get("intermediates"))
 
         fn = jax.jit(prefill)
-        self._prefill_cache[P] = fn
+        self._prefill_cache[(P, K)] = fn
         return fn
 
     @staticmethod
-    def _insert_impl(cache, slab, slot, true_len):
-        """Copy a 1-lane prefill cache into slot ``slot`` of the pool
-        cache and reset that slot's index to ``true_len``."""
+    def _insert_impl(cache, slab, slots, true_lens):
+        """Scatter a K-lane prefill cache into slots ``slots`` of the
+        pool cache and reset those slots' indices to ``true_lens``."""
         def put(big, small):
-            if small.ndim == 1:                       # cache_index [1]
-                return big.at[slot].set(true_len)
-            # kv buffers: [1, ...small_len...] -> [slots, ...cache_len...]
-            # at the slot, offset 0 along the time axis
-            starts = [slot] + [0] * (big.ndim - 1)
-            return jax.lax.dynamic_update_slice(big, small, tuple(starts))
+            if small.ndim == 1:                       # cache_index [K]
+                return big.at[slots].set(true_lens)
+            # kv buffers: [K, ...] lanes -> the pool's [n_slots, ...]
+            return big.at[slots].set(small)
         return jax.tree.map(put, cache, slab)
 
     def _step_impl(self, cache, toks, key, params):
@@ -298,7 +303,7 @@ class ContinuousBatcher:
             try:
                 filled = self._fill_slots(block=not self._any_active())
             except Exception as e:  # noqa: BLE001 — never die silently
-                # a prefill blew up in a way _prefill_into didn't
+                # a prefill blew up in a way _prefill_batch didn't
                 # absorb: fail everything live so no caller hangs
                 logger.exception("engine fill failed")
                 self._fail_all(e)
@@ -326,53 +331,75 @@ class ContinuousBatcher:
 
     def _fill_slots(self, block: bool) -> bool:
         """Move queued requests into free slots; returns True if any
-        prefill happened.  Blocks for the first request when idle."""
-        filled = False
-        while True:
-            free = next((i for i, s in enumerate(self._slots) if s.free),
-                        None)
-            if free is None:
-                return filled
+        prefill happened.  Blocks for the first request when idle.
+        Waiting same-bucket requests share batched prefill dispatches
+        (PREFILL_KS sub-batches) instead of one dispatch+sync each."""
+        free = [i for i, s in enumerate(self._slots) if s.free]
+        if not free:
+            return False
+        taken: list[_Request] = []
+        while len(taken) < len(free):
             try:
-                req = self._queue.get(block=block and not filled
+                req = self._queue.get(block=block and not taken
                                       and not self._stopping)
             except queue.Empty:
-                return filled
+                break
             if req is None:                            # stop signal
                 self._stopping = True
-                return filled
-            self._prefill_into(free, req)
-            filled = True
-
-    def _prefill_into(self, slot: int, req: _Request) -> None:
-        try:
+                break
+            taken.append(req)
+            block = False                              # drain non-blocking
+        if not taken:
+            return False
+        # group by prompt bucket, then greedy PREFILL_KS sub-batches
+        by_bucket: dict[int, list[_Request]] = {}
+        for req in taken:
             P = next(b for b in self._buckets if len(req.ids) <= b)
-            ids = np.zeros((1, P), np.int32)
-            ids[0, :len(req.ids)] = req.ids
+            by_bucket.setdefault(P, []).append(req)
+        for P, reqs in sorted(by_bucket.items()):
+            at = 0
+            while at < len(reqs):
+                K = next(k for k in self.PREFILL_KS
+                         if k <= len(reqs) - at or k == 1)
+                group = reqs[at:at + K]
+                at += len(group)
+                slots = [free.pop(0) for _ in group]
+                self._prefill_batch(P, slots, group)
+        return True
+
+    def _prefill_batch(self, P: int, slots: list[int],
+                       reqs: list[_Request]) -> None:
+        K = len(reqs)
+        try:
+            ids = np.zeros((K, P), np.int32)
+            lens = np.zeros((K,), np.int32)
+            for i, req in enumerate(reqs):
+                ids[i, :len(req.ids)] = req.ids
+                lens[i] = len(req.ids)
             self._rng, key = jax.random.split(self._rng)
-            slab, tok, drops = self._prefill_fn(P)(
-                self._params, jnp.asarray(ids),
-                jnp.asarray(len(req.ids), jnp.int32), key)
+            slab, toks, drops = self._prefill_fn(P, K)(
+                self._params, jnp.asarray(ids), jnp.asarray(lens), key)
             self._cache = self._insert_jit(
-                self._cache, slab, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(len(req.ids), jnp.int32))
-            tok = int(np.asarray(tok)[0])
+                self._cache, slab, jnp.asarray(slots, jnp.int32),
+                jnp.asarray(lens, jnp.int32))
+            toks = np.asarray(toks)
             drops = int(np.asarray(drops))
             if drops:
                 with self._stats_lock:
                     self._moe_drops += drops
-        except Exception as e:  # noqa: BLE001 — fail THIS request only
-            logger.exception("prefill failed for prompt len %d",
-                             len(req.ids))
-            req.future.set_exception(e)
+        except Exception as e:  # noqa: BLE001 — fail THIS group only
+            logger.exception("prefill failed (bucket %d, %d reqs)", P, K)
+            for req in reqs:
+                req.future.set_exception(e)
             return
-        s = self._slots[slot]
-        s.request = req
-        s.emitted = [tok]
-        s.remaining = req.max_new - 1
-        self._toks[slot] = tok
-        if s.remaining == 0 or tok == self._eos:
-            self._finish(slot)
+        for slot, req, tok in zip(slots, reqs, toks.tolist()):
+            s = self._slots[slot]
+            s.request = req
+            s.emitted = [int(tok)]
+            s.remaining = req.max_new - 1
+            self._toks[slot] = int(tok)
+            if s.remaining == 0 or int(tok) == self._eos:
+                self._finish(slot)
 
     def _advance(self) -> None:
         self._rng, key = jax.random.split(self._rng)
