@@ -229,6 +229,34 @@ def test_controller_skips_near_end_and_reaps_terminal(memkv):
     assert len(act.calls) == n_calls               # reaped once only
 
 
+def test_kubectl_actuator_invocation(tmp_path):
+    """KubectlActuator shells the documented command and survives a
+    failing/missing kubectl without raising."""
+    from edl_tpu.controller.actuator import KubectlActuator
+
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "kubectl"
+    # printf, not echo: echo would eat the leading "-n" namespace flag
+    fake.write_text(f"#!/bin/sh\nprintf '%s ' \"$@\" >> {log}\n"
+                    f"printf '\\n' >> {log}\nexit 0\n")
+    fake.chmod(0o755)
+    act = KubectlActuator(namespace="ns1", kubectl=str(fake))
+    assert act.scale("rn50", 3) is True
+    assert log.read_text().strip() == "-n ns1 scale statefulset/rn50 --replicas=3"
+
+    failing = tmp_path / "kubectl-fail"
+    failing.write_text("#!/bin/sh\necho boom >&2\nexit 1\n")
+    failing.chmod(0o755)
+    assert KubectlActuator(kubectl=str(failing)).scale("j", 1) is False
+    assert KubectlActuator(kubectl="/nonexistent/kubectl").scale("j", 1) is False
+
+    # custom workload mapping
+    act2 = KubectlActuator(namespace="ns2", kubectl=str(fake),
+                           workload_of=lambda j: f"deployment/{j}-workers")
+    assert act2.scale("lm", 0) is True
+    assert "deployment/lm-workers --replicas=0" in log.read_text()
+
+
 # -- live scale-in e2e --------------------------------------------------------
 @pytest.mark.slow
 def test_controller_scales_in_live_job(coord_server, tmp_path):

@@ -63,6 +63,22 @@ def test_transformer_with_ring_matches_dense():
                                rtol=2e-3, atol=2e-3)
 
 
+def test_ring_with_grouped_kv_matches_dense():
+    """GQA through the ring path: dispatch expands the kv groups before
+    the shard_map, so grouped K/V must equal dense grouped attention."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    rng = np.random.default_rng(9)
+    H, Hk = 4, 2
+    q = jnp.asarray(rng.normal(size=(2, 32, H, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 32, Hk, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 32, Hk, 8)), jnp.float32)
+    expected = dense_attention(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: dot_product_attention(
+        a, b, c, causal=True, impl="ring", mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_dispatch_requires_mesh_for_ring():
     q, k, v = _qkv(L=8)
     with pytest.raises(ValueError, match="needs the mesh"):
