@@ -76,8 +76,6 @@ def test_engine_counts_moe_prefill_drops():
     """Continuous-batching prefill surfaces MoE capacity overflow."""
     import dataclasses
 
-    from edl_tpu.models import TransformerLM
-
     cfg = TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
                             num_heads=4, mlp_dim=64, max_len=64,
                             remat=False, dtype=jnp.float32,
@@ -107,8 +105,6 @@ def test_gqa_engine_greedy_parity(small):
     """Continuous batching over a GQA model: grouped decode cache per
     slot still matches isolated generate() exactly."""
     import dataclasses
-
-    from edl_tpu.models import TransformerLM
 
     cfg = dataclasses.replace(small[0], num_kv_heads=2)
     params = TransformerLM(cfg).init(
