@@ -74,6 +74,17 @@ def test_lm_pipeline_composes_with_tp_and_fsdp(tmp_path):
 
 
 @pytest.mark.slow
+def test_lm_gqa_trains(tmp_path):
+    """--kv_heads 2 (grouped-query attention) trains the same workload
+    on a dp x tp mesh — the grouped dense path under jit + grad."""
+    rec, _ = run_lm(tmp_path, "--epochs", "2", "--steps_per_epoch", "10",
+                    "--kv_heads", "2", "--tp", "2")
+    assert rec["mesh"]["tp"] == 2, rec
+    assert rec["val_nll"] < rec["unigram_nll"], rec
+    assert rec["nll_curve"][-1] < rec["nll_curve"][0], rec
+
+
+@pytest.mark.slow
 def test_lm_fsdp_param_sharding(tmp_path):
     """dp x fsdp x tp: zero-style parameter sharding (embed on fsdp via
     the logical rules) trains the same workload."""
