@@ -41,6 +41,15 @@ from edl_tpu.utils.logger import get_logger
 logger = get_logger(__name__)
 
 
+def _natural_id(pod_id: str):
+    """Sort key treating a trailing ``-<int>`` (StatefulSet ordinal)
+    numerically; plain ids order lexically among themselves."""
+    head, _, tail = pod_id.rpartition("-")
+    if head and tail.isdigit():
+        return (head, int(tail), "")
+    return (pod_id, -1, pod_id)
+
+
 class ClusterGenerator(threading.Thread):
     def __init__(self, store, job_id: str, leader_pod_id: str,
                  min_nodes: int, max_nodes: int,
@@ -169,10 +178,15 @@ class ClusterGenerator(threading.Thread):
 
     def _leader_first(self, pods: list[Pod], resource: dict[str, Pod]) -> list[Pod]:
         """Leader pod first (it must be rank 0), stable order for the rest:
-        surviving members keep relative rank order, joiners sort by id."""
+        surviving members keep relative rank order, joiners sort by id —
+        NATURALLY, so StatefulSet-style ids ('job-10' after 'job-2') get
+        ranks tracking their pod ordinals and a k8s scale-in (highest
+        ordinal first) kills the same pods the cap retires."""
         uniq = {p.pod_id: p for p in pods}
         leader = uniq.pop(self._leader_id, None) or resource.get(self._leader_id)
-        rest = sorted(uniq.values(), key=lambda p: (p.rank if p.rank >= 0 else 1 << 30, p.pod_id))
+        rest = sorted(uniq.values(),
+                      key=lambda p: (p.rank if p.rank >= 0 else 1 << 30,
+                                     _natural_id(p.pod_id)))
         return ([leader] if leader else []) + rest
 
     def _write(self, cluster: Cluster | None) -> Cluster | None:

@@ -31,9 +31,13 @@ class KubectlActuator:
     ``workload_of(job_id)`` maps job ids to k8s workload refs
     (``statefulset/edl-train``); by default the job id IS the workload
     name of a StatefulSet, matching k8s/train-job.yaml.  StatefulSets
-    terminate the highest ordinals first on scale-in — the same
-    highest-rank-first order the generator's cap uses, so the record
-    and the replica patch agree about WHICH pods leave.
+    terminate the highest ordinals first on scale-in, and the generator
+    ranks joiners by pod ordinal (generator._natural_id), so the record
+    and the replica patch USUALLY agree about which pods leave.  They
+    can differ — the leader holds rank 0 whatever its ordinal, so when
+    the leader is not ordinal 0 one retired rank may not be the pod k8s
+    kills; the cost is one extra stop-resume rebuild (the killed pod's
+    TTL expiry triggers it), never a correctness problem.
     """
 
     def __init__(self, namespace: str = "default", kubectl: str = "kubectl",

@@ -196,7 +196,7 @@ class Block(nn.Module):
         return out.reshape(B, L, H, Dh)
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, token_mask=None):
         cfg = self.cfg
         H, Dh = cfg.num_heads, cfg.head_dim
         Hk = cfg.kv_heads
@@ -228,7 +228,7 @@ class Block(nn.Module):
                             mlp_dim=cfg.mlp_dim, top_k=cfg.moe_top_k,
                             capacity_factor=cfg.moe_capacity,
                             dtype=cfg.dtype, decode=cfg.decode,
-                            name="moe")(y)
+                            name="moe")(y, token_mask)
             return x + y, aux
         gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="mlp_gate")(y)
@@ -245,12 +245,16 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, train: bool = True,
-                 return_hidden: bool = False, with_aux: bool = False):
+                 return_hidden: bool = False, with_aux: bool = False,
+                 token_mask=None):
         """Logits [B, L, V] f32 — or, with ``return_hidden``, the
         final-norm hidden states [B, L, D] for the fused-CE loss path
         (:func:`lm_loss_fused`), which never materialises the logits.
         ``with_aux`` additionally returns the mean per-layer auxiliary
-        loss (the MoE load-balance term; 0 for dense MLP configs)."""
+        loss (the MoE load-balance term; 0 for dense MLP configs).
+        ``token_mask`` ([B, L] bool) marks real tokens in a padded
+        batch — pad positions are excluded from MoE routing (they must
+        not consume expert capacity; ops/moe.py compute_routing)."""
         cfg = self.cfg
         del train
         if positions is None:
@@ -268,7 +272,8 @@ class TransformerLM(nn.Module):
             # (models/generate.py _split_layer_params).
             aux = None
             for i in range(cfg.num_layers):
-                x, _ = Block(cfg, name=f"layer_{i}")(x, positions)
+                x, _ = Block(cfg, name=f"layer_{i}")(x, positions,
+                                                     token_mask)
         else:
             block = Block
             if cfg.remat:
@@ -279,7 +284,7 @@ class TransformerLM(nn.Module):
                             length=cfg.num_layers,
                             in_axes=nn.broadcast, metadata_params={},
                             unroll=1 if cfg.scan_layers else cfg.num_layers)
-            x, aux = Stack(cfg, name="layers")(x, positions)
+            x, aux = Stack(cfg, name="layers")(x, positions, token_mask)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
         aux_total = (jnp.mean(aux) if aux is not None
                      else jnp.zeros((), jnp.float32))

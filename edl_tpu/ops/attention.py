@@ -45,6 +45,7 @@ def dense_attention(q, k, v, *, causal: bool = False,
     h // (H//Hk)) — the grouped einsum attends without materialising
     repeated K/V."""
     B, Lq, H, D = q.shape
+    Lk = k.shape[1]
     Hk = k.shape[2]
     scale = sm_scale if sm_scale is not None else D ** -0.5
     if Hk == H:
@@ -53,10 +54,15 @@ def dense_attention(q, k, v, *, causal: bool = False,
     else:
         assert H % Hk == 0, f"q heads {H} not divisible by kv heads {Hk}"
         qg = q.reshape(B, Lq, Hk, H // Hk, D)
+        # grouped einsum, then the [B, H, Lq, Lk] view (q head h =
+        # hk * G + g, matching the reshape above) so causal/user masks
+        # broadcast identically to the MHA branch — a [B, 1, Lq, Lk]
+        # mask must never meet 5-D logits (it would error, or silently
+        # mis-mask when B == Hk)
         logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k
                             ).astype(jnp.float32) * scale
+        logits = logits.reshape(B, H, Lq, Lk)
     if causal:
-        Lk = k.shape[1]
         causal_mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
         logits = jnp.where(causal_mask, logits, -jnp.inf)
     if mask is not None:
@@ -64,7 +70,8 @@ def dense_attention(q, k, v, *, causal: bool = False,
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if Hk == H:
         return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    wg = weights.reshape(B, Hk, H // Hk, Lq, Lk)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v)
     return out.reshape(B, Lq, H, D)
 
 

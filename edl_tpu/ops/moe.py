@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 
-def compute_routing(probs, top_k: int, capacity: int):
+def compute_routing(probs, top_k: int, capacity: int, valid=None):
     """Routing tensors from router probabilities ``[B, S, E]``.
 
     Returns ``(dispatch [B, S, E, C] in {0,1}, combine [B, S, E, C]
@@ -40,18 +40,29 @@ def compute_routing(probs, top_k: int, capacity: int):
     deterministic, no RNG.  ``drops`` counts (token, expert)
     assignments that overflowed capacity — the silent-quality-loss
     signal a serving path must be able to observe.
+
+    ``valid`` ([B, S] bool, optional) marks real tokens: invalid
+    positions route NOWHERE — they claim no capacity slot, contribute
+    zero combine weight, and are excluded from the drop count and the
+    aux loss.  Serving prefill pads prompts to a bucket length; without
+    the mask, pad tokens consume capacity ahead of real tokens' lower
+    choices and the padded forward diverges from generate() on the
+    same prompt.
     """
     B, S, E = probs.shape
     gates, idx = jax.lax.top_k(probs, top_k)              # [B, S, K]
     gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
     onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [B, S, K, E]
+    if valid is not None:
+        onehot = onehot * valid[:, :, None, None].astype(jnp.float32)
 
     # k-major slot order: [B, K*S, E]
     slots = onehot.transpose(0, 2, 1, 3).reshape(B, top_k * S, E)
     pos = (jnp.cumsum(slots, axis=1) * slots).astype(jnp.int32) - 1
     kept = (pos >= 0) & (pos < capacity)
-    drops = (B * S * top_k
-             - kept.sum().astype(jnp.int32))             # overflowed slots
+    total = (jnp.asarray(B * S, jnp.int32) if valid is None
+             else valid.sum().astype(jnp.int32)) * top_k
+    drops = total - kept.sum().astype(jnp.int32)          # overflowed slots
     pos_c = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * kept[..., None]
     # back to token-major [B, S, K, E, C]; merge k (distinct (e, c) each)
     pos_c = pos_c.reshape(B, top_k, S, E, capacity).transpose(0, 2, 1, 3, 4)
@@ -59,10 +70,16 @@ def compute_routing(probs, top_k: int, capacity: int):
     combine = jnp.einsum("bske,bskec->bsec",
                          onehot * gates[..., None], pos_c)
 
-    # Switch aux loss from top-1 assignments
+    # Switch aux loss from top-1 assignments (over real tokens only)
     top1 = jax.nn.one_hot(idx[..., 0], E, dtype=jnp.float32)
-    frac_tokens = top1.mean(axis=(0, 1))                  # [E]
-    frac_prob = probs.mean(axis=(0, 1))                   # [E]
+    if valid is None:
+        frac_tokens = top1.mean(axis=(0, 1))              # [E]
+        frac_prob = probs.mean(axis=(0, 1))               # [E]
+    else:
+        v = valid.astype(jnp.float32)[..., None]
+        n = jnp.maximum(v.sum(), 1.0)
+        frac_tokens = (top1 * v).sum(axis=(0, 1)) / n
+        frac_prob = (probs * v).sum(axis=(0, 1)) / n
     aux = E * jnp.sum(frac_tokens * frac_prob)
     return dispatch, combine, aux, drops
 
@@ -85,7 +102,14 @@ class MoEMLP(nn.Module):
     Prefill (decode=True with a long S) takes the capacity path and
     CAN drop on overflow; the drop count is sown into the
     ``intermediates`` collection as ``moe_drops`` so serving paths can
-    surface it (pass ``mutable=["cache", "intermediates"]``)."""
+    surface it (pass ``mutable=["cache", "intermediates"]``).
+
+    Capacity is computed from the STATIC sequence length S, so a
+    bucket-padded prefill (serving/engine.py) gets a larger capacity
+    than the same prompt unpadded through generate(): with
+    ``token_mask`` the padded path can only drop FEWER (never more)
+    real-token assignments — identical whenever capacity is ample,
+    quality-biased-up when it is tight."""
 
     num_experts: int
     mlp_dim: int
@@ -95,7 +119,9 @@ class MoEMLP(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_mask=None):
+        """``token_mask`` ([B, S] bool, optional): real-token mask for
+        padded prefill — see :func:`compute_routing`."""
         B, S, M = x.shape
         E = self.num_experts
         gate_w = self.param("gate", nn.initializers.lecun_normal(),
@@ -134,8 +160,8 @@ class MoEMLP(nn.Module):
 
         capacity = max(1, math.ceil(
             self.top_k * S * self.capacity_factor / E))
-        dispatch, combine, aux, drops = compute_routing(probs, self.top_k,
-                                                        capacity)
+        dispatch, combine, aux, drops = compute_routing(
+            probs, self.top_k, capacity, valid=token_mask)
         # observable overflow: serving reads this via the intermediates
         # collection (training ignores it at zero cost — sow is a no-op
         # unless the caller asks for the collection)

@@ -235,10 +235,15 @@ class ContinuousBatcher:
                     lambda: model.init(
                         jax.random.key(0), jnp.zeros((K, 1), jnp.int32),
                         positions=jnp.zeros((K, 1), jnp.int32)))["cache"])
+            # pad positions are masked out of MoE routing (they must
+            # not claim expert capacity ahead of real tokens' choices
+            # — padded prefill and generate() must match exactly)
             logits, mut = model.apply(
                 {"params": params, "cache": cache}, ids,
                 positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),
                                            ids.shape),
+                token_mask=jnp.arange(ids.shape[1])[None, :]
+                < true_lens[:, None],
                 mutable=["cache", "intermediates"])
             # padded prompts: sample each lane at ITS last real
             # position; the pad queries wrote kv past true_len, which

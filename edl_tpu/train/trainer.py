@@ -205,6 +205,8 @@ class ElasticTrainer:
         anything it writes into ``meta`` is patched into that same
         epoch's committed sidecar afterwards.  Returns the final state."""
         rng = jax.random.key(0) if rng is None else rng
+        if self._run_t0 is None:
+            self._run_t0 = time.monotonic()
         self._report(TrainStatus.RUNNING)
         for epoch in range(meta.next_epoch, epochs):
             if epochs - epoch <= self.cfg.near_end_epochs:
@@ -375,6 +377,7 @@ class ElasticTrainer:
     _last_beat = 0.0
     _last_step_t: float | None = None
     _step_ema: float | None = None
+    _run_t0: float | None = None
     _warned_no_beat = False
 
     def _observe_step_time(self) -> None:
@@ -395,8 +398,11 @@ class ElasticTrainer:
         the pod) — feeds the launcher's hang watchdog.  The first beat
         only happens after step 1 finishes, so the watchdog can never
         mistake the initial XLA compile for a hang.  Publishes the
-        self-derived stale threshold (max(10x EMA step, 120 s)) so the
-        watchdog is on by default with no tuning.  Best-effort."""
+        self-derived stale threshold (max(10x EMA step, 120 s); the
+        first beat, before any inter-step interval exists, uses 10x the
+        elapsed wall time since fit() began so slow-step jobs are never
+        false-killed in the step-1..2 window) so the watchdog is on by
+        default with no tuning.  Best-effort."""
         if (self.store is None or self.tenv is None or not self.tenv.pod_id
                 or self.tenv.rank_in_pod != 0):
             return
@@ -416,8 +422,18 @@ class ElasticTrainer:
             return
         self._observe_step_time()
         from edl_tpu.cluster import heartbeat
-        threshold = (heartbeat.auto_threshold(self._step_ema)
-                     if _c.HANG_TIMEOUT == 0 else None)
+        threshold = None
+        if _c.HANG_TIMEOUT == 0:
+            # first beat (no inter-step interval observed yet): the bare
+            # floor would false-kill any job whose steady step exceeds
+            # it, so feed the elapsed wall time since fit() began
+            # (compile + step 1, an upper bound on step time) into the
+            # same auto_threshold formula; the second beat replaces it
+            # with the EMA-derived value.
+            ema = self._step_ema
+            if ema is None and self._run_t0 is not None:
+                ema = time.monotonic() - self._run_t0
+            threshold = heartbeat.auto_threshold(ema)
         # auto-couple the throttle: beat at least 3x faster than the
         # effective stale threshold, whatever heartbeat_every says — a
         # threshold below the throttle must never kill a healthy trainer

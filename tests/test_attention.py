@@ -53,6 +53,24 @@ def test_dense_grouped_kv_matches_repeat():
                                atol=1e-5)
 
 
+def test_dense_grouped_kv_batched_mask():
+    # a [B, 1, Lq, Lk] mask must broadcast identically in the GQA and
+    # MHA branches (it used to meet 5-D grouped logits: shape error, or
+    # silent mis-masking when B == Hk)
+    rng = np.random.default_rng(5)
+    B, L, H, Hk = 2, 8, 4, 2      # B == Hk: the silent mis-mask case
+    q = jnp.asarray(rng.normal(size=(B, L, H, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, L, Hk, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, L, Hk, 8)), jnp.float32)
+    mask = jnp.asarray(rng.random((B, 1, L, L)) > 0.3)
+    mask = mask | jnp.eye(L, dtype=bool)          # keep rows non-empty
+    grouped = dense_attention(q, k, v, mask=mask)
+    repeated = dense_attention(q, jnp.repeat(k, H // Hk, axis=2),
+                               jnp.repeat(v, H // Hk, axis=2), mask=mask)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(repeated),
+                               atol=1e-5)
+
+
 def test_auto_on_cpu_is_dense():
     # no pallas kernels off-TPU: auto must resolve to dense and agree
     rng = np.random.default_rng(1)

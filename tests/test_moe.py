@@ -47,6 +47,27 @@ def test_routing_position_bound():
     assert float(per_expert.max()) <= 1.0           # one token per cell
 
 
+def test_routing_pad_tokens_claim_no_capacity():
+    # serving prefill pads prompts to a bucket: with `valid`, the pad
+    # positions must route nowhere, and the real tokens' routing must
+    # be IDENTICAL to routing the unpadded prefix at the same capacity
+    probs = _probs(B=1, S=12, E=4, seed=7)
+    L, K, C = 8, 2, 3                      # tight capacity: drops happen
+    valid = jnp.arange(12)[None, :] < L
+    d_pad, c_pad, aux_pad, drops_pad = compute_routing(
+        probs, K, capacity=C, valid=valid)
+    d_ref, c_ref, aux_ref, drops_ref = compute_routing(
+        probs[:, :L], K, capacity=C)
+    assert float(d_pad[:, L:].sum()) == 0.0          # pads claim nothing
+    assert float(c_pad[:, L:].sum()) == 0.0
+    np.testing.assert_array_equal(np.asarray(d_pad[:, :L]),
+                                  np.asarray(d_ref))
+    np.testing.assert_allclose(np.asarray(c_pad[:, :L]), np.asarray(c_ref),
+                               rtol=1e-6)
+    assert int(drops_pad) == int(drops_ref)          # pads aren't "drops"
+    np.testing.assert_allclose(float(aux_pad), float(aux_ref), rtol=1e-6)
+
+
 def test_moe_mlp_forward_and_grad():
     model = MoEMLP(num_experts=4, mlp_dim=16, top_k=2,
                    dtype=jnp.float32)

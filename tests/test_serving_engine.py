@@ -101,6 +101,31 @@ def test_engine_counts_moe_prefill_drops():
         eng2.stop()
 
 
+def test_moe_engine_greedy_parity():
+    """MoE greedy parity engine-vs-generate: the padded prefill masks
+    pad positions out of routing, so a prompt shorter than its bucket
+    matches generate() on the unpadded prompt (ample capacity — see
+    compute_routing's valid test for the tight-capacity invariant)."""
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
+                            num_heads=4, mlp_dim=64, max_len=64,
+                            remat=False, dtype=jnp.float32,
+                            moe_experts=4, moe_top_k=2, moe_capacity=4.0)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 64, (n,)).astype(np.int32) for n in (3, 7, 13)]
+    eng = _engine(cfg, params, slots=2)
+    try:
+        futs = [eng.submit(p, 6) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop()
+    for p, out in zip(prompts, got):
+        want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), 6,
+                                   temperature=0.0))[0]
+        np.testing.assert_array_equal(out, want)
+
+
 def test_gqa_engine_greedy_parity(small):
     """Continuous batching over a GQA model: grouped decode cache per
     slot still matches isolated generate() exactly."""
