@@ -251,10 +251,25 @@ def _pad_to(a: np.ndarray, n: int) -> np.ndarray:
 
 
 def jit_teacher(model_apply, variables, fetch_name: str = "logits",
+                mesh=None, logical_rules=None, rules=None,
                 **apply_kw) -> Callable[[dict], dict]:
     """Wrap a flax apply into a jitted single-input predict_fn: feeds
-    named in the feed dict are passed positionally in sorted key order."""
+    named in the feed dict are passed positionally in sorted key order.
+
+    ``mesh`` (optional) serves the teacher tensor-parallel: variables
+    are device_put by their logical axes (``logical_rules`` — the
+    model's LOGICAL_RULES list; mapped to mesh axes by ``rules``,
+    default tp on heads/mlp/vocab) and the jitted forward follows the
+    data, so XLA inserts the tp collectives — a teacher bigger than one
+    chip's HBM serves exactly like the reference's multi-GPU-spanning
+    Paddle Serving teachers (/root/reference/README.md:51-64)."""
     import jax
+
+    if mesh is not None:
+        from edl_tpu.parallel.sharding import device_put_by_logical
+
+        variables = device_put_by_logical(variables, logical_rules, mesh,
+                                          rules)
 
     @jax.jit
     def fwd(*args):

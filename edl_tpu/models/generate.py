@@ -37,6 +37,38 @@ def _split_layer_params(params, num_layers: int):
     return out
 
 
+def _split_rules():
+    """LOGICAL_RULES rewritten for the SPLIT (per-layer unrolled) param
+    tree: ``layers/...`` paths become ``layer_<i>/...`` and lose the
+    leading ``layers`` stacking axis."""
+    from edl_tpu.models.transformer import LOGICAL_RULES
+
+    out = []
+    for pat, axes in LOGICAL_RULES:
+        if pat.startswith("layers/"):
+            out.append((r"layer_\d+/" + pat[len("layers/"):], axes[1:]))
+        else:
+            out.append((pat, axes))
+    return out
+
+
+def shard_split_params(params, mesh, num_layers: int, rules=None):
+    """Split stacked layer params and shard them over ``mesh`` by their
+    logical axes (megatron tp on heads/mlp/vocab under the default
+    rules) — the serving-side twin of ElasticTrainer.create_state's
+    sharded init.  ``params`` may be stacked (training layout) or
+    already split.  Returns the device-put split tree; jitting
+    generate()/the engine step over it makes XLA insert the tp
+    collectives (computation follows data) — the multi-chip serving
+    path for models bigger than one chip's HBM (the reference's
+    teacher regime: a ResNeXt101 spanning its GPU,
+    /root/reference/README.md:51-64)."""
+    from edl_tpu.parallel.sharding import device_put_by_logical
+
+    split = _split_layer_params(params, num_layers)
+    return device_put_by_logical(split, _split_rules(), mesh, rules)
+
+
 def sample_logits(logits, key, *, temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 0.0, top_k_recall: float = 0.95):
     """[B, V] logits -> [B] sampled token ids (the one sampling recipe

@@ -100,6 +100,42 @@ def tree_shardings(tree_logical, mesh: Mesh,
     )
 
 
+def divisible_shardings(tree, shardings):
+    """Replace any sharding whose spec does not divide its leaf's shape
+    with a replicated one (serving-side guard: a config whose vocab or
+    head count doesn't divide ``tp`` should serve correctly with that
+    one tensor replicated, not crash — training's shard_init keeps
+    strict validation so layout bugs surface loudly there)."""
+    import math
+
+    def fix(x, sh: NamedSharding):
+        for dim, axes in enumerate(sh.spec):
+            if axes is None:
+                continue
+            axes_t = axes if isinstance(axes, tuple) else (axes,)
+            size = math.prod(sh.mesh.shape[a] for a in axes_t)
+            if x.shape[dim] % size:
+                return NamedSharding(sh.mesh, P())
+        return sh
+
+    return jax.tree.map(fix, tree, shardings)
+
+
+def device_put_by_logical(tree, logical_rules, mesh: Mesh,
+                          rules: ShardingRules | None = None):
+    """Serving-side sharding recipe: map param paths to logical axes
+    (``logical_rules`` — a model's LOGICAL_RULES list), resolve to mesh
+    shardings, replicate anything that doesn't divide
+    (:func:`divisible_shardings`), device_put.  The one place the
+    lenient serve-time layout is defined — the engine and the teacher
+    must never drift apart here."""
+    from edl_tpu.models.logical import logical_axes_from_paths
+
+    logical = logical_axes_from_paths(tree, logical_rules or [])
+    shardings = tree_shardings(logical, mesh, rules or ShardingRules())
+    return jax.device_put(tree, divisible_shardings(tree, shardings))
+
+
 def shard_init(init_fn, tree_logical, mesh: Mesh,
                rules: ShardingRules | None = None):
     """Run ``init_fn`` under jit with output shardings so parameters are

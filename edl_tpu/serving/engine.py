@@ -81,6 +81,16 @@ class ContinuousBatcher:
     ``steps_per_sync`` trades scheduling latency for dispatch
     amortisation: a finished slot wastes at most ``steps_per_sync - 1``
     lane-steps before the host notices.
+
+    ``mesh`` (optional) lifts the engine onto a device mesh: params are
+    tp-sharded by their logical axes (models/generate.shard_split_params)
+    and the KV cache is sharded over ``tp`` on the kv-head axis, so a
+    model bigger than one chip's HBM serves from the same slot pool —
+    the reference's teacher regime (a ResNeXt101 spanning its GPU,
+    /root/reference/README.md:51-64).  The slot logic stays host-side
+    and unchanged; XLA inserts the tp collectives from the shardings.
+    Tokens match the unsharded engine exactly (greedy parity tested on
+    a tp=2 mesh).
     """
 
     def __init__(self, cfg: TransformerConfig, params, *, slots: int = 8,
@@ -88,14 +98,21 @@ class ContinuousBatcher:
                  prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int | None = None,
-                 steps_per_sync: int = 8, rng_seed: int = 20_26):
+                 steps_per_sync: int = 8, rng_seed: int = 20_26,
+                 mesh=None, rules=None):
         cache_len = max_len or cfg.max_len
         self.cfg = cfg
         self._dcfg = dataclasses.replace(
             cfg, decode=True, attention_impl="dense", mesh=None,
             max_len=cache_len)
         self._model = TransformerLM(self._dcfg)
-        self._params = _split_layer_params(params, cfg.num_layers)
+        self._mesh = mesh
+        if mesh is not None:
+            from edl_tpu.models.generate import shard_split_params
+            self._params = shard_split_params(params, mesh, cfg.num_layers,
+                                              rules)
+        else:
+            self._params = _split_layer_params(params, cfg.num_layers)
         self._slots = [_Slot() for _ in range(slots)]
         self._buckets = tuple(sorted(b for b in prefill_buckets
                                      if b <= cache_len))
@@ -122,8 +139,21 @@ class ContinuousBatcher:
         self._active_lane_steps = 0   # of those, slots with live requests
         self._t0 = time.monotonic()
         self._prefill_cache: dict[tuple[int, int], object] = {}
-        self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,))
-        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        if mesh is not None:
+            # pin the pool cache's sharding on every step/insert output
+            # so the layout is stable from step 1 (inference-only
+            # propagation would re-specialise the jit once per layout
+            # change and thrash the donation)
+            sh = self._pool_cache_shardings()
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,),
+                                     out_shardings=(sh, rep))
+            self._insert_jit = jax.jit(self._insert_impl,
+                                       donate_argnums=(0,), out_shardings=sh)
+        else:
+            self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,))
+            self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._thread.start()
@@ -198,13 +228,34 @@ class ContinuousBatcher:
                 req.future.set_exception(RuntimeError("engine stopped"))
 
     # -- device state construction -------------------------------------------
-    def _fresh_cache(self, B: int):
-        shapes = jax.eval_shape(
+    def _cache_shapes(self, B: int):
+        return jax.eval_shape(
             lambda: self._model.init(
                 jax.random.key(0), jnp.zeros((B, 1), jnp.int32),
-                positions=jnp.zeros((B, 1), jnp.int32)))
-        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                            shapes["cache"])
+                positions=jnp.zeros((B, 1), jnp.int32)))["cache"]
+
+    def _fresh_cache(self, B: int):
+        shapes = self._cache_shapes(B)
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self._mesh is not None:
+            zeros = jax.device_put(
+                zeros, jax.tree.map(self._leaf_sharding, shapes))
+        return zeros
+
+    def _leaf_sharding(self, s):
+        """KV buffers shard over ``tp`` on the kv-head axis (axis 1 of
+        [B, Hk, ...]) when it divides; cache_index and non-divisible
+        shapes (e.g. MQA with Hk < tp) replicate — GSPMD still shards
+        the q-head compute from the param shardings either way."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tp = dict(self._mesh.shape).get("tp", 1)
+        if s.ndim >= 2 and tp > 1 and s.shape[1] % tp == 0:
+            return NamedSharding(self._mesh, P(None, "tp"))
+        return NamedSharding(self._mesh, P())
+
+    def _pool_cache_shardings(self):
+        return jax.tree.map(self._leaf_sharding,
+                            self._cache_shapes(len(self._slots)))
 
     # -- jitted pieces -------------------------------------------------------
     def _sample(self, logits, key):
@@ -236,8 +287,11 @@ class ContinuousBatcher:
                         jax.random.key(0), jnp.zeros((K, 1), jnp.int32),
                         positions=jnp.zeros((K, 1), jnp.int32)))["cache"])
             # pad positions are masked out of MoE routing (they must
-            # not claim expert capacity ahead of real tokens' choices
-            # — padded prefill and generate() must match exactly)
+            # not claim expert capacity ahead of real tokens' choices;
+            # with ample capacity the padded prefill matches generate()
+            # exactly — under a tight capacity_factor the bucket's
+            # larger static capacity can only drop FEWER real tokens,
+            # see MoEMLP's docstring)
             logits, mut = model.apply(
                 {"params": params, "cache": cache}, ids,
                 positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),

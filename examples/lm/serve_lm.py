@@ -46,7 +46,7 @@ def request(endpoint: str, prompts: np.ndarray, timeout: float = 120.0):
 
 
 def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
-                     top_k: int, top_p: float = 0.0):
+                     top_k: int, top_p: float = 0.0, mesh=None):
     """jitted (params, ids, rng) -> tokens, with a fresh fold per call
     so temperature sampling differs between identical requests.
 
@@ -57,9 +57,15 @@ def build_predict_fn(cfg, params, max_new_tokens: int, temperature: float,
     RPC exposes)."""
     import jax
 
-    from edl_tpu.models.generate import generate
+    from edl_tpu.models.generate import generate, shard_split_params
 
     moe = bool(cfg.moe_experts)
+    if mesh is not None:
+        # tp-sharded serving: params split + device_put by logical
+        # axes; the jitted generate follows the data and XLA inserts
+        # the tp collectives (tokens match the replicated run exactly
+        # — tests/test_generate_sharded.py)
+        params = shard_split_params(params, mesh, cfg.num_layers)
 
     @jax.jit
     def gen(p, ids, rng):
@@ -144,13 +150,13 @@ class _ContinuousServer:
         self._engine.stop()
 
 
-def _continuous_server(cfg, params, args) -> _ContinuousServer:
+def _continuous_server(cfg, params, args, mesh=None) -> _ContinuousServer:
     from edl_tpu.serving import ContinuousBatcher
 
     engine = ContinuousBatcher(
         cfg, params, slots=args.continuous,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        eos_id=None if args.eos_id < 0 else args.eos_id)
+        eos_id=None if args.eos_id < 0 else args.eos_id, mesh=mesh)
     return _ContinuousServer(engine, args.max_new_tokens, port=args.port)
 
 
@@ -191,6 +197,10 @@ def main() -> None:
     p.add_argument("--eos_id", type=int, default=-1,
                    help="stop generation at this token (continuous "
                         "mode); -1 disables")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel serving over this many chips "
+                        "(params + KV cache sharded; for models bigger "
+                        "than one chip's HBM); 0 = single device")
     args = p.parse_args()
 
     if args.moe and args.moe_top_k > args.moe:
@@ -234,11 +244,20 @@ def main() -> None:
     else:
         params = init_params()    # random weights: wiring demo only
 
+    mesh = None
+    if args.tp > 1:
+        from edl_tpu.parallel import MeshSpec, build_mesh
+        devs = jax.devices()
+        if len(devs) < args.tp:
+            raise SystemExit(f"--tp {args.tp} but only {len(devs)} devices")
+        mesh = build_mesh(MeshSpec(dp=1, tp=args.tp), devices=devs[:args.tp])
+
     if args.continuous:
-        server = _continuous_server(cfg, params, args)
+        server = _continuous_server(cfg, params, args, mesh=mesh)
     else:
         predict = build_predict_fn(cfg, params, args.max_new_tokens,
-                                   args.temperature, args.top_k, args.top_p)
+                                   args.temperature, args.top_k, args.top_p,
+                                   mesh=mesh)
         server = TeacherServer(predict, port=args.port,
                                extra_stats=predict.stats)
     if args.coord_endpoints:

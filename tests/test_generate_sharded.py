@@ -10,35 +10,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from edl_tpu.models.generate import _split_layer_params, generate
+from edl_tpu.models.generate import generate, shard_split_params
 from edl_tpu.models.transformer import (
     TransformerConfig, TransformerLM,
 )
 from edl_tpu.parallel import MeshSpec, build_mesh
-from edl_tpu.parallel.sharding import ShardingRules, tree_shardings
 
 CFG = TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
                         num_heads=4, mlp_dim=64, max_len=32,
                         dtype=jnp.float32, attention_impl="dense",
                         remat=False)
-
-
-def _shard_split_params(params, mesh, rules, num_layers):
-    """tp-shard the per-layer split params by their logical axes."""
-    from edl_tpu.models import transformer as tf_mod
-    from edl_tpu.models.logical import logical_axes_from_paths
-
-    logical = logical_axes_from_paths(params, tf_mod.LOGICAL_RULES)
-    # per-layer modules lose the leading "layers" stacking axis
-    is_axes = lambda x: isinstance(x, tuple) and all(  # noqa: E731
-        a is None or isinstance(a, str) for a in x)
-    per_layer = jax.tree.map(lambda ax: ax[1:], logical["layers"],
-                             is_leaf=is_axes)
-    split_logical = {k: v for k, v in logical.items() if k != "layers"}
-    for i in range(num_layers):
-        split_logical[f"layer_{i}"] = per_layer
-    split = _split_layer_params(params, num_layers)
-    return jax.device_put(split, tree_shardings(split_logical, mesh, rules))
 
 
 def test_tp_sharded_generation_matches_replicated():
@@ -51,8 +32,7 @@ def test_tp_sharded_generation_matches_replicated():
     want = generate(CFG, params, prompt, 8, temperature=0)
 
     mesh = build_mesh(MeshSpec(dp=-1, tp=2))
-    rules = ShardingRules()
-    sharded = _shard_split_params(params, mesh, rules, CFG.num_layers)
+    sharded = shard_split_params(params, mesh, CFG.num_layers)
     # spot-check an actually-sharded leaf (mlp kernel split over tp)
     k = sharded["layer_0"]["mlp_in"]["kernel"]
     assert k.addressable_shards[0].data.shape == (32, 32)  # mlp 64 / tp 2

@@ -92,48 +92,71 @@ def test_continuous_server_roundtrip():
         server.stop()
 
 
-@pytest.mark.slow
-def test_serve_lm_cli_restores_checkpoint(tmp_path):
-    """Save a TrainState, boot the CLI against it, query, SIGTERM."""
+def _save_ckpt(tmp_path, params):
     import optax
 
     from edl_tpu.train.checkpoint import CheckpointManager
     from edl_tpu.train.state import TrainState
 
-    params = _params()
     ckpt = CheckpointManager(str(tmp_path / "ckpt"))
     ckpt.save(1, TrainState.create(params, optax.adamw(1e-3)))
     ckpt.wait()
     ckpt.close()
 
+
+def _boot_cli(tmp_path, extra_args=(), n_devices: int = 0):
+    """Boot the serve_lm CLI on the tiny CFG checkpoint; returns
+    (proc, endpoint).  ``n_devices`` > 0 forces a virtual CPU mesh."""
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    if n_devices:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "examples", "lm", "serve_lm.py"),
          "--checkpoint_dir", str(tmp_path / "ckpt"), "--vocab", "53",
          "--layers", "1", "--embed", "32", "--heads", "2", "--mlp", "64",
          "--max_len", "64", "--max_new_tokens", "4", "--temperature", "0",
-         "--port", "0"],
+         "--port", "0", *extra_args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    endpoint = None
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        # select-gated readline: a wedged server fails at the
+        # deadline instead of blocking the test forever
+        if not sel.select(timeout=1.0):
+            if proc.poll() is not None:
+                raise AssertionError("serve_lm died silently")
+            continue
+        line = proc.stdout.readline()
+        if "[serve_lm] serving on" in line:
+            endpoint = line.split("serving on")[1].split()[0]
+            break
+        if not line and proc.poll() is not None:
+            raise AssertionError("serve_lm died before announcing")
+    assert endpoint, "server never announced its endpoint"
+    return proc, endpoint
+
+
+def _stop_cli(proc):
+    proc.send_signal(signal.SIGTERM)
     try:
-        import selectors
-        sel = selectors.DefaultSelector()
-        sel.register(proc.stdout, selectors.EVENT_READ)
-        endpoint = None
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            # select-gated readline: a wedged server fails at the
-            # deadline instead of blocking the test forever
-            if not sel.select(timeout=1.0):
-                if proc.poll() is not None:
-                    raise AssertionError("serve_lm died silently")
-                continue
-            line = proc.stdout.readline()
-            if "[serve_lm] serving on" in line:
-                endpoint = line.split("serving on")[1].split()[0]
-                break
-            if not line and proc.poll() is not None:
-                raise AssertionError("serve_lm died before announcing")
-        assert endpoint, "server never announced its endpoint"
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.mark.slow
+def test_serve_lm_cli_restores_checkpoint(tmp_path):
+    """Save a TrainState, boot the CLI against it, query, SIGTERM."""
+    params = _params()
+    _save_ckpt(tmp_path, params)
+    proc, endpoint = _boot_cli(tmp_path)
+    try:
         toks = request(endpoint, np.asarray([[2, 4, 6]], np.int32))
         assert toks.shape == (1, 4)
 
@@ -144,8 +167,24 @@ def test_serve_lm_cli_restores_checkpoint(tmp_path):
                         temperature=0)
         np.testing.assert_array_equal(toks, np.asarray(want))
     finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        _stop_cli(proc)
+
+
+@pytest.mark.slow
+def test_serve_lm_cli_tp_continuous(tmp_path):
+    """serve_lm --tp 2 --continuous 2 on a virtual 8-device CPU mesh:
+    tensor-parallel continuous batching through the full CLI + RPC
+    stack, greedy output equal to in-process replicated generation."""
+    params = _params()
+    _save_ckpt(tmp_path, params)
+    proc, endpoint = _boot_cli(tmp_path, ("--tp", "2", "--continuous", "2"),
+                               n_devices=8)
+    try:
+        toks = request(endpoint, np.asarray([[2, 4, 6]], np.int32),
+                       timeout=300.0)
+        from edl_tpu.models.generate import generate
+        want = generate(CFG, params, jnp.asarray([[2, 4, 6]], jnp.int32), 4,
+                        temperature=0)
+        np.testing.assert_array_equal(toks, np.asarray(want))
+    finally:
+        _stop_cli(proc)

@@ -101,6 +101,54 @@ def test_engine_counts_moe_prefill_drops():
         eng2.stop()
 
 
+def test_tp_sharded_engine_greedy_parity(small):
+    """Continuous batching on a tp=2 mesh: params + KV cache sharded,
+    slot logic unchanged, tokens match the unsharded engine exactly —
+    the serving path for models bigger than one chip's HBM."""
+    from edl_tpu.parallel import MeshSpec, build_mesh
+
+    cfg, params = small
+    mesh = build_mesh(MeshSpec(dp=-1, tp=2))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 97, (n,)).astype(np.int32)
+               for n in (3, 9, 14, 6)]
+    news = [7, 4, 10, 6]
+    eng = _engine(cfg, params, mesh=mesh)
+    try:
+        # spot-check the params actually shard (mlp kernel over tp)
+        k = eng._params["layer_0"]["mlp_in"]["kernel"]
+        assert k.sharding.is_fully_replicated is False
+        futs = [eng.submit(p, n) for p, n in zip(prompts, news)]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop()
+    for p, n, out in zip(prompts, news, got):
+        want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), n,
+                                   temperature=0.0))[0]
+        np.testing.assert_array_equal(out, want)
+
+
+def test_tp_sharded_jit_teacher_matches():
+    """TeacherServer's model wrapper on a tp mesh: sharded forward
+    logits match the replicated forward bit-for-bit shape/value-wise."""
+    from edl_tpu.distill.teacher import jit_teacher
+    from edl_tpu.models.transformer import LOGICAL_RULES
+    from edl_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
+                            num_heads=4, mlp_dim=64, max_len=32,
+                            remat=False, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+    ids = np.random.default_rng(2).integers(0, 64, (2, 8)).astype(np.int32)
+
+    plain = jit_teacher(model.apply, variables)({"ids": ids})["logits"]
+    mesh = build_mesh(MeshSpec(dp=-1, tp=2))
+    sharded = jit_teacher(model.apply, variables, mesh=mesh,
+                          logical_rules=LOGICAL_RULES)({"ids": ids})["logits"]
+    np.testing.assert_allclose(sharded, plain, atol=1e-5)
+
+
 def test_moe_engine_greedy_parity():
     """MoE greedy parity engine-vs-generate: the padded prefill masks
     pad positions out of routing, so a prompt shorter than its bucket
