@@ -7,12 +7,20 @@ requests arrive raggedly or finish early.  This engine keeps a fixed
 pool of ``slots`` decode lanes over ONE persistent KV cache:
 
 - a new request **prefills** into any free slot (per-prompt-length
-  bucket, compiled once per bucket) while the other slots keep their
-  state;
+  bucket, compiled once per bucket; buckets extend by doubling up to
+  the cache length, so any prompt that leaves room for one generated
+  token is accepted);
 - every decode dispatch advances ALL slots ``steps_per_sync`` tokens
   under one jitted ``lax.scan`` (host↔device sync once per chunk, not
   per token — decode is host-driven, so the sync cadence sets the
   floor);
+- prefill work is **bounded and overlapped**: each engine tick
+  dispatches at most ONE prefill group (so a burst of arrivals can
+  never starve running lanes), then the decode chunk, then the insert
+  — and syncs the host ONCE for all of it.  Active lanes advance
+  ``steps_per_sync`` tokens every tick no matter how fast requests
+  arrive; ``stats()['prefill_stall_s']`` bounds the decode wall-time
+  cost of prefill dispatches;
 - a finished slot (token budget or ``eos_id``) frees immediately and
   the next queued request takes it — no convoy behind the longest
   generation in a batch.
@@ -34,6 +42,7 @@ import dataclasses
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 
@@ -106,6 +115,7 @@ class ContinuousBatcher:
             cfg, decode=True, attention_impl="dense", mesh=None,
             max_len=cache_len)
         self._model = TransformerLM(self._dcfg)
+        self._pending: "deque[_Request]" = deque()
         self._mesh = mesh
         if mesh is not None:
             from edl_tpu.models.generate import shard_split_params
@@ -114,8 +124,15 @@ class ContinuousBatcher:
         else:
             self._params = _split_layer_params(params, cfg.num_layers)
         self._slots = [_Slot() for _ in range(slots)]
-        self._buckets = tuple(sorted(b for b in prefill_buckets
-                                     if b <= cache_len))
+        buckets = sorted(b for b in prefill_buckets if b <= cache_len)
+        if not buckets:
+            raise ValueError(f"no prefill bucket fits cache_len {cache_len}")
+        # extend by doubling to cache_len: the prompt cap is the CACHE,
+        # not the configured bucket list (a 1024-cache engine must
+        # accept a 600-token prompt even with default 512-max buckets)
+        while buckets[-1] < cache_len:
+            buckets.append(min(buckets[-1] * 2, cache_len))
+        self._buckets = tuple(buckets)
         self._temperature = temperature
         self._top_k = top_k
         self._top_p = top_p
@@ -137,6 +154,7 @@ class ContinuousBatcher:
         self._moe_drops = 0       # MoE prefill capacity overflow (see stats)
         self._lane_steps = 0          # slot-steps actually dispatched
         self._active_lane_steps = 0   # of those, slots with live requests
+        self._prefill_stall_s = 0.0   # prefill dispatch time w/ lanes live
         self._t0 = time.monotonic()
         self._prefill_cache: dict[tuple[int, int], object] = {}
         if mesh is not None:
@@ -168,10 +186,10 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(ids) > (self._buckets[-1] if self._buckets else 0):
+        if len(ids) >= cache_len:
             raise ValueError(
-                f"prompt length {len(ids)} exceeds the largest prefill "
-                f"bucket {self._buckets[-1:]} (cache_len {cache_len})")
+                f"prompt length {len(ids)} must leave room for at least "
+                f"one generated token (cache_len {cache_len})")
         if len(ids) + max_new_tokens > cache_len:
             raise ValueError(
                 f"prompt {len(ids)} + new {max_new_tokens} exceeds "
@@ -196,7 +214,7 @@ class ContinuousBatcher:
             return {
                 "slots": len(self._slots),
                 "active_slots": active,
-                "queue_depth": self._queue.qsize(),
+                "queue_depth": self._queue.qsize() + len(self._pending),
                 "requests_done": self._done_requests,
                 "tokens_emitted": self._emitted_tokens,
                 "tokens_per_s": round(self._emitted_tokens / dt, 1),
@@ -206,6 +224,12 @@ class ContinuousBatcher:
                 # MoE prefill capacity overflow (always 0 for dense
                 # configs; nonzero = raise capacity_factor)
                 "moe_prefill_drops": self._moe_drops,
+                # host-side time spent dispatching prefill work while
+                # decode lanes were live — the upper bound on decode
+                # wall-time lost to admissions (device work still
+                # serialises on one chip; this is the schedule cost)
+                "prefill_stall_s": round(self._prefill_stall_s, 3),
+                "max_prompt_len": self._dcfg.max_len - 1,
                 "uptime_s": round(dt, 3),
             }
 
@@ -219,6 +243,9 @@ class ContinuousBatcher:
                 s.request.future.set_exception(
                     RuntimeError("engine stopped mid-generation"))
                 s.request = None
+        while self._pending:      # engine thread joined: safe to touch
+            self._pending.popleft().future.set_exception(
+                RuntimeError("engine stopped"))
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -359,25 +386,75 @@ class ContinuousBatcher:
     # -- the loop ------------------------------------------------------------
     def _loop(self) -> None:
         while True:
-            try:
-                filled = self._fill_slots(block=not self._any_active())
-            except Exception as e:  # noqa: BLE001 — never die silently
-                # a prefill blew up in a way _prefill_batch didn't
-                # absorb: fail everything live so no caller hangs
-                logger.exception("engine fill failed")
-                self._fail_all(e)
-                filled = False
+            self._drain(block=not self._any_active())
             if self._stopping:
-                return
-            if not self._any_active():
-                if filled:
-                    continue
-                return  # stop signal drained and nothing active
+                return  # stop() fails active slots + pending
             try:
-                self._advance()
-            except Exception as e:  # noqa: BLE001 — fail all live futures
-                logger.exception("engine step failed")
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — never die silently
+                logger.exception("engine tick failed")
                 self._fail_all(e)
+
+    def _drain(self, block: bool) -> None:
+        """Pull queued requests into the host-side pending list; blocks
+        for the first one only when the engine is otherwise idle."""
+        while True:
+            try:
+                req = self._queue.get(block=block and not self._pending
+                                      and not self._stopping)
+            except queue.Empty:
+                return
+            if req is None:                            # stop signal
+                self._stopping = True
+                return
+            self._pending.append(req)
+            block = False                              # drain non-blocking
+
+    def _tick(self) -> None:
+        """One engine tick: dispatch at most ONE prefill group, then the
+        decode chunk for the lanes that were already live, then the
+        cache insert — and sync the host once for all of it.  Bounding
+        prefill to one group per tick means a burst of arrivals can
+        never starve running lanes: they advance ``steps_per_sync``
+        tokens every tick regardless of the queue."""
+        active = [i for i, s in enumerate(self._slots) if not s.free]
+        pre = None
+        group = self._next_group()
+        if group is not None:
+            t0 = time.monotonic()
+            pre = self._dispatch_prefill(*group)
+            if active:
+                with self._stats_lock:
+                    self._prefill_stall_s += time.monotonic() - t0
+        # everything from here to the sync can raise with the prefill
+        # group already popped from _pending but not yet in slots —
+        # _fail_all (our caller's handler) only covers slot-resident
+        # requests, so fail the group's futures before re-raising
+        try:
+            dec = None
+            if active:
+                self._rng, key = jax.random.split(self._rng)
+                self._cache, dec = self._step_jit(
+                    self._cache, jnp.asarray(self._toks), key, self._params)
+            if pre is not None:
+                slab, ptoks, pdrops, slots, reqs, lens = pre
+                self._cache = self._insert_jit(
+                    self._cache, slab, jnp.asarray(slots, jnp.int32),
+                    jnp.asarray(lens, jnp.int32))
+            # single sync point for decode + prefill
+            dec_np = np.asarray(dec) if dec is not None else None
+            if pre is not None:
+                ptoks_np = np.asarray(ptoks)
+                drops = int(np.asarray(pdrops))
+        except Exception as e:  # noqa: BLE001
+            if pre is not None:
+                for req in pre[4]:
+                    req.future.set_exception(e)
+            raise
+        if dec_np is not None:
+            self._finish_decode(dec_np, len(active))
+        if pre is not None:
+            self._finish_prefill(slots, reqs, ptoks_np, drops)
 
     def _fail_all(self, e: Exception) -> None:
         for s in self._slots:
@@ -388,46 +465,40 @@ class ContinuousBatcher:
     def _any_active(self) -> bool:
         return any(not s.free for s in self._slots)
 
-    def _fill_slots(self, block: bool) -> bool:
-        """Move queued requests into free slots; returns True if any
-        prefill happened.  Blocks for the first request when idle.
-        Waiting same-bucket requests share batched prefill dispatches
-        (PREFILL_KS sub-batches) instead of one dispatch+sync each."""
+    def _bucket(self, n: int) -> int:
+        """Smallest prefill bucket holding an n-token prompt (buckets
+        extend to cache_len at construction, so any prompt submit()
+        accepts has one)."""
+        return next(b for b in self._buckets if n <= b)
+
+    def _next_group(self) -> tuple[int, list[int], list[_Request]] | None:
+        """Take the next same-bucket run of pending requests (FIFO from
+        the front) as one prefill group, capped by free slots and the
+        largest PREFILL_KS sub-batch size (compile count stays bounded
+        at buckets × |PREFILL_KS|)."""
+        if self._stopping or not self._pending:
+            return None
         free = [i for i, s in enumerate(self._slots) if s.free]
         if not free:
-            return False
-        taken: list[_Request] = []
-        while len(taken) < len(free):
-            try:
-                req = self._queue.get(block=block and not taken
-                                      and not self._stopping)
-            except queue.Empty:
-                break
-            if req is None:                            # stop signal
-                self._stopping = True
-                break
-            taken.append(req)
-            block = False                              # drain non-blocking
-        if not taken:
-            return False
-        # group by prompt bucket, then greedy PREFILL_KS sub-batches
-        by_bucket: dict[int, list[_Request]] = {}
-        for req in taken:
-            P = next(b for b in self._buckets if len(req.ids) <= b)
-            by_bucket.setdefault(P, []).append(req)
-        for P, reqs in sorted(by_bucket.items()):
-            at = 0
-            while at < len(reqs):
-                K = next(k for k in self.PREFILL_KS
-                         if k <= len(reqs) - at or k == 1)
-                group = reqs[at:at + K]
-                at += len(group)
-                slots = [free.pop(0) for _ in group]
-                self._prefill_batch(P, slots, group)
-        return True
+            return None
+        P = self._bucket(len(self._pending[0].ids))
+        reqs: list[_Request] = []
+        cap = min(len(free), self.PREFILL_KS[0])
+        while (self._pending and len(reqs) < cap
+               and self._bucket(len(self._pending[0].ids)) == P):
+            reqs.append(self._pending.popleft())
+        K = next(k for k in self.PREFILL_KS if k <= len(reqs))
+        for req in reversed(reqs[K:]):                 # overflow back, FIFO
+            self._pending.appendleft(req)
+        reqs = reqs[:K]
+        return P, free[:K], reqs
 
-    def _prefill_batch(self, P: int, slots: list[int],
-                       reqs: list[_Request]) -> None:
+    def _dispatch_prefill(self, P: int, slots: list[int],
+                          reqs: list[_Request]):
+        """Dispatch (not sync) one prefill group; returns the in-flight
+        device values or None when tracing/dispatch failed (that group's
+        futures are failed here; device-side errors surface at the tick
+        sync)."""
         K = len(reqs)
         try:
             ids = np.zeros((K, P), np.int32)
@@ -438,19 +509,18 @@ class ContinuousBatcher:
             self._rng, key = jax.random.split(self._rng)
             slab, toks, drops = self._prefill_fn(P, K)(
                 self._params, jnp.asarray(ids), jnp.asarray(lens), key)
-            self._cache = self._insert_jit(
-                self._cache, slab, jnp.asarray(slots, jnp.int32),
-                jnp.asarray(lens, jnp.int32))
-            toks = np.asarray(toks)
-            drops = int(np.asarray(drops))
-            if drops:
-                with self._stats_lock:
-                    self._moe_drops += drops
+            return slab, toks, drops, slots, reqs, lens
         except Exception as e:  # noqa: BLE001 — fail THIS group only
             logger.exception("prefill failed (bucket %d, %d reqs)", P, K)
             for req in reqs:
                 req.future.set_exception(e)
-            return
+            return None
+
+    def _finish_prefill(self, slots: list[int], reqs: list[_Request],
+                        toks: np.ndarray, drops: int) -> None:
+        if drops:
+            with self._stats_lock:
+                self._moe_drops += drops
         for slot, req, tok in zip(slots, reqs, toks.tolist()):
             s = self._slots[slot]
             s.request = req
@@ -460,17 +530,15 @@ class ContinuousBatcher:
             if s.remaining == 0 or int(tok) == self._eos:
                 self._finish(slot)
 
-    def _advance(self) -> None:
-        self._rng, key = jax.random.split(self._rng)
-        active_before = sum(not s.free for s in self._slots)
-        self._cache, toks = self._step_jit(
-            self._cache, jnp.asarray(self._toks), key, self._params)
-        toks = np.asarray(toks)                        # [slots, T] sync point
+    def _finish_decode(self, toks: np.ndarray, n_active: int) -> None:
+        """Consume one decode chunk [slots, T].  Runs BEFORE this tick's
+        _finish_prefill, so lanes filled this tick are still free here
+        and never consume a chunk that predates their insert."""
         with self._stats_lock:
             self._lane_steps += len(self._slots) * self._T
-            self._active_lane_steps += active_before * self._T
+            self._active_lane_steps += n_active * self._T
         for i, s in enumerate(self._slots):
-            if s.free:
+            if s.free:      # occupied slots always have remaining >= 1
                 continue
             for t in range(self._T):
                 if s.remaining <= 0:

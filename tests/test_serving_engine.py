@@ -218,12 +218,94 @@ def test_submit_validation(small):
     try:
         with pytest.raises(ValueError, match="empty"):
             eng.submit(np.zeros((0,), np.int32), 4)
-        with pytest.raises(ValueError, match="bucket"):
-            eng.submit(np.zeros((17,), np.int32), 4)   # > largest bucket 16
+        with pytest.raises(ValueError, match="room"):
+            eng.submit(np.zeros((64,), np.int32), 1)   # no room to generate
         with pytest.raises(ValueError, match="max_len"):
             eng.submit(np.zeros((16,), np.int32), 60)  # 16 + 60 > 64
     finally:
         eng.stop()
+
+
+def test_prompt_longer_than_configured_buckets(small):
+    """The prompt cap is the CACHE, not the bucket list: buckets extend
+    by doubling to cache_len, so a 17-token prompt serves fine with
+    configured buckets (8, 16) and a 64 cache — greedy parity holds."""
+    cfg, params = small
+    p = np.random.default_rng(9).integers(1, 97, (17,)).astype(np.int32)
+    eng = _engine(cfg, params)
+    try:
+        assert eng.stats()["max_prompt_len"] == 63
+        out = eng.generate(p, 5, timeout=120)
+    finally:
+        eng.stop()
+    want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), 5,
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.slow
+def test_600_token_prompt_1024_cache():
+    """VERDICT r4 #4's acceptance case: a 1024-cache engine must accept
+    a 600-token prompt with the DEFAULT bucket list (max 512)."""
+    cfg = TransformerConfig(vocab_size=61, num_layers=1, embed_dim=16,
+                            num_heads=2, mlp_dim=32, max_len=1024,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    p = np.random.default_rng(4).integers(1, 61, (600,)).astype(np.int32)
+    eng = ContinuousBatcher(cfg, params, slots=2, temperature=0.0,
+                            steps_per_sync=4)
+    try:
+        out = eng.generate(p, 6, timeout=300)
+    finally:
+        eng.stop()
+    want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), 6,
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_mixed_load_decode_not_starved(small):
+    """Decode lanes advance every tick no matter how fast new requests
+    arrive: two long generations run to completion while a queue of
+    short arrivals churns through the remaining slot, and their wall
+    time stays within 2x the quiet-engine run.  (The tick design bounds
+    prefill to ONE dispatch per tick; the >=0.8 device-class ratio is
+    measured on real hardware by bench.py's engine section — wall-clock
+    asserts any tighter than 2x flake on a loaded 1-core CI host.)"""
+    import time as _t
+
+    cfg, params = small
+    LONG, SHORT = 40, 4
+
+    def run(churn: int) -> float:
+        eng = _engine(cfg, params, slots=3, steps_per_sync=4)
+        try:
+            t0 = _t.monotonic()
+            longs = [eng.submit(np.asarray([7, 11, 13], np.int32), LONG)
+                     for _ in range(2)]
+            shorts = [eng.submit(np.asarray([5, 9], np.int32), SHORT)
+                      for _ in range(churn)]
+            for f in longs:
+                f.result(timeout=300)
+            dt = _t.monotonic() - t0
+            for f in shorts:
+                f.result(timeout=300)
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        if churn:
+            # shorts prefill while the longs decode: stall is accounted
+            # (no assertion on the quiet run — whether its two submits
+            # land in one idle-engine prefill group is a thread race)
+            assert stats["prefill_stall_s"] > 0.0
+            assert stats["requests_done"] == 2 + churn
+        return dt
+
+    quiet = run(churn=0)
+    busy = run(churn=12)
+    assert busy <= max(2.0 * quiet, quiet + 2.0), (
+        f"long decodes starved by arrivals: quiet {quiet:.2f}s vs "
+        f"busy {busy:.2f}s")
 
 
 def test_stop_fails_pending(small):
