@@ -126,7 +126,9 @@ class ContinuousBatcher:
         self._slots = [_Slot() for _ in range(slots)]
         buckets = sorted(b for b in prefill_buckets if b <= cache_len)
         if not buckets:
-            raise ValueError(f"no prefill bucket fits cache_len {cache_len}")
+            # every configured bucket exceeds the cache: one bucket at
+            # the cache length still serves any prompt submit() accepts
+            buckets = [cache_len]
         # extend by doubling to cache_len: the prompt cap is the CACHE,
         # not the configured bucket list (a 1024-cache engine must
         # accept a 600-token prompt even with default 512-max buckets)
@@ -205,6 +207,28 @@ class ContinuousBatcher:
                  timeout: float | None = None) -> np.ndarray:
         """Blocking convenience wrapper around :meth:`submit`."""
         return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def warm(self, prompt_len: int) -> None:
+        """Compile everything serving ``prompt_len``-class prompts can
+        hit — the decode step and the prefill + insert pair at every
+        PREFILL_KS sub-batch size — BEFORE traffic arrives.  A compile
+        inside the serving path stalls every live lane (minutes on a
+        remote-compiler backend); call this after construction, before
+        submitting.  Thread-safe only while no requests are in flight."""
+        key = jax.random.key(0)
+        P = self._bucket(prompt_len)
+        for K in [k for k in self.PREFILL_KS if k <= len(self._slots)]:
+            ids = jnp.zeros((K, P), jnp.int32)
+            lens = jnp.ones((K,), jnp.int32)
+            slab, toks, _ = self._prefill_fn(P, K)(self._params, ids,
+                                                   lens, key)
+            # lower+compile only: executing would donate the live cache
+            self._insert_jit.lower(self._cache, slab,
+                                   jnp.zeros((K,), jnp.int32),
+                                   lens).compile()
+            jax.block_until_ready(toks)
+        self._step_jit.lower(self._cache, jnp.asarray(self._toks), key,
+                             self._params).compile()
 
     def stats(self) -> dict:
         with self._stats_lock:

@@ -62,13 +62,34 @@ def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
                 "world_size > 1 but no coordinator address: set "
                 "EDL_TPU_COORDINATOR or EDL_TPU_TRAINER_ENDPOINTS")
         timeout = int(os.environ.get("EDL_TPU_DIST_INIT_TIMEOUT", "120"))
+        retries = max(1, int(os.environ.get("EDL_TPU_DIST_INIT_RETRIES", "3")))
         logger.info("jax.distributed.initialize(coordinator=%s, n=%d, rank=%d)",
                     coordinator, tenv.world_size, tenv.global_rank)
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=tenv.world_size,
-            process_id=tenv.global_rank,
-            initialization_timeout=timeout)
+        for attempt in range(1, retries + 1):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=tenv.world_size,
+                    process_id=tenv.global_rank,
+                    initialization_timeout=timeout)
+                break
+            except Exception as e:  # noqa: BLE001 — rendezvous is racy
+                # under CPU starvation the Gloo/coordinator rendezvous
+                # can time out even though every peer is alive (a real
+                # loaded-cluster failure mode, observed when multiple
+                # suites compete for one core): retry with backoff
+                # before declaring the world unformable
+                if attempt == retries:
+                    raise
+                logger.warning(
+                    "distributed init failed (attempt %d/%d): %s — "
+                    "retrying", attempt, retries, e)
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 — partial init state
+                    pass
+                import time
+                time.sleep(2.0 * attempt)
         _initialized = True
         formed = jax.process_count()
         if formed != tenv.world_size:

@@ -161,3 +161,20 @@ def test_transformer_tp_sharding_end_to_end():
     out = jax.jit(lambda p, i: model.apply({"params": p}, i))(params, gids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_auto_layout_decisions():
+    """The shipped defaults must BE the fast configuration: unroll
+    shallow stacks, remat only when the batch misses HBM (calibrated on
+    measured v5e runs — the flagship trains un-remat'd at bs 8 and OOMs
+    at bs 16 on 16 GB)."""
+    from edl_tpu.models.transformer import TransformerConfig, auto_layout
+
+    flag = TransformerConfig()          # 12L x 768, seq 1024
+    bs8 = auto_layout(flag, 8, 1024, hbm_bytes=16.6e9)
+    assert bs8.remat is False and bs8.scan_layers is False
+    bs16 = auto_layout(flag, 16, 1024, hbm_bytes=16.6e9)
+    assert bs16.remat is True
+    deep = auto_layout(TransformerConfig(num_layers=48), 8, 1024,
+                       hbm_bytes=16.6e9)
+    assert deep.scan_layers is True and deep.remat is True

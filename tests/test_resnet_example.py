@@ -142,7 +142,21 @@ def test_resnet_data_service_survives_mid_epoch_kill(coord_server, tmp_path):
         time.sleep(0.25)
     else:
         raise AssertionError("epoch 0 never completed: " + _logs(tmp)[-3000:])
-    time.sleep(2.0)
+    # kill once training is demonstrably INSIDE epoch 1: a mid-epoch
+    # save (every 4 steps) past epoch 0's 16 steps has committed — a
+    # condition, where the old fixed 2 s meant 0-8 steps depending on
+    # host load
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        steps = [int(d) for d in (os.listdir(ckpt)
+                                  if os.path.isdir(ckpt) else [])
+                 if d.isdigit()]
+        if steps and max(steps) > 16:
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError("no mid-epoch-1 checkpoint appeared: "
+                             + _logs(tmp)[-3000:])
     kill_tree(pb)
     assert finish(pa, 420) == 0, _logs(tmp)[-4000:]
     try:
