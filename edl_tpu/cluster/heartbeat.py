@@ -103,23 +103,36 @@ def clear(store, job_id: str, pod_id: str) -> None:
 # kill+respawn).  Launchers remember the incident timestamp they have
 # already handled, so a restarted supervise loop ignores its own cause.
 
-def _hang_key(job_id: str, stage: str) -> str:
-    return paths.key(job_id, constants.ETCD_HEARTBEAT, f"hang/{stage}")
-
-
-def flag_hang(store, job_id: str, stage: str, pod_id: str) -> float:
-    """Record 'stage <stage> is hung' (detected by ``pod_id``); returns
-    the incident timestamp all launchers coordinate on."""
+def write_stage_flag(store, job_id: str, name: str, stage: str,
+                     pod_id: str) -> float:
+    """Shared stage-scoped incident flag: ``<name>/<stage>`` under the
+    heartbeat table, value ``<timestamp> <pod_id>`` — used by the hang
+    watchdog here and the preemption grace (cluster/preempt.py); one
+    encode/decode so the two can never drift."""
     t = time.time()
-    store.put(_hang_key(job_id, stage), f"{t!r} {pod_id}".encode())
+    store.put(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                        f"{name}/{stage}"),
+              f"{t!r} {pod_id}".encode())
     return t
 
 
-def get_hang(store, job_id: str, stage: str) -> float | None:
-    rec = store.get(_hang_key(job_id, stage))
+def read_stage_flag(store, job_id: str, name: str, stage: str
+                    ) -> float | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                              f"{name}/{stage}"))
     if rec is None or not rec.value:
         return None
     try:
         return float(rec.value.decode().split()[0])
     except (ValueError, IndexError):
         return None
+
+
+def flag_hang(store, job_id: str, stage: str, pod_id: str) -> float:
+    """Record 'stage <stage> is hung' (detected by ``pod_id``); returns
+    the incident timestamp all launchers coordinate on."""
+    return write_stage_flag(store, job_id, "hang", stage, pod_id)
+
+
+def get_hang(store, job_id: str, stage: str) -> float | None:
+    return read_stage_flag(store, job_id, "hang", stage)

@@ -1,0 +1,37 @@
+"""Preemption flag: the SIGTERM-grace channel between a departing
+launcher and every trainer in the world.
+
+TPU pods get preempted with SIGTERM + a grace window; without handling,
+a preemption looks like a crash and the job loses everything since the
+last periodic checkpoint.  The flow (reference stop-resume contract,
+fault_tolerance.md:20-25, extended to step granularity):
+
+1. the signalled launcher writes ``preempt/<stage>`` (this module);
+2. every trainer process polls the flag at a step-aligned cadence
+   (PREEMPT_CHECK_STEPS) and multi-process worlds OR the sightings via
+   a tiny allgather, so ALL processes agree on the SAME step — the
+   checkpoint save is collective and must be step-aligned;
+3. trainers save (state + data-checkpoint spans) at that step and exit
+   ``PREEMPT_EXIT_CODE``;
+4. the signalled launcher exits DESCALED (clean departure); survivors
+   take the normal stop-resume path and resume from the
+   preemption-point checkpoint — no span reprocessed.
+
+The flag is STAGE-scoped: a rebuilt cluster (new stage) never sees a
+stale preemption.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.cluster import heartbeat
+
+
+def flag_preempt(store, job_id: str, stage: str, pod_id: str) -> float:
+    """Record 'pod ``pod_id`` is being preempted at stage ``stage``'."""
+    return heartbeat.write_stage_flag(store, job_id, "preempt", stage,
+                                      pod_id)
+
+
+def get_preempt(store, job_id: str, stage: str) -> float | None:
+    """Timestamp of the pending preemption for this stage, or None."""
+    return heartbeat.read_stage_flag(store, job_id, "preempt", stage)

@@ -93,12 +93,16 @@ class ClusterGenerator(threading.Thread):
         alive = [p for p in current.pods
                  if p.pod_id in resource and statuses.get(p.pod_id) != Status.FAILED]
         gone = [p for p in current.pods if p.pod_id not in {a.pod_id for a in alive}]
-        # a pod that left after SUCCEEDing (job completion) or DESCALED
-        # (controller scale-in) is not a failure — rebuilding would
-        # pointlessly restart the survivors
-        lost = any(statuses.get(p.pod_id) not in (Status.SUCCEED,
-                                                  Status.DESCALED)
-                   for p in gone)
+        # a MEMBER that left after SUCCEEDing (job completion) is not a
+        # membership change — rebuilding would pointlessly restart the
+        # survivors while they finish.  A member gone with any other
+        # status — including DESCALED — requires a rebuild: a preempted
+        # pod departs DESCALED while still a member, and the survivors
+        # wait on the shrunk cluster to stop-resume.  (Controller
+        # scale-in never hits this: the cap rebuild removes the pod
+        # from the cluster BEFORE it exits DESCALED, so it is not in
+        # ``gone``.)
+        lost = any(statuses.get(p.pod_id) != Status.SUCCEED for p in gone)
 
         # only *members'* SUCCEED blocks scale-out (job is finishing); a
         # stale unleased SUCCEED left by a previous run of this job_id is

@@ -100,8 +100,21 @@ def run(argv: list[str] | None = None) -> int:
                       find_free_ports(job_env.nproc_per_node))
     logger.info("pod %s on %s launching job %s", pod.pod_id, pod.addr, job_env.job_id)
 
-    final = Launcher(job_env, pod, store, args.training_script,
-                     args.script_args).launch()
+    launcher = Launcher(job_env, pod, store, args.training_script,
+                        args.script_args)
+    # TPU pods are preempted with SIGTERM + grace: trap it so trainers
+    # checkpoint at an agreed step and this pod departs DESCALED while
+    # peers resize — instead of looking like a crash and losing up to a
+    # full checkpoint interval (cluster/preempt.py).  Handler is
+    # signal-safe: it only sets an event the supervisor loop acts on.
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda *_: launcher.request_preempt())
+    except ValueError:  # pragma: no cover - non-main-thread embedding
+        logger.warning("not main thread; SIGTERM preemption grace disabled")
+    final = launcher.launch()
     logger.info("pod %s finished with %s", pod.pod_id, final.value)
     # DESCALED = scaled out by the controller: a clean departure (the
     # job continues on the remaining pods), not a failure

@@ -59,6 +59,18 @@ class Launcher:
         self._procs: list[train_process.TrainerProc] = []
         self._hang_incident: float | None = None
         self._hang_counts: dict[str, int] = {}  # stage -> incidents seen
+        import threading
+        self._preempt_event = threading.Event()
+        self._preempt_stage: str | None = None  # stage the flag was written for
+        self._preempt_deadline: float | None = None
+
+    def request_preempt(self) -> None:
+        """SIGTERM entry (signal-handler safe: just sets a flag).  The
+        supervisor loop writes the stage's preempt flag; trainers
+        checkpoint at an agreed step and exit PREEMPT_EXIT_CODE; this
+        pod then departs DESCALED and peers stop-resume from the
+        preemption-point checkpoint (cluster/preempt.py)."""
+        self._preempt_event.set()
 
     # -- lifecycle -----------------------------------------------------------
     def launch(self) -> Status:
@@ -174,6 +186,7 @@ class Launcher:
         takes the stop-resume path together — see cluster/heartbeat.py.
         """
         fail_deadline = None
+        peer_preempted_at: float | None = None
         # incidents at/before this timestamp are already handled (e.g.
         # the one that caused this very supervise loop to start);
         # None = unknown (read failed) — adopt the first value SEEN as
@@ -190,9 +203,63 @@ class Launcher:
                 logger.exception("hang-flag read failed")
                 hang_baseline = None
         while True:
+            if (cluster is not None and self._preempt_event.is_set()
+                    and self._preempt_stage != cluster.stage):
+                # (re)flag for THIS stage — a resize between SIGTERM and
+                # here would otherwise leave the flag on a stage no
+                # trainer reads anymore
+                if self._preempt_deadline is None:
+                    self._preempt_deadline = (time.monotonic()
+                                              + constants.PREEMPT_GRACE)
+                logger.warning("SIGTERM: flagging preemption for stage %s",
+                               cluster.stage[:8])
+                from edl_tpu.cluster import preempt
+                try:
+                    preempt.flag_preempt(self._store, self._job_env.job_id,
+                                         cluster.stage, self._pod.pod_id)
+                    # only a SUCCESSFUL write arms the guard: a store
+                    # blip retries on the next poll instead of silently
+                    # downgrading to the lossy grace-deadline path
+                    self._preempt_stage = cluster.stage
+                except Exception:  # noqa: BLE001 — retried next poll
+                    logger.exception("preempt flag write failed; retrying")
             local = train_process.watch_procs(self._procs)
             if local == Status.SUCCEED:
                 return Status.SUCCEED
+            if local == Status.DESCALED:
+                # the world took the preemption-point checkpoint and
+                # departed together: the signalled pod leaves cleanly;
+                # everyone else WAITS for the membership change before
+                # stop-resuming — re-barriering at the unchanged stage
+                # would respawn trainers against a cluster that still
+                # lists the departing pod, and the new world hangs in
+                # jax.distributed init until its 120 s register timeout
+                if self._preempt_event.is_set():
+                    logger.info("preemption checkpoint complete; departing")
+                    return Status.DESCALED
+                if peer_preempted_at is None:
+                    peer_preempted_at = time.monotonic()
+                    logger.info("peer preempted; waiting for the shrunk "
+                                "cluster before stop-resume")
+                    # the preempted trainers are gone: their last beat
+                    # must not ripen into a "hang" while we wait
+                    self._clear_heartbeat()
+                elif time.monotonic() - peer_preempted_at > 60:
+                    # never re-barrier early: the unchanged stage would
+                    # respawn a world that still lists the departed pod.
+                    # A long wait is legitimate (leader failover, or a
+                    # min_nodes cluster waiting for a replacement pod) —
+                    # keep waiting, loudly.
+                    peer_preempted_at = time.monotonic()
+                    logger.warning("still waiting for a membership change "
+                                   "after peer preemption (leader failover "
+                                   "or min_nodes wait?)")
+            if (self._preempt_deadline is not None
+                    and time.monotonic() >= self._preempt_deadline
+                    and self._preempt_event.is_set()):
+                logger.warning("preempt grace expired; departing with the "
+                               "last periodic checkpoint")
+                return Status.DESCALED
             if self._resource_register.is_stopped or self._elector.is_stopped:
                 logger.error("registration lost; failing pod")
                 return Status.FAILED

@@ -101,16 +101,30 @@ def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
 
 def watch_procs(procs: list[TrainerProc]) -> Status:
     """RUNNING while any child lives; FAILED on first nonzero exit;
-    SUCCEED when all exited zero (reference train_process.py:130-175)."""
+    SUCCEED when all exited zero (reference train_process.py:130-175).
+    DESCALED when the world exits with PREEMPT_EXIT_CODE — the
+    coordinated preemption-point-checkpoint departure, neither success
+    nor crash (cluster/preempt.py)."""
+    from edl_tpu.utils import constants
+
     alive = False
+    preempted = False
     for tp in procs:
         ret = tp.proc.poll()
         if ret is None:
             alive = True
+        elif ret == constants.PREEMPT_EXIT_CODE:
+            preempted = True
         elif ret != 0:
             logger.error("trainer rank %d exited with %d; tail of %s:\n%s",
                          tp.global_rank, ret, tp.log_path, _tail(tp.log_path))
             return Status.FAILED
+    if not alive and preempted:
+        for tp in procs:
+            if tp.tail is not None:
+                tp.tail.stop()
+                tp.tail = None
+        return Status.DESCALED
     if not alive:
         # stop tails with their final drain NOW: on the success path the
         # launcher may exit without terminate_procs finishing the tail

@@ -19,6 +19,7 @@ records in etcd.  ElasticTrainer packages that contract TPU-natively:
 
 from __future__ import annotations
 
+import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -245,6 +246,7 @@ class ElasticTrainer:
                 self._report_recovery(metrics)
             self._heartbeat()
             step = start_step + n_steps
+            self._maybe_preempt(state, meta, step)
             if self.cfg.log_every and step % self.cfg.log_every == 0:
                 logger.info("epoch %d step %d: %s", epoch, step,
                             {k: float(v) for k, v in metrics.items()})
@@ -450,6 +452,73 @@ class ElasticTrainer:
                            threshold=threshold)
         except Exception:  # noqa: BLE001 — liveness must never fail a job
             logger.exception("heartbeat write failed")
+
+    _preempt_seen = False
+
+    def _maybe_preempt(self, state, meta, step: int) -> None:
+        """SIGTERM-preemption grace (cluster/preempt.py): at a
+        step-aligned cadence, check the stage's preempt flag; in a
+        multi-process world OR the sightings via a tiny allgather so
+        EVERY process picks the SAME step (the save is collective).
+        On agreement: checkpoint (state + data spans) at this exact
+        step and exit PREEMPT_EXIT_CODE — the launcher reads that as a
+        clean coordinated departure, survivors resume from this
+        checkpoint with no span reprocessed."""
+        from edl_tpu.utils import constants as _c
+        if (self.store is None or self.tenv is None or not self.tenv.pod_id
+                or not self.tenv.cluster_stage
+                or step % max(1, _c.PREEMPT_CHECK_STEPS)):
+            return
+        # only rank-0-in-pod reads the store (the _heartbeat convention
+        # — N identical reads per pod would be pure traffic); the
+        # allgather below fans a single sighting out to every process
+        if not self._preempt_seen and self.tenv.rank_in_pod == 0:
+            from edl_tpu.cluster import preempt
+            try:
+                self._preempt_seen = preempt.get_preempt(
+                    self.store, self.tenv.job_id,
+                    self.tenv.cluster_stage) is not None
+            except Exception:  # noqa: BLE001 — a store blip is not a preempt
+                logger.exception("preempt flag read failed")
+        agreed = self._preempt_seen
+        if jax.process_count() > 1:
+            from edl_tpu.parallel.sharding import allgather_flag
+            agreed = bool(allgather_flag(int(self._preempt_seen)).sum())
+        if not agreed:
+            return
+        logger.warning("preemption flagged: checkpointing at step %d and "
+                       "exiting %d", step, _c.PREEMPT_EXIT_CODE)
+        if self.ckpt is not None:
+            meta.step = step
+            self._sync_data_checkpoint(meta)
+            self.ckpt.save(step, state, meta, force=True)
+            self.ckpt.wait()
+            logger.info("preempt: checkpoint committed at step %d", step)
+        if jax.process_count() > 1:
+            # every process's save must COMMIT before any process
+            # leaves: the first abrupt exit trips the coordination
+            # service's death-watch, which fatals the peers mid-save
+            # (observed: the coordinator-hosting rank killed with exit
+            # 1 while its shards were still writing)
+            from edl_tpu.parallel.sharding import allgather_flag
+            allgather_flag(1)
+        # os._exit, NOT SystemExit: normal teardown runs jax's atexit
+        # distributed shutdown, whose barrier hangs the coordinator-
+        # hosting rank once a peer (exiting by the same agreement, a
+        # beat earlier) has already disconnected — observed as a 2-min
+        # DEADLINE_EXCEEDED fatal that overwrote the exit code.  The
+        # whole world exits here together; there is nothing left to
+        # coordinate, only buffers to flush.
+        import logging as _logging
+        import sys as _sys
+        for h in _logging.getLogger().handlers:
+            try:
+                h.flush()
+            except Exception:  # noqa: BLE001
+                pass
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+        os._exit(_c.PREEMPT_EXIT_CODE)
 
     def _sync_data_checkpoint(self, meta: State) -> None:
         """Before every save, merge all processes' consumed data spans —

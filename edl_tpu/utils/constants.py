@@ -79,3 +79,17 @@ HANG_TIMEOUT = _f("EDL_TPU_HANG_TIMEOUT", 0.0)
 # max in-place trainer restarts per cluster stage before the pod gives
 # up and fails (a trainer that hangs every time is not going to recover)
 HANG_MAX_RESTARTS = int(_f("EDL_TPU_HANG_MAX_RESTARTS", 3))
+
+# -- SIGTERM preemption grace (cluster/preempt.py) -----------------------
+# exit code trainers use after a preemption-point checkpoint: tells the
+# launcher "clean coordinated departure", not success and not a crash
+PREEMPT_EXIT_CODE = 94
+# trainers poll the preempt flag (and, multi-process, OR the sightings
+# via allgather so the save step is agreed) every this many steps —
+# bounds preemption latency at K steps while keeping the per-step loop
+# collective-free
+PREEMPT_CHECK_STEPS = int(_f("EDL_TPU_PREEMPT_CHECK_STEPS", 8))
+# how long the signalled launcher waits for its trainers to finish the
+# preemption-point checkpoint before giving up and departing with
+# whatever the last periodic checkpoint was
+PREEMPT_GRACE = _f("EDL_TPU_PREEMPT_GRACE", 120.0)

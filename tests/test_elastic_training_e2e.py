@@ -46,6 +46,66 @@ def spawn(job_id, coord_ep, tmp, name, ckpt_dir, extra_env=None,
 
 
 @pytest.mark.slow
+def test_sigterm_preemption_checkpoint(coord_server, tmp_path):
+    """SIGTERM a 2-pod world mid-run: the signalled pod's launcher
+    flags preemption, BOTH trainers checkpoint at an agreed step and
+    exit PREEMPT_EXIT_CODE, the signalled pod departs DESCALED (exit
+    0), and the survivor stop-resumes SOLO from the preemption-point
+    checkpoint — epochs complete exactly once (VERDICT r4 #8)."""
+    import signal as _signal
+
+    ep = f"127.0.0.1:{coord_server.port}"
+    ckpt = str(tmp_path / "ckpt")
+    env = {"EDL_TPU_PREEMPT_CHECK_STEPS": "2"}
+    pa = spawn("preempt-e2e", ep, str(tmp_path), "a", ckpt, extra_env=env,
+               epochs="8", steps="4")
+    pb = spawn("preempt-e2e", ep, str(tmp_path), "b", ckpt, extra_env=env,
+               epochs="8", steps="4")
+    # wait for the 2-pod world to commit its first epoch checkpoint
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        done = [d for d in (os.listdir(ckpt) if os.path.isdir(ckpt) else [])
+                if d.isdigit()]
+        if done:
+            break
+        assert pa.poll() is None and pb.poll() is None, "pod died in warmup"
+        time.sleep(0.25)
+    else:
+        raise AssertionError("no checkpoint committed before preemption")
+
+    pb.send_signal(_signal.SIGTERM)
+    assert finish(pb, 240) == 0, "preempted pod must exit cleanly (DESCALED)"
+    assert finish(pa, 300) == 0
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "preempt-e2e") == Status.SUCCEED
+    client.close()
+
+    lb = (tmp_path / "launcher-b.log").read_bytes().decode(errors="replace")
+    assert "flagging preemption" in lb, lb[-2000:]
+    assert "preemption checkpoint complete; departing" in lb, lb[-2000:]
+    # both worlds' trainers took the coordinated preemption checkpoint
+    m = re.search(r"preemption flagged: checkpointing at step (\d+)", lb)
+    assert m, lb[-3000:]
+    preempt_step = int(m.group(1))
+    la = (tmp_path / "launcher-a.log").read_bytes().decode(errors="replace")
+    assert "peer preempted; waiting for the shrunk cluster" in la, la[-2000:]
+    # the survivor's restarted trainer resumed from the preemption-point
+    # checkpoint: its resume epoch is the epoch the preempt step sat in
+    # (4 steps/epoch; later epoch checkpoints GC the step dir itself)
+    resumes = [int(x) for x in re.findall(r"resume_epoch=(\d+)", la)]
+    assert len(resumes) >= 2, la[-2000:]
+    assert resumes[1] == preempt_step // 4, (resumes, preempt_step)
+    # the survivor finished the full epoch set exactly once, world=1
+    marker_a = (tmp_path / "marker-a").read_text()
+    done_lines = [l for l in marker_a.splitlines() if l.startswith("done")]
+    assert done_lines, marker_a
+    m = re.search(r"world=(\d+) epochs=\[([0-9, ]+)\]", done_lines[-1])
+    assert m and m.group(1) == "1", marker_a
+    assert [int(x) for x in m.group(2).split(",")] == list(range(8))
+
+
+@pytest.mark.slow
 def test_elastic_join_resumes_training(coord_server, tmp_path):
     ep = f"127.0.0.1:{coord_server.port}"
     ckpt = str(tmp_path / "ckpt")

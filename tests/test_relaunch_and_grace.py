@@ -83,6 +83,10 @@ def test_supervise_grace_turns_peer_crash_into_resize(monkeypatch):
     lch._procs = []
     lch._period = 0.02
     lch._ttl = 0.2
+    import threading as _t
+    lch._preempt_event = _t.Event()
+    lch._preempt_stage = None
+    lch._preempt_deadline = None
 
     class _Alive:
         is_stopped = False
