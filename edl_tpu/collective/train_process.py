@@ -119,21 +119,16 @@ def watch_procs(procs: list[TrainerProc]) -> Status:
             logger.error("trainer rank %d exited with %d; tail of %s:\n%s",
                          tp.global_rank, ret, tp.log_path, _tail(tp.log_path))
             return Status.FAILED
-    if not alive and preempted:
-        for tp in procs:
-            if tp.tail is not None:
-                tp.tail.stop()
-                tp.tail = None
-        return Status.DESCALED
-    if not alive:
-        # stop tails with their final drain NOW: on the success path the
-        # launcher may exit without terminate_procs finishing the tail
-        # thread, losing rank 0's last log lines (advisor r2)
-        for tp in procs:
-            if tp.tail is not None:
-                tp.tail.stop()
-                tp.tail = None
-    return Status.RUNNING if alive else Status.SUCCEED
+    if alive:
+        return Status.RUNNING
+    # stop tails with their final drain NOW: on the terminal paths the
+    # launcher may exit without terminate_procs finishing the tail
+    # thread, losing rank 0's last log lines (advisor r2)
+    for tp in procs:
+        if tp.tail is not None:
+            tp.tail.stop()
+            tp.tail = None
+    return Status.DESCALED if preempted else Status.SUCCEED
 
 
 def terminate_procs(procs: list[TrainerProc], grace: float = 3.0) -> None:

@@ -18,8 +18,10 @@ import threading
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="EDL-TPU elastic controller")
     p.add_argument("--coord_endpoints", required=True)
-    p.add_argument("--capacity", type=int, required=True,
-                   help="schedulable pod slots across the cluster")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="schedulable pod slots across the cluster; "
+                        "0 (default) = observe: track the high-water "
+                        "mark of concurrently live pod adverts")
     p.add_argument("--max_load_desired", type=float, default=0.9,
                    help="fill the cluster to at most this fraction "
                         "(reference edl_controller.yaml:21)")
@@ -29,6 +31,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--period", type=float, default=5.0)
     p.add_argument("--cooldown", type=float, default=30.0,
                    help="min seconds between resizes per job")
+    p.add_argument("--cooldown_per_resize_s", type=float, default=10.0,
+                   help="scale each job's cooldown by this x its last "
+                        "measured stop-resume cost (recovery records)")
     p.add_argument("--k8s_namespace", default="",
                    help="when set, also `kubectl scale` the job's "
                         "StatefulSet in this namespace")
@@ -49,7 +54,8 @@ def run(argv=None) -> int:
     ctl = Controller(connect(args.coord_endpoints), capacity=args.capacity,
                      max_load_desired=args.max_load_desired,
                      job_ids=args.job_id, actuator=actuator,
-                     period=args.period, cooldown=args.cooldown)
+                     period=args.period, cooldown=args.cooldown,
+                     cooldown_per_resize_s=args.cooldown_per_resize_s)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -10,14 +10,20 @@ so the policy is unit-testable against fabricated job views.
 Rules (reference behavior + the repo's own scaling gates):
 
 - budget = floor(capacity * max_load_desired), at least one pod;
-- fair share: each active job gets budget // n_jobs, remainder to the
-  earliest jobs (stable by job_id) — the reference's fragment-avoiding
-  fair division;
+- fair share: each active job gets budget // n_jobs, remainder first
+  to jobs with PENDING pods (a registered-but-unplaced replica means
+  the infra already scheduled the hardware — growing that job is a
+  free join, no actuator round-trip), then earliest by job_id — the
+  reference's fragment-avoiding fair division, load-informed;
 - clamp to [min_nodes, max_nodes] per job;
 - a job whose train status is not scalable (NEARTHEEND — the
   anti-meaningless-scaling rule, train_status.py) keeps its current
   size;
 - never scale a terminal (SUCCEED/FAILED) job — it leaves the view.
+
+The policy stays PURE: every observed signal (live pod counts, pending
+replicas, measured resize cost) arrives in the JobView / arguments;
+the controller does the observing.
 """
 
 from __future__ import annotations
@@ -34,6 +40,13 @@ class JobView:
     max_nodes: int
     current_nodes: int
     scalable: bool = True     # train status INITIAL/RUNNING (SCALABLE set)
+    # live resource adverts not (yet) in the cluster: replicas the
+    # infra scheduled that the desired record hasn't admitted
+    pending_pods: int = 0
+    # last measured stop-resume cost in seconds (recovery records);
+    # 0 = never measured.  The controller scales each job's resize
+    # cooldown with this, so expensive-to-resize jobs flap less.
+    resize_cost_s: float = 0.0
 
 
 def compute_desired(jobs: list[JobView], capacity: int,
@@ -57,7 +70,13 @@ def compute_desired(jobs: list[JobView], capacity: int,
     if not flexible:
         return out
     base, rem = divmod(max(0, budget), len(flexible))
+    # remainder pods go first to jobs that already have a pending
+    # replica registered (free join: the hardware is up and waiting),
+    # then earliest job_id; stable within each class
+    order = sorted(range(len(flexible)),
+                   key=lambda i: (0 if flexible[i].pending_pods > 0 else 1, i))
+    gets_extra = set(order[:rem])
     for i, job in enumerate(flexible):
-        share = base + (1 if i < rem else 0)
+        share = base + (1 if i in gets_extra else 0)
         out[job.job_id] = max(job.min_nodes, min(job.max_nodes, share))
     return out

@@ -465,14 +465,19 @@ class ElasticTrainer:
         clean coordinated departure, survivors resume from this
         checkpoint with no span reprocessed."""
         from edl_tpu.utils import constants as _c
-        if (self.store is None or self.tenv is None or not self.tenv.pod_id
+        # participation is decided from ENV facts only (identical for
+        # every process the launcher spawned): a process whose store
+        # connect failed must still enter the allgather below with
+        # seen=0, or the world's collectives mismatch and hang
+        if (self.tenv is None or not self.tenv.pod_id
                 or not self.tenv.cluster_stage
                 or step % max(1, _c.PREEMPT_CHECK_STEPS)):
             return
         # only rank-0-in-pod reads the store (the _heartbeat convention
         # — N identical reads per pod would be pure traffic); the
         # allgather below fans a single sighting out to every process
-        if not self._preempt_seen and self.tenv.rank_in_pod == 0:
+        if (not self._preempt_seen and self.store is not None
+                and self.tenv.rank_in_pod == 0):
             from edl_tpu.cluster import preempt
             try:
                 self._preempt_seen = preempt.get_preempt(

@@ -299,3 +299,71 @@ def test_controller_scales_in_live_job(coord_server, tmp_path):
             if proc.poll() is None:
                 proc.kill()
         client.close()
+
+
+# -- observed (metrics-driven) controller inputs ------------------------------
+def test_policy_remainder_prefers_pending_pods():
+    """A job with a registered-but-unplaced replica gets the remainder
+    pod first: the hardware is up and joining is free."""
+    jobs = [JobView("a", 1, 8, 2), JobView("b", 1, 8, 2, pending_pods=1)]
+    out = compute_desired(jobs, capacity=5, max_load_desired=1.0)
+    assert out == {"a": 2, "b": 3}
+    # without the pending signal, earliest job_id wins as before
+    jobs = [JobView("a", 1, 8, 2), JobView("b", 1, 8, 2)]
+    assert compute_desired(jobs, 5, 1.0) == {"a": 3, "b": 2}
+
+
+def test_controller_observes_capacity_from_live_pods(memkv):
+    """capacity=0 = observe: the budget tracks the high-water mark of
+    live adverts (members + pending) instead of a typed constant."""
+    pods = [make_pod(f"10.3.0.{i}") for i in range(2)]
+    _publish_job(memkv, "j3", pods, 1, 8)
+    for p in pods:
+        register_pod(memkv, "j3", p, ttl=5.0)
+    # one extra live advert NOT in the cluster: a pending replica
+    extra = make_pod("10.3.0.9")
+    register_pod(memkv, "j3", extra, ttl=5.0)
+    act = FakeActuator()
+    # default max_load_desired: observe mode must IGNORE the trim — the
+    # mark is demonstrated usage, and 0.9x it would evict healthy pods
+    ctl = Controller(memkv, capacity=0, actuator=act, cooldown=0.0)
+    view = ctl.job_view("j3")
+    assert view.pending_pods == 1
+    acted = ctl.reconcile_once()
+    # observed capacity = 2 members + 1 pending = 3: admit the pending
+    assert acted == {"j3": 3}
+    assert ctl._capacity_observed == 3
+    # converged at the mark -> no shrink, no flapping
+    _put_cluster(memkv, "j3", pods + [extra])
+    assert ctl.reconcile_once() == {}
+    # the high-water mark survives adverts expiring (capacity is the
+    # infra's demonstrated size, not the instantaneous liveness)
+    ctl._capacity_observed = 5
+    assert ctl._effective_capacity([view]) == 5
+
+
+def test_controller_cooldown_scales_with_resize_cost(memkv):
+    """A job whose last stop-resume took 12 s gets a 120 s effective
+    cooldown (10 x) even with a 30 s base."""
+    import json as _json
+
+    from edl_tpu.cluster import paths as _paths
+    pods = [make_pod("10.4.0.1")]
+    _publish_job(memkv, "j4", pods, 1, 8)
+    # fabricate a complete recovery record (launcher + trainer halves)
+    stage = "s1"
+    memkv.put(_paths.key("j4", constants.ETCD_RECOVERY,
+                         f"{stage}/launcher/p1"),
+              _json.dumps({"detect": 100.0, "killed": 101.0,
+                           "barrier": 104.0, "spawn": 105.0}).encode())
+    memkv.put(_paths.key("j4", constants.ETCD_RECOVERY,
+                         f"{stage}/trainer/p1"),
+              _json.dumps({"restored": 110.0,
+                           "first_step": 112.0}).encode())
+    ctl = Controller(memkv, capacity=4, cooldown=30.0,
+                     cooldown_per_resize_s=10.0)
+    view = ctl.job_view("j4")
+    assert view.resize_cost_s == 12.0
+    assert ctl._effective_cooldown(view) == 120.0
+    # an unmeasured job keeps the base cooldown
+    assert ctl._effective_cooldown(JobView("x", 1, 2, 1)) == 30.0
