@@ -227,8 +227,11 @@ def main() -> None:
     if args.pp > 1:
         if args.sp > 1 or args.attention == "ring":
             raise SystemExit("--pp cannot combine with --sp/--attention "
-                             "ring (ring's shard_map cannot nest inside "
-                             "the pipeline's); use auto/dense/flash")
+                             "ring: ring applies its own shard_map over "
+                             "sp and nesting it inside the pipeline's "
+                             "manual-over-pp shard_map fails jax's nested "
+                             "axis checks (measured attempt in doc/perf.md "
+                             "'Pipeline schedule'); use auto/dense/flash")
         if args.layers % args.pp:
             raise SystemExit(f"--layers {args.layers} must divide evenly "
                              f"over --pp {args.pp} stages")
