@@ -245,3 +245,18 @@ def test_hang_flag_roundtrip(memkv):
     t2 = heartbeat.flag_hang(memkv, "j", "s1", "podB")   # overwrite wins
     assert t2 >= t1
     assert heartbeat.get_hang(memkv, "j", "s1") == t2
+
+
+def test_preempt_flag_roundtrip(memkv):
+    """Stage-scoped preemption flag (cluster/preempt.py) shares the
+    hang flag's machinery but its own namespace — the two must never
+    read each other's incidents."""
+    from edl_tpu.cluster import preempt
+
+    assert preempt.get_preempt(memkv, "j", "s1") is None
+    t = preempt.flag_preempt(memkv, "j", "s1", "podA")
+    assert preempt.get_preempt(memkv, "j", "s1") == t
+    assert preempt.get_preempt(memkv, "j", "s2") is None   # per-stage
+    assert heartbeat.get_hang(memkv, "j", "s1") is None    # namespaced
+    heartbeat.flag_hang(memkv, "j", "s1", "podA")
+    assert preempt.get_preempt(memkv, "j", "s1") == t      # unaffected
