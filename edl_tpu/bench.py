@@ -461,11 +461,20 @@ def _bench_engine(cfg, params) -> dict:
     new = int(os.environ.get("EDL_TPU_BENCH_ENGINE_NEW",
                              max(1, min(128, cfg.max_len // 4))))
     n_req = int(os.environ.get("EDL_TPU_BENCH_ENGINE_REQS", 3 * 64))
+    # decode-chunk length: the host syncs once per chunk, and through a
+    # high-RTT link the sync cadence IS the serving floor (A/B on the
+    # tunneled v5e: 16 -> 32 steps/sync took 192x128-token streaming
+    # from ~1.0-1.5k to ~4.1-4.4k tok/s).  A finished slot wastes at
+    # most sync-1 lane-steps: new/4 bounds that at ~25% for the default
+    # new=128; short smoke configs hit the floor of 8 and waste more —
+    # their numbers are lower bounds, not comparable across configs
+    sync = int(os.environ.get("EDL_TPU_BENCH_ENGINE_SYNC",
+                              max(8, min(32, new // 4))))
     rng = np.random.default_rng(11)
     prompts = [rng.integers(1, cfg.vocab_size, (plen,)).astype(np.int32)
                for _ in range(n_req)]
     eng = ContinuousBatcher(cfg, params, slots=slots, temperature=0.8,
-                            top_k=40, steps_per_sync=16,
+                            top_k=40, steps_per_sync=sync,
                             max_len=min(cfg.max_len, 2 * plen + new))
     try:
         # deterministic warm-up (engine.warm): the step plus the
@@ -485,6 +494,7 @@ def _bench_engine(cfg, params) -> dict:
         "engine_tokens_s": round(total / dt, 1),
         "engine_slots": slots,
         "engine_requests": n_req,
+        "engine_steps_per_sync": sync,
         "engine_slot_utilization": stats["slot_utilization"],
         "engine_prefill_stall_s": stats["prefill_stall_s"],
     }
