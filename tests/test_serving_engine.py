@@ -308,6 +308,24 @@ def test_mixed_load_decode_not_starved(small):
         f"busy {busy:.2f}s")
 
 
+def test_warm_then_serve(small):
+    """warm() pre-compiles the step + every prefill/insert sub-batch
+    without touching live state: the engine must serve identically
+    afterwards (greedy parity), and the ladder must scale with slots."""
+    cfg, params = small
+    eng = _engine(cfg, params, slots=3)
+    try:
+        assert eng.PREFILL_KS == (2, 1)   # ladder filtered by slots
+        eng.warm(7)
+        p = np.random.default_rng(21).integers(1, 97, (7,)).astype(np.int32)
+        out = eng.generate(p, 5, timeout=120)
+    finally:
+        eng.stop()
+    want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), 5,
+                               temperature=0.0))[0]
+    np.testing.assert_array_equal(out, want)
+
+
 def test_stop_fails_pending(small):
     cfg, params = small
     eng = _engine(cfg, params, slots=1)
