@@ -190,3 +190,31 @@ def test_evaluate_uneven_batches_two_processes(tmp_path):
     expected = sum(vals) / len(vals)
     for r in results:
         assert abs(r["mean_x"] - expected) < 1e-3, (results, expected)
+
+
+def test_maybe_preempt_unit(memkv, monkeypatch):
+    """Preempt check in isolation: flag set -> the trainer exits with
+    PREEMPT_EXIT_CODE at the next aligned step; no flag -> no-op; an
+    unaligned step never reads the store."""
+    from edl_tpu.cluster import preempt
+    from edl_tpu.cluster.env import TrainerEnv
+    from edl_tpu.utils import constants
+
+    monkeypatch.setenv("EDL_TPU_JOB_ID", "pj")
+    monkeypatch.setenv("EDL_TPU_POD_ID", "pod1")
+    monkeypatch.setenv("EDL_TPU_CLUSTER_STAGE", "stg")
+    tenv = TrainerEnv()
+    tr = ElasticTrainer(lambda *a: None, TrainConfig(log_every=0),
+                        store=memkv, tenv=tenv)
+    exits = []
+    monkeypatch.setattr("os._exit", lambda code: exits.append(code))
+
+    K = constants.PREEMPT_CHECK_STEPS
+    tr._maybe_preempt(None, None, K + 1)     # unaligned: no-op
+    tr._maybe_preempt(None, None, K)         # aligned, no flag: no-op
+    assert exits == []
+    preempt.flag_preempt(memkv, "pj", "stg", "pod2")
+    tr._maybe_preempt(None, None, K + 1)     # still unaligned: no read
+    assert exits == []
+    tr._maybe_preempt(None, None, 2 * K)     # aligned + flagged: exit
+    assert exits == [constants.PREEMPT_EXIT_CODE]
