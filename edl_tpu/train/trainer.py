@@ -15,6 +15,14 @@ records in etcd.  ElasticTrainer packages that contract TPU-natively:
 - train-status reporting (RUNNING / NEARTHEEND) to the coordination
   store so the cluster generator won't scale near job end
   (cluster_generator.py:200-215).
+
+Mid-epoch saves (``save_every_steps``, SIGTERM preemption) re-enter
+the in-progress epoch on resume.  Exactly-once record delivery across
+that re-entry requires a SPAN-AWARE reader (the data service /
+ElasticInput: consumed spans ride the checkpoint and are skipped);
+a plain generator ``data_fn`` re-yields the epoch from its start —
+at-least-once, the reference's per-epoch granularity.  Epoch-boundary
+checkpoints are always exact for both.
 """
 
 from __future__ import annotations
