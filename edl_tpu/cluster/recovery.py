@@ -14,7 +14,62 @@ from __future__ import annotations
 import json
 
 from edl_tpu.cluster import paths
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
+
+# phase name -> (begin timestamp key, end timestamp key), per record half.
+# summarize_recovery, the per-phase histogram, and the trace events are
+# all derived from these tables and the same ``times`` dicts, so the
+# store record and the trace agree by construction.
+LAUNCHER_PHASES = (
+    ("detect_to_kill", "detect", "killed"),
+    ("kill_to_barrier", "killed", "barrier"),
+    ("barrier_to_spawn", "barrier", "spawn"),
+)
+TRAINER_PHASES = (
+    ("restored_to_first_step", "restored", "first_step"),
+)
+
+RESIZE_PHASE_SECONDS = obs_metrics.histogram(
+    "edl_resize_phase_seconds",
+    "Elastic resize phase duration in seconds, by phase",
+    ("phase",), buckets=obs_metrics.RESIZE_BUCKETS)
+
+
+def _observe_phases(stage: str, times: dict, phases) -> None:
+    tracer = obs_trace.get_tracer()
+    for phase, begin, end in phases:
+        if begin in times and end in times:
+            dur = times[end] - times[begin]
+            RESIZE_PHASE_SECONDS.labels(phase=phase).observe(dur)
+            tracer.emit(f"resize/{phase}", at=times[begin], dur=dur,
+                        stage=stage)
+
+
+def write_launcher_half(store, job_id: str, stage: str, pod_id: str,
+                        times: dict) -> None:
+    """Launcher half of a resize record (detect/killed/barrier/spawn
+    wall-clock timestamps): one write drives the store record (merged
+    back by :func:`summarize_recovery`), the resize-phase histogram,
+    and the JSONL trace events."""
+    store.put(
+        paths.key(job_id, constants.ETCD_RECOVERY,
+                  f"{stage}/launcher/{pod_id}"),
+        json.dumps(times).encode())
+    _observe_phases(stage, times, LAUNCHER_PHASES)
+
+
+def write_trainer_half(store, job_id: str, stage: str, pod_id: str,
+                       restored: float, first_step: float) -> None:
+    """Trainer half (checkpoint restored / first post-resize step) —
+    same unified write path as :func:`write_launcher_half`."""
+    times = {"restored": restored, "first_step": first_step}
+    store.put(
+        paths.key(job_id, constants.ETCD_RECOVERY,
+                  f"{stage}/trainer/{pod_id}"),
+        json.dumps(times).encode())
+    _observe_phases(stage, times, TRAINER_PHASES)
 
 
 def load_recovery_records(store, job_id: str) -> dict[str, dict]:

@@ -88,6 +88,8 @@ def run(argv: list[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
     job_env = JobEnv(args)
     configure(job_env.log_level)
+    from edl_tpu import obs
+    obs.install_from_env("launcher")  # /metrics + JSONL trace, env-gated
 
     store = connect(job_env.coord_endpoints)
     if load_job_status(store, job_env.job_id) == Status.SUCCEED:

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 
-from edl_tpu.cluster import heartbeat
+from edl_tpu.cluster import heartbeat, recovery
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.env import JobEnv
 from edl_tpu.cluster.pod import Pod
@@ -28,11 +28,18 @@ from edl_tpu.collective.leader import LeaderElector
 from edl_tpu.collective.pod_server import start_pod_server
 from edl_tpu.collective.watcher import ClusterWatcher
 from edl_tpu.data.data_server import DataService
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
 from edl_tpu.utils.exceptions import EdlDescaledError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+_RESIZES_TOTAL = obs_metrics.counter(
+    "edl_resizes_total", "Membership changes handled (stop-resume)")
+_HANG_RESTARTS_TOTAL = obs_metrics.counter(
+    "edl_hang_restarts_total", "Trainer hang-watchdog restart incidents")
 
 
 class Launcher:
@@ -140,7 +147,13 @@ class Launcher:
             # elastic recovery time is the framework's north-star metric
             # (BASELINE.md "not published: must be measured")
             logger.info("membership changed; re-barrier + restart trainers")
+            _RESIZES_TOTAL.inc()
             resize_times = {"detect": time.time()}
+            # tagged from_stage: the change is detected in the OLD stage;
+            # the per-phase events land under the post-barrier stage id
+            # (the stage the recovery record is keyed by)
+            obs_trace.emit("resize/detect", at=resize_times["detect"],
+                           from_stage=cluster.stage)
             if self._hang_incident is not None:
                 resize_times["_hang_suffix"] = \
                     f"+hang{int(self._hang_incident)}"
@@ -320,6 +333,8 @@ class Launcher:
         fail instead of restarting again."""
         n = self._hang_counts.get(stage, 0) + 1
         self._hang_counts[stage] = n
+        _HANG_RESTARTS_TOTAL.inc()
+        obs_trace.emit("launcher/hang_incident", stage=stage, count=n)
         if n > constants.HANG_MAX_RESTARTS:
             logger.error("trainers hung %d times at stage %s (%d restarts "
                          "attempted); failing pod", n, stage[:8],
@@ -399,15 +414,12 @@ class Launcher:
     def _write_recovery(self, stage: str, times: dict) -> None:
         """Launcher half of the resize timing record (the trainer adds
         restore/first-step under the same stage key — see
-        ElasticTrainer._report_recovery).  Best-effort."""
-        import json
-
-        from edl_tpu.cluster import paths
+        ElasticTrainer._report_recovery).  One unified write drives the
+        store record, the resize-phase histogram, and the trace events
+        (cluster/recovery.py).  Best-effort."""
         try:
-            self._store.put(
-                paths.key(self._job_env.job_id, constants.ETCD_RECOVERY,
-                          f"{stage}/launcher/{self._pod.pod_id}"),
-                json.dumps(times).encode())
+            recovery.write_launcher_half(self._store, self._job_env.job_id,
+                                         stage, self._pod.pod_id, times)
         except Exception:  # noqa: BLE001 — metrics must never fail a job
             logger.exception("recovery record write failed")
 

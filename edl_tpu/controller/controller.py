@@ -27,10 +27,24 @@ from edl_tpu.cluster.status import Status, load_job_status
 from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
 from edl_tpu.controller.actuator import NullActuator
 from edl_tpu.controller.policy import JobView, compute_desired
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+_DECISIONS_TOTAL = obs_metrics.counter(
+    "edl_controller_scale_decisions_total",
+    "Desired-size changes written, by job and direction",
+    ("job", "direction"))
+_DESIRED_NODES = obs_metrics.gauge(
+    "edl_controller_desired_nodes", "Last desired size written per job",
+    ("job",))
+_RESIZE_COST = obs_metrics.gauge(
+    "edl_controller_resize_cost_seconds",
+    "Last measured stop-resume cost per job (recovery records)",
+    ("job",))
 
 
 class Controller:
@@ -139,6 +153,7 @@ class Controller:
         except Exception:  # noqa: BLE001 — metrics must not stop scaling
             logger.exception("recovery records unreadable for %s", job_id)
         self._resize_cost_cache[job_id] = (now, cost)
+        _RESIZE_COST.labels(job=job_id).set(cost)
         return cost
 
     def _effective_cooldown(self, view: JobView) -> float:
@@ -197,6 +212,12 @@ class Controller:
             self._actuator.scale(v.job_id, want)
             self._last_change[v.job_id] = now
             acted[v.job_id] = want
+            direction = "up" if want > v.current_nodes else "down"
+            _DECISIONS_TOTAL.labels(job=v.job_id, direction=direction).inc()
+            _DESIRED_NODES.labels(job=v.job_id).set(want)
+            obs_trace.emit("controller/scale", job=v.job_id,
+                           from_nodes=v.current_nodes, to_nodes=want,
+                           resize_cost_s=v.resize_cost_s)
         return acted
 
     def _reap_finished(self, jobs: list[str]) -> None:

@@ -44,6 +44,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def run(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
 
+    from edl_tpu import obs
+    from edl_tpu.utils.logger import configure
+
+    configure()
+    obs.install_from_env("controller")  # /metrics + JSONL trace, env-gated
+
     from edl_tpu.controller.actuator import KubectlActuator, NullActuator
     from edl_tpu.controller.controller import Controller
     from edl_tpu.coord.client import connect

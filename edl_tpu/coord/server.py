@@ -12,12 +12,37 @@ backends (tests/test_coord.py), so either is a drop-in for production.
 from __future__ import annotations
 
 import argparse
+import functools
+import time
 
 from edl_tpu.coord.memory import MemoryKV
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils.logger import configure, get_logger
 
 logger = get_logger(__name__)
+
+_KV_OPS_TOTAL = obs_metrics.counter(
+    "edl_kv_ops_total", "Coordination KV ops served, by op", ("op",))
+_KV_OP_SECONDS = obs_metrics.histogram(
+    "edl_kv_op_seconds", "Coordination KV op service time (seconds); "
+    "`wait` blocks until an event or its timeout", ("op",))
+
+
+def _timed(fn):
+    """Count + time each KV op (op = wire method name)."""
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *a, **kw)
+        finally:
+            _KV_OPS_TOTAL.labels(op=op).inc()
+            _KV_OP_SECONDS.labels(op=op).observe(time.perf_counter() - t0)
+
+    return wrapper
 
 
 def _rec_to_wire(rec):
@@ -30,43 +55,55 @@ class CoordService:
     def __init__(self, kv: MemoryKV):
         self._kv = kv
 
+    @_timed
     def kv_put(self, key, value, lease_id=0):
         return {"rev": self._kv.put(key, value, lease_id)}
 
+    @_timed
     def kv_get(self, key):
         return {"rec": _rec_to_wire(self._kv.get(key))}
 
+    @_timed
     def kv_range(self, prefix):
         recs, rev = self._kv.get_prefix(prefix)
         return {"recs": [_rec_to_wire(r) for r in recs], "rev": rev}
 
+    @_timed
     def kv_del(self, key):
         return {"deleted": self._kv.delete(key)}
 
+    @_timed
     def kv_del_range(self, prefix):
         return {"n": self._kv.delete_prefix(prefix)}
 
+    @_timed
     def lease_grant(self, ttl):
         return {"lease_id": self._kv.lease_grant(ttl)}
 
+    @_timed
     def lease_keepalive(self, lease_id):
         return {"alive": self._kv.lease_keepalive(lease_id)}
 
+    @_timed
     def lease_revoke(self, lease_id):
         self._kv.lease_revoke(lease_id)
         return {}
 
+    @_timed
     def txn_put_if_absent(self, key, value, lease_id=0):
         return {"succeeded": self._kv.put_if_absent(key, value, lease_id)}
 
+    @_timed
     def txn_put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
         return {"succeeded": self._kv.put_if_equals(guard_key, guard_value, key, value, lease_id)}
 
+    @_timed
     def wait(self, prefix, since_revision, timeout):
         res = self._kv.wait(prefix, since_revision, min(float(timeout), 60.0))
         return {"events": [[e.type, _rec_to_wire(e.record)] for e in res.events],
                 "rev": res.revision}
 
+    @_timed
     def ping(self):
         return {"pong": True}
 
@@ -83,6 +120,8 @@ def main():
     parser.add_argument("--port", type=int, default=2379)
     args = parser.parse_args()
     configure()
+    from edl_tpu import obs
+    obs.install_from_env("coord")  # /metrics + JSONL trace, env-gated
     server = start_server(args.host, args.port)
     logger.info("coordination server listening on %s", server.endpoint)
     try:

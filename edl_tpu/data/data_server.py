@@ -40,6 +40,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict, deque
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils.exceptions import EdlDataError, EdlStopIteration, EdlTableError
 from edl_tpu.utils.logger import get_logger
@@ -49,6 +50,27 @@ logger = get_logger(__name__)
 
 
 from edl_tpu.utils.spans import in_spans, merge_span  # noqa: F401 — re-export
+
+# labeled by the reader's BASE name (the part before the epoch/stage
+# "@generation" suffix): generations are unbounded over a long job,
+# base names are the job's fixed reader set
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "edl_data_queue_depth",
+    "Produced batches awaiting consumers, by reader base name",
+    ("reader",))
+_BATCHES_PRODUCED = obs_metrics.counter(
+    "edl_data_batches_produced_total", "Batch metas reported by producers",
+    ("reader",))
+_BATCHES_ACKED = obs_metrics.counter(
+    "edl_data_batches_acked_total", "Batches acked consumed", ("reader",))
+_REBALANCES = obs_metrics.counter(
+    "edl_data_rebalances_total",
+    "Work-requeue incidents (dead pod per generation, or an "
+    "eviction-repair nack)", ("reader",))
+
+
+def _base(reader: str) -> str:
+    return reader.split("@", 1)[0]
 
 
 class _Meta:
@@ -178,6 +200,10 @@ class DataService:
                 gen.queue.append(_Meta(pod_id, endpoint, batch_id,
                                        [list(map(int, s)) for s in spans]))
             gen.produced += len(batches)
+            if batches:
+                _BATCHES_PRODUCED.labels(reader=_base(reader)).inc(
+                    len(batches))
+            _QUEUE_DEPTH.labels(reader=_base(reader)).set(len(gen.queue))
             return {"backlog": len(gen.queue)}
 
     def file_done(self, reader: str, pod_id: str, file_idx: int) -> dict:
@@ -213,6 +239,7 @@ class DataService:
                 meta = held.pop(bid, None)
                 if meta is not None:
                     gen.acked += 1
+                    _BATCHES_ACKED.labels(reader=_base(reader)).inc()
                     for file_idx, b, e in meta.spans:
                         merge_span(gen.consumed.setdefault(file_idx, []), b, e)
             if gen.error is not None:
@@ -222,6 +249,7 @@ class DataService:
                 meta = gen.queue.popleft()
                 held[meta.batch_id] = meta
                 metas.append(meta.wire())
+            _QUEUE_DEPTH.labels(reader=_base(reader)).set(len(gen.queue))
             # end-of-data is per consumer: ITS acks are in (held empty)
             # and nothing is pending globally.  Other consumers' inflight
             # must not delay it (deadlock vs the step agreement); should
@@ -248,12 +276,19 @@ class DataService:
         with self._lock:
             gen = self._gen(reader)
             held = gen.inflight.get(pod_id, OrderedDict())
+            nacked = 0
             for bid in batch_ids:
                 meta = held.pop(bid, None)
                 if meta is not None:
+                    nacked += 1
                     producers.add(meta.producer)
                     self._requeue_spans_locked(
                         gen, meta.spans, whole_file=producer_dead)
+            if nacked and not producer_dead:
+                # one eviction-repair incident; the producer_dead path is
+                # counted by mark_pod_dead (per affected generation), so
+                # counting here too would double-book the same event
+                _REBALANCES.labels(reader=_base(reader)).inc()
         if producer_dead:
             for producer in producers:
                 self.mark_pod_dead(producer, reader=reader)
@@ -266,9 +301,10 @@ class DataService:
         as a consumer, drop the queued metas it produced, and requeue
         its files — all minus already-consumed spans."""
         with self._lock:
-            gens = ([self._gens[reader]] if reader and reader in self._gens
-                    else list(self._gens.values()) if reader is None else [])
-            for gen in gens:
+            gens = ({reader: self._gens[reader]}
+                    if reader and reader in self._gens
+                    else dict(self._gens) if reader is None else {})
+            for gen_name, gen in gens.items():
                 # consumer side: unconsumed handed-out metas return to the
                 # pool (unless their producer is the dead pod itself)
                 held = gen.inflight.pop(pod_id, None)
@@ -300,6 +336,9 @@ class DataService:
                                             if e[0] != file_idx)
                         gen.pending.appendleft([file_idx, None])
                 if held or dead_queued:
+                    _REBALANCES.labels(reader=_base(gen_name)).inc()
+                    _QUEUE_DEPTH.labels(reader=_base(gen_name)).set(
+                        len(gen.queue))
                     logger.info(
                         "pod %s dead: requeued %d metas, re-producing %d "
                         "batches' files", pod_id[:8], requeued,

@@ -1,0 +1,43 @@
+"""Unified observability: metrics registry + /metrics exposition +
+structured JSONL trace.
+
+Three opt-in surfaces over one instrumentation layer:
+
+- **Metrics** (:mod:`edl_tpu.obs.metrics`): dependency-free Counter /
+  Gauge / Histogram with labels on a process-wide registry, exposed in
+  Prometheus text format by :mod:`edl_tpu.obs.exposition`
+  (``EDL_TPU_METRICS_PORT``).
+- **Trace** (:mod:`edl_tpu.obs.trace`): JSONL events with monotonic
+  span durations (``EDL_TPU_TRACE_DIR``) — the per-phase resize record
+  and the store's recovery records are written by the same code
+  (:mod:`edl_tpu.cluster.recovery`), so they agree by construction.
+- **Store readers**: :mod:`edl_tpu.obs.dump` (``python -m
+  edl_tpu.obs.dump`` — per-resize phase timeline + job summary) and
+  :mod:`edl_tpu.obs.collector` (CSV time-series poller).
+
+CLI entry points call :func:`install_from_env` right after
+``utils.logger.configure`` — library code never starts servers or
+opens files at import time.  ``dump``/``collector`` are deliberately
+NOT imported here: they pull in the cluster layer, which itself uses
+the metrics/trace submodules.
+"""
+
+from edl_tpu.obs.exposition import (  # noqa: F401
+    MetricsServer, installed_server, serve_from_env,
+)
+from edl_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, REGISTRY, RESIZE_BUCKETS, Counter, Gauge, Histogram,
+    Registry, counter, gauge, histogram, parse_exposition,
+)
+from edl_tpu.obs.trace import (  # noqa: F401
+    NullTracer, Tracer, emit, get_tracer, span,
+)
+from edl_tpu.obs.trace import configure_from_env as configure_tracer_from_env  # noqa: F401
+
+
+def install_from_env(component: str = "edl") -> None:
+    """Enable the env-gated observability surfaces for this process:
+    the /metrics endpoint (``EDL_TPU_METRICS_PORT``) and the JSONL
+    tracer (``EDL_TPU_TRACE_DIR``).  Idempotent, never raises."""
+    serve_from_env(component)
+    configure_tracer_from_env(component)

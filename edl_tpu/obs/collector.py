@@ -1,0 +1,180 @@
+"""Job metrics collector: CSV time-series of elastic-job state.
+
+The reference shipped a k8s-API poller that tracked job phases
+(pending/running/finish), pod counts and CPU/GPU utilisation into CSV
+for its fault-tolerance experiments (example/fit_a_line/collector.py:
+JobInfo phases, run_once poll loop, cpu_utils).  The TPU-native build's
+source of truth is the coordination store, not the k8s API — every
+launcher already publishes cluster membership, pod/job/train statuses
+and resize-timing records there — so this collector polls the store and
+needs nothing from the deployment platform.
+
+One CSV row per job per tick::
+
+    ts,job_id,job_status,stage,live_pods,cluster_pods,world_size,
+    pods_running,train_status,resizes,last_recovery_sec
+
+plus a per-job phase summary (submit→start→end, like the reference's
+JobInfo table) printed on exit.  Terminal: all watched jobs SUCCEED or
+FAILED (or --max_ticks for a bounded probe).
+
+Usage::
+
+    python -m edl_tpu.obs.collector --coord_endpoints host:2379 \
+        --job_id rn50 lm1 --interval 3 --out metrics.csv
+
+(``examples/collective/collector.py`` is a thin wrapper over this
+module.)  For a one-shot human-readable report of the same store
+state — including the per-resize phase timeline — use
+``python -m edl_tpu.obs.dump``; for live scraping of in-process
+counters, see the /metrics endpoint (doc/observability.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+import time
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.recovery import summarize_recovery
+from edl_tpu.cluster.status import Status, load_job_status, load_pods_status
+from edl_tpu.cluster.train_status import load_train_statuses
+from edl_tpu.collective.resource import load_resource_pods
+
+FIELDS = ["ts", "job_id", "job_status", "stage", "live_pods",
+          "cluster_pods", "world_size", "pods_running", "train_status",
+          "resizes", "last_recovery_sec"]
+
+TERMINAL_VALUES = {Status.SUCCEED.value, Status.FAILED.value}
+
+# consecutive poll failures after which a job is abandoned (transient
+# store blips ride through; a permanently unpollable job can't hang the
+# collector forever once every other job is terminal)
+MAX_CONSECUTIVE_FAILURES = 10
+
+
+def collect_row(store, job_id: str, now: float | None = None) -> dict:
+    """One poll of everything the store knows about ``job_id``."""
+    now = time.time() if now is None else now
+    job = load_job_status(store, job_id)
+    cluster = Cluster.load_from_store(store, job_id)
+    live = load_resource_pods(store, job_id)
+    pods = load_pods_status(store, job_id)
+    trains = load_train_statuses(store, job_id)
+    resizes = summarize_recovery(store, job_id)
+    last = resizes[-1].get("total") if resizes else None
+    # one compact cell, not a column per pod: pod sets change under resize
+    tcounts: dict[str, int] = {}
+    for st in trains.values():
+        tcounts[st.value] = tcounts.get(st.value, 0) + 1
+    return {
+        "ts": round(now, 3),
+        "job_id": job_id,
+        "job_status": job.value if job else "N/A",
+        "stage": cluster.stage[:8] if cluster else "",
+        "live_pods": len(live),
+        "cluster_pods": len(cluster.pods) if cluster else 0,
+        "world_size": cluster.world_size if cluster else 0,
+        "pods_running": sum(1 for s in pods.values()
+                            if s == Status.RUNNING),
+        "train_status": "|".join(f"{k}:{v}"
+                                 for k, v in sorted(tcounts.items())),
+        "resizes": len(resizes),
+        "last_recovery_sec": "" if last is None else last,
+    }
+
+
+class JobPhases:
+    """First-seen / first-running / terminal timestamps per job — the
+    reference's JobInfo submit/start/end accounting."""
+
+    def __init__(self) -> None:
+        self.submit: dict[str, float] = {}
+        self.start: dict[str, float] = {}
+        self.end: dict[str, tuple[float, str]] = {}
+
+    def observe(self, row: dict) -> None:
+        job, ts, status = row["job_id"], row["ts"], row["job_status"]
+        self.submit.setdefault(job, ts)
+        if job not in self.start and (row["pods_running"] > 0
+                                      or status == Status.RUNNING.value):
+            self.start[job] = ts
+        if job not in self.end and status in TERMINAL_VALUES:
+            self.end[job] = (ts, status)
+
+    def summary(self) -> list[dict]:
+        out = []
+        for job, t0 in self.submit.items():
+            start = self.start.get(job)
+            end = self.end.get(job)
+            out.append({
+                "job_id": job,
+                "status": end[1] if end else "RUNNING",
+                "pending_sec": round(start - t0, 1) if start else None,
+                "run_sec": round(end[0] - start, 1) if end and start else None,
+            })
+        return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", nargs="+", required=True)
+    p.add_argument("--interval", type=float, default=3.0)
+    p.add_argument("--out", default="-", help="CSV path ('-' = stdout)")
+    p.add_argument("--max_ticks", type=int, default=0,
+                   help="stop after N polls (0 = until all jobs terminal)")
+    args = p.parse_args()
+
+    from edl_tpu.coord.client import connect
+    store = connect(args.coord_endpoints)
+    sink = sys.stdout if args.out == "-" else open(args.out, "w", newline="")
+    writer = csv.DictWriter(sink, fieldnames=FIELDS)
+    writer.writeheader()
+    phases = JobPhases()
+    tick = 0
+    try:
+        # last-known status per job: a job whose poll failed this tick
+        # must NOT drop out of the terminal check (its series would be
+        # silently truncated the moment the others finish) — but a job
+        # that NEVER polls (corrupt record, dead store shard) is given
+        # up after MAX_CONSECUTIVE_FAILURES so the loop still terminates
+        latest = {job: "N/A" for job in args.job_id}
+        failures = dict.fromkeys(args.job_id, 0)
+        while True:
+            tick += 1
+            for job in args.job_id:
+                if failures[job] >= MAX_CONSECUTIVE_FAILURES:
+                    continue  # given up (counted terminal below)
+                try:
+                    row = collect_row(store, job)
+                except Exception as e:  # noqa: BLE001
+                    failures[job] += 1
+                    print(f"[collector] poll {job} failed "
+                          f"({failures[job]}/{MAX_CONSECUTIVE_FAILURES}):"
+                          f" {e}", file=sys.stderr, flush=True)
+                    continue
+                failures[job] = 0
+                writer.writerow(row)
+                phases.observe(row)
+                latest[job] = row["job_status"]
+            sink.flush()
+            if args.max_ticks and tick >= args.max_ticks:
+                break
+            if all(s in TERMINAL_VALUES
+                   or failures[j] >= MAX_CONSECUTIVE_FAILURES
+                   for j, s in latest.items()):
+                break
+            time.sleep(args.interval)
+    finally:
+        for s in phases.summary():
+            print(f"[collector] {s}", file=sys.stderr, flush=True)
+        if sink is not sys.stdout:
+            sink.close()
+        store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""``python -m edl_tpu.obs.dump`` (also ``edl-obs-dump``): one-shot
+human-readable report of a job's observability state from the
+coordination store — job summary + per-resize phase timeline.
+
+The phase timeline is :func:`~edl_tpu.cluster.recovery.
+summarize_recovery` verbatim (the north-star recovery-time metric), so
+this CLI, the CSV collector, the controller's resize-cost signal, and
+the launcher/trainer trace events all report the same numbers: they
+share one read path over one write path (recovery.write_*_half).
+
+Usage::
+
+    python -m edl_tpu.obs.dump --coord_endpoints host:2379 --job_id rn50
+    python -m edl_tpu.obs.dump ... --json     # machine-readable
+    python -m edl_tpu.obs.dump ... --kill_time 1700000000.5   # adds
+        kill_to_detect / total_from_kill (harness SIGKILL timestamp)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from edl_tpu.cluster.recovery import summarize_recovery
+from edl_tpu.obs.collector import collect_row
+
+# render order: the chronological phase chain, then the totals
+PHASE_ORDER = ("kill_to_detect", "detect_to_kill", "kill_to_barrier",
+               "barrier_to_spawn", "spawn_to_restored",
+               "restored_to_first_step", "total", "total_from_kill")
+
+
+def job_report(store, job_id: str,
+               kill_time: float | None = None) -> dict:
+    """{"job": <collector row>, "resizes": <summarize_recovery>}."""
+    return {"job": collect_row(store, job_id),
+            "resizes": summarize_recovery(store, job_id, kill_time)}
+
+
+def render_report(report: dict) -> str:
+    row = report["job"]
+    resizes = report["resizes"]
+    lines = [
+        f"job {row['job_id']}: {row['job_status']}"
+        f"  stage={row['stage'] or '-'}"
+        f"  pods={row['pods_running']}/{row['cluster_pods']}"
+        f" (live {row['live_pods']})"
+        f"  world={row['world_size']}"
+        f"  train={row['train_status'] or '-'}"
+        f"  resizes={row['resizes']}",
+    ]
+    for s in resizes:
+        done = "" if "total" in s else "  [launcher half only]"
+        lines.append(f"  resize {s['stage']} @ {s['detect_at']:.3f}{done}")
+        for phase in PHASE_ORDER:
+            if phase in s:
+                lines.append(f"    {phase:<24} {s[phase]:>9.3f}s")
+    if not resizes:
+        lines.append("  (no resize records)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl_tpu.obs.dump",
+        description="Render a job's per-resize phase timeline + summary "
+                    "from the coordination store")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", nargs="+", required=True)
+    p.add_argument("--kill_time", type=float, default=None,
+                   help="harness SIGKILL timestamp: adds kill_to_detect "
+                        "and total_from_kill to each complete resize")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object per job instead of text")
+    args = p.parse_args(argv)
+
+    from edl_tpu.coord.client import connect
+    store = connect(args.coord_endpoints)
+    try:
+        for job_id in args.job_id:
+            report = job_report(store, job_id, kill_time=args.kill_time)
+            if args.as_json:
+                print(json.dumps(report))
+            else:
+                print(render_report(report))
+    finally:
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""/metrics exposition: a tiny stdlib HTTP endpoint over a Registry.
+
+Pull-model exposition (the Borg/Kubernetes-lineage convention): every
+process serves its own registry; the scraper joins series across
+processes by target.  CLI entry points (and :class:`ElasticTrainer`)
+enable it the same way ``utils.logger.configure`` installs handlers —
+opt-in via :func:`serve_from_env` (``EDL_TPU_METRICS_PORT``), never at
+import time.
+
+``EDL_TPU_METRICS_PORT=0`` binds an OS-assigned free port — the
+multi-process-per-host default (launcher + N trainers can't share an
+explicit port); the advertised host comes from ``utils.network``'s
+``local_ip`` (sandbox/NAT aware).  Set ``EDL_TPU_METRICS_DIR`` to have
+each process
+drop a ``metrics-<component>-<pid>.addr`` file with its ``host:port``,
+so harnesses and scrapers can discover auto-picked ports.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from edl_tpu.obs.metrics import REGISTRY, Registry
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serve ``registry.render()`` at ``/metrics`` (and ``/``)."""
+
+    def __init__(self, registry: Registry | None = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        reg = registry or REGISTRY
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = reg.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log lines
+                pass
+
+        self.registry = reg
+        # port 0 = OS-assigned ephemeral port, atomically (no probe race);
+        # server_address[1] reports the bound port
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host = self._httpd.server_address[0]
+        if host in ("0.0.0.0", ""):
+            host = local_ip()
+        return f"{host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"metrics:{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_install_lock = threading.Lock()
+_server: MetricsServer | None = None
+
+
+def installed_server() -> MetricsServer | None:
+    return _server
+
+
+def serve_from_env(component: str = "edl",
+                   registry: Registry | None = None) -> MetricsServer | None:
+    """Start the process-wide /metrics endpoint if ``EDL_TPU_METRICS_PORT``
+    is set (0 = auto free port); idempotent; never raises — metrics must
+    never fail a job."""
+    global _server
+    port_s = os.environ.get("EDL_TPU_METRICS_PORT", "")
+    if not port_s:
+        return None
+    with _install_lock:
+        if _server is not None:
+            return _server
+        try:
+            port = int(port_s)
+        except ValueError:
+            logger.warning("EDL_TPU_METRICS_PORT=%r is not an int; "
+                           "metrics endpoint disabled", port_s)
+            return None
+        if port < 0:
+            return None
+        try:
+            try:
+                srv = MetricsServer(registry, port=port).start()
+            except OSError:
+                if port == 0:
+                    raise
+                # explicit port busy (several processes per host): serve
+                # anyway on a free port — an addr file still locates it
+                logger.warning("metrics port %d busy; falling back to a "
+                               "free port", port)
+                srv = MetricsServer(registry, port=0).start()
+        except Exception:  # noqa: BLE001 — metrics must never fail a job
+            logger.exception("metrics endpoint failed to start")
+            return None
+        _server = srv
+    addr_dir = os.environ.get("EDL_TPU_METRICS_DIR")
+    if addr_dir:
+        try:
+            os.makedirs(addr_dir, exist_ok=True)
+            path = os.path.join(addr_dir,
+                                f"metrics-{component}-{os.getpid()}.addr")
+            with open(path, "w") as f:
+                f.write(srv.endpoint + "\n")
+        except OSError:
+            logger.exception("could not write metrics addr file")
+    logger.info("metrics: serving /metrics on %s", srv.endpoint)
+    return srv

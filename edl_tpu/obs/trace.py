@@ -1,0 +1,136 @@
+"""Structured JSONL event trace with monotonic spans.
+
+One line per event::
+
+    {"ts": 1700000000.123456, "name": "resize/kill_to_barrier",
+     "component": "launcher", "dur": 0.512, ...}
+
+``ts`` is wall-clock (joinable across hosts via NTP-class skew);
+``dur`` is measured with the *monotonic* clock, so spans are immune to
+wall-clock steps.  MLPerf-style training logs and Chrome trace events
+use the same shape: flat JSON records keyed by a hierarchical name.
+
+Library code calls :func:`get_tracer` and emits unconditionally — the
+default is a :class:`NullTracer`, so a job that never opted in pays a
+no-op call.  CLI entry points opt in via
+:func:`configure_from_env` (``EDL_TPU_TRACE_DIR``), the same pattern
+as ``utils.logger.configure``; the per-process file name carries the
+component and pid so every process of a job can share one directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+
+class NullTracer:
+    """Disabled tracer: every operation is a no-op."""
+
+    enabled = False
+
+    def emit(self, name: str, *, dur: float | None = None,
+             at: float | None = None, **fields) -> None:
+        pass
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        yield
+
+    def close(self) -> None:
+        pass
+
+
+class Tracer:
+    """Append-only JSONL writer; thread-safe, flushed per event (events
+    are rare — phase boundaries, not per-step — so durability beats
+    buffering: the interesting lines are the ones just before a kill)."""
+
+    enabled = True
+
+    def __init__(self, path: str, component: str = ""):
+        self.path = path
+        self.component = component
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, name: str, *, dur: float | None = None,
+             at: float | None = None, **fields) -> None:
+        rec: dict = {"ts": round(time.time() if at is None else at, 6),
+                     "name": name}
+        if self.component:
+            rec["component"] = self.component
+        if dur is not None:
+            rec["dur"] = round(float(dur), 6)
+        rec.update(fields)
+        line = json.dumps(rec) + "\n"
+        try:
+            with self._lock:
+                self._f.write(line)
+                self._f.flush()
+        except (OSError, ValueError):  # closed/full disk: tracing is best-effort
+            pass
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Emit ``name`` with its monotonic duration when the block exits
+        (exceptions included — the span's end is the interesting part of
+        a failing phase)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.emit(name, dur=time.monotonic() - t0, **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+_lock = threading.Lock()
+_tracer: NullTracer | Tracer = NullTracer()
+
+
+def get_tracer() -> NullTracer | Tracer:
+    return _tracer
+
+
+def configure(path: str, component: str = "") -> Tracer:
+    """Install a process-wide tracer writing to ``path``."""
+    global _tracer
+    with _lock:
+        if isinstance(_tracer, Tracer):
+            _tracer.close()
+        _tracer = Tracer(path, component)
+        return _tracer
+
+
+def configure_from_env(component: str = "") -> Tracer | None:
+    """``EDL_TPU_TRACE_DIR`` set → trace to
+    ``<dir>/trace-<component>-<pid>.jsonl``; unset → leave the
+    NullTracer in place.  Idempotent per process."""
+    d = os.environ.get("EDL_TPU_TRACE_DIR")
+    if not d:
+        return None
+    with _lock:
+        if isinstance(_tracer, Tracer):
+            return _tracer
+    path = os.path.join(d, f"trace-{component or 'proc'}-{os.getpid()}.jsonl")
+    return configure(path, component)
+
+
+def emit(name: str, **kw) -> None:
+    _tracer.emit(name, **kw)
+
+
+def span(name: str, **fields):
+    return _tracer.span(name, **fields)

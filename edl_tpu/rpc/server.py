@@ -15,12 +15,23 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import framing
 from edl_tpu.utils import exceptions
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# labeled by method — the method set is the registered services'
+# public surface, so cardinality is bounded (unknown-method requests
+# are not labeled: arbitrary client strings must not mint series)
+_REQUEST_SECONDS = obs_metrics.histogram(
+    "edl_rpc_request_seconds", "RPC handler latency (seconds), by method",
+    ("method",))
+_ERRORS_TOTAL = obs_metrics.counter(
+    "edl_rpc_errors_total", "RPC handler exceptions, by method", ("method",))
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -39,6 +50,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     "s": {"type": "EdlInternalError", "detail": f"no such method {msg.get('m')!r}"},
                     "r": None})
                 continue
+            t0 = time.perf_counter()
             try:
                 result = fn(**(msg.get("a") or {}))
                 resp = {"s": None, "r": result}
@@ -46,6 +58,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 if not isinstance(e, exceptions.EdlRetryableError):
                     logger.warning("handler %s raised", method, exc_info=True)
                 resp = {"s": exceptions.serialize(e), "r": None}
+                if not isinstance(e, exceptions.EdlStopIteration):
+                    # StopIteration is end-of-data protocol, not a fault
+                    _ERRORS_TOTAL.labels(method=method).inc()
+            _REQUEST_SECONDS.labels(method=method).observe(
+                time.perf_counter() - t0)
             try:
                 framing.send_frame(self.request, resp)
             except OSError:

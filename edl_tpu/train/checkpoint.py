@@ -16,14 +16,32 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any
 
 import orbax.checkpoint as ocp
 
 from edl_tpu.cluster.state import State
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# saves are async: _save_seconds is the synchronous (blocking-the-step)
+# part of save(); _wait_seconds is the commit drain (epoch boundaries,
+# preemption); restore is fully synchronous
+_SAVE_SECONDS = obs_metrics.histogram(
+    "edl_checkpoint_save_seconds",
+    "Synchronous portion of a checkpoint save (seconds)")
+_WAIT_SECONDS = obs_metrics.histogram(
+    "edl_checkpoint_wait_seconds",
+    "Async checkpoint commit drain (seconds)")
+_RESTORE_SECONDS = obs_metrics.histogram(
+    "edl_checkpoint_restore_seconds", "Checkpoint restore (seconds)")
+_SAVES_TOTAL = obs_metrics.counter(
+    "edl_checkpoint_saves_total", "Checkpoint saves accepted")
+_RESTORES_TOTAL = obs_metrics.counter(
+    "edl_checkpoint_restores_total", "Checkpoint restores completed")
 
 
 class CheckpointManager:
@@ -52,8 +70,11 @@ class CheckpointManager:
         args = {"state": ocp.args.StandardSave(state)}
         if meta is not None:
             args["meta"] = ocp.args.JsonSave(meta.to_dict())
+        t0 = time.perf_counter()
         saved = self._mngr.save(step, args=ocp.args.Composite(**args), force=force)
         if saved:
+            _SAVE_SECONDS.observe(time.perf_counter() - t0)
+            _SAVES_TOTAL.inc()
             logger.info("checkpoint step %d queued to %s", step, self._dir)
         return saved
 
@@ -69,6 +90,7 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
+        t0 = time.perf_counter()
         if self._has_item(step, "meta"):
             restored = self._mngr.restore(
                 step, args=ocp.args.Composite(
@@ -86,6 +108,8 @@ class CheckpointManager:
         meta = None
         if restored.get("meta") is not None:
             meta = State().from_dict(restored["meta"])
+        _RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        _RESTORES_TOTAL.inc()
         logger.info("restored checkpoint step %d from %s", step, self._dir)
         return restored["state"], meta
 
@@ -123,7 +147,9 @@ class CheckpointManager:
             return True  # assume present; the composite restore will say
 
     def wait(self) -> None:
+        t0 = time.perf_counter()
         self._mngr.wait_until_finished()
+        _WAIT_SECONDS.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         self._mngr.wait_until_finished()

@@ -45,11 +45,27 @@ from edl_tpu.parallel.mesh import MeshSpec, batch_divisor, build_mesh
 from edl_tpu.parallel.sharding import (
     ShardingRules, logical_sharding, shard_host_batch,
 )
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.train.checkpoint import CheckpointManager
 from edl_tpu.train.state import TrainState, abstract_like
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# step latency is the wall time between completed-step observations:
+# steps dispatch asynchronously, but with a bounded dispatch queue the
+# steady-state loop rate equals the device step rate (see
+# _observe_step_time), so the histogram converges on true step time
+# without forcing a device sync per step
+_STEP_SECONDS = obs_metrics.histogram(
+    "edl_train_step_seconds", "Wall time between completed train steps")
+_STEPS_TOTAL = obs_metrics.counter(
+    "edl_train_steps_total", "Completed train steps")
+_EXAMPLES_TOTAL = obs_metrics.counter(
+    "edl_train_examples_total", "Examples consumed (global batch rows)")
+_EPOCHS_TOTAL = obs_metrics.counter(
+    "edl_train_epochs_total", "Completed epochs")
 
 # loss_fn(params, extra, batch, rng) -> (loss, (new_extra, metrics))
 LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[Any, dict]]]
@@ -83,6 +99,11 @@ class ElasticTrainer:
                  store=None, tenv: TrainerEnv | None = None, devices=None):
         self.cfg = config or TrainConfig()
         self.loss_fn = loss_fn
+        # env-gated (EDL_TPU_METRICS_PORT / EDL_TPU_TRACE_DIR): trainers
+        # are user scripts with no CLI entry point of ours, so the
+        # trainer is where the per-process observability surfaces attach
+        from edl_tpu import obs
+        obs.install_from_env("trainer")
         self.tenv = tenv
         self.store = store
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
@@ -160,8 +181,10 @@ class ElasticTrainer:
         meta = State(total_batch_size=self.cfg.global_batch_size)
         if self.ckpt is None or self.ckpt.latest_step() is None:
             return self.create_state(init_fn, tx, param_logical), meta
-        restored = self.ckpt.restore(
-            self._abstract_state(init_fn, tx, param_logical))
+        with obs_trace.get_tracer().span("train/restore",
+                                         step=self.ckpt.latest_step()):
+            restored = self.ckpt.restore(
+                self._abstract_state(init_fn, tx, param_logical))
         assert restored is not None
         state, saved_meta = restored
         if saved_meta is not None:
@@ -250,6 +273,16 @@ class ElasticTrainer:
             rng, step_rng = jax.random.split(rng)
             state, metrics = self.step_fn(state, gbatch, step_rng)
             n_steps += 1
+            self._observe_step_time()
+            _STEPS_TOTAL.inc()
+            # global batch rows, counted by process 0 only: scrapes are
+            # per-process and Prometheus sums across targets, so every
+            # process counting the GLOBAL dimension would overcount by
+            # the process count
+            if jax.process_index() == 0:
+                leaves = jax.tree.leaves(gbatch)
+                if leaves and getattr(leaves[0], "shape", None):
+                    _EXAMPLES_TOTAL.inc(int(leaves[0].shape[0]))
             if self._t_restored is not None:
                 self._report_recovery(metrics)
             self._heartbeat()
@@ -302,6 +335,9 @@ class ElasticTrainer:
                 self.ckpt.save_meta(int(state.step), meta)
         if self._profiling:  # epoch ended inside the window
             self._stop_profile()
+        _EPOCHS_TOTAL.inc()
+        obs_trace.emit("train/epoch", dur=dt, epoch=epoch, steps=n_steps,
+                       world=self.world_size)
         logger.info("epoch %d done: %d steps in %.1fs", epoch, n_steps, dt)
         return state, meta
 
@@ -372,15 +408,13 @@ class ElasticTrainer:
             return
         jax.block_until_ready(metrics["loss"])  # the step truly finished
         try:
-            import json
-
-            from edl_tpu.cluster import paths
-            from edl_tpu.utils import constants
-            self.store.put(
-                paths.key(self.tenv.job_id, constants.ETCD_RECOVERY,
-                          f"{self.tenv.cluster_stage}/trainer/{self.tenv.pod_id}"),
-                json.dumps({"restored": t_restored,
-                            "first_step": time.time()}).encode())
+            from edl_tpu.cluster import recovery
+            # unified write: store record + resize-phase histogram +
+            # trace events from one times dict (recovery.py)
+            recovery.write_trainer_half(
+                self.store, self.tenv.job_id, self.tenv.cluster_stage,
+                self.tenv.pod_id, restored=t_restored,
+                first_step=time.time())
         except Exception:  # noqa: BLE001 — metrics must never fail a job
             logger.exception("recovery record write failed")
 
@@ -401,6 +435,7 @@ class ElasticTrainer:
             dt = now - self._last_step_t
             self._step_ema = (dt if self._step_ema is None
                               else 0.9 * self._step_ema + 0.1 * dt)
+            _STEP_SECONDS.observe(dt)
         self._last_step_t = now
 
     def _heartbeat(self) -> None:
@@ -430,7 +465,8 @@ class ElasticTrainer:
                     "engages for this trainer", _c.HANG_TIMEOUT,
                     " = auto" if _c.HANG_TIMEOUT == 0 else "")
             return
-        self._observe_step_time()
+        # step-time EMA is maintained by the epoch loop's per-step
+        # _observe_step_time() call (shared with the step metrics)
         from edl_tpu.cluster import heartbeat
         threshold = None
         if _c.HANG_TIMEOUT == 0:

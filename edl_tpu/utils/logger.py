@@ -34,6 +34,13 @@ def configure(level: str | None = None, log_dir: str | None = None, filename: st
         root.addHandler(handler)
     if log_dir and filename:
         os.makedirs(log_dir, exist_ok=True)
-        fh = logging.FileHandler(os.path.join(log_dir, filename))
-        fh.setFormatter(logging.Formatter(_FMT))
-        root.addHandler(fh)
+        path = os.path.abspath(os.path.join(log_dir, filename))
+        # idempotent like the stderr handler above: repeated configure()
+        # calls (relaunch paths, embedding apps) must not stack handlers
+        # that duplicate every line into the same file
+        if not any(isinstance(h, logging.FileHandler)
+                   and getattr(h, "baseFilename", None) == path
+                   for h in root.handlers):
+            fh = logging.FileHandler(path)
+            fh.setFormatter(logging.Formatter(_FMT))
+            root.addHandler(fh)

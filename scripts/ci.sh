@@ -19,6 +19,12 @@ if [[ "${FULL:-0}" == "1" ]]; then
     python examples/collective/recovery_bench.py
 fi
 
+# observability smoke: a few real trainer steps with the /metrics
+# endpoint enabled, fetched over HTTP and parsed back — the
+# step-latency and resize-phase series must be present, and the dump
+# CLI must reproduce summarize_recovery's per-phase totals
+JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU)
 EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
@@ -30,9 +36,11 @@ JAX_PLATFORMS=cpu python bench.py | tail -1 \
 edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
 edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
+edl-obs-dump --help >/dev/null 2>&1 || { echo "edl-obs-dump missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
-for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench; do
+for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
+           edl-obs-dump; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""CI observability smoke (scripts/ci.sh): run a few real trainer
+steps with the /metrics endpoint enabled via the env contract
+(EDL_TPU_METRICS_PORT=0), push one resize record through the unified
+write path, then fetch /metrics over HTTP and PARSE it back —
+asserting the step-latency and resize-phase series are present — and
+check the dump CLI reproduces summarize_recovery's per-phase totals.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["EDL_TPU_METRICS_PORT"] = "0"  # auto free port, the env contract
+
+# runnable without `pip install -e .` (air-gapped checkouts)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.cluster.state import State
+from edl_tpu.train import ElasticTrainer, TrainConfig
+
+RNG = np.random.default_rng(0)
+
+
+def loss(params, extra, batch, rng):
+    pred = batch["x"] @ params["w"]
+    mse = jnp.mean((pred - batch["y"]) ** 2)
+    return mse, (extra, {"mse": mse})
+
+
+def batches():
+    for _ in range(5):
+        x = RNG.normal(size=(8, 4)).astype(np.float32)
+        yield {"x": x, "y": x @ np.ones((4, 1), np.float32)}
+
+
+def main() -> None:
+    trainer = ElasticTrainer(loss, TrainConfig(log_every=0))
+    state = trainer.create_state(lambda: ({"w": jnp.zeros((4, 1))}, None),
+                                 optax.sgd(0.1))
+    trainer.fit(state, State(), lambda e: batches(), epochs=1)
+
+    # one resize through the unified write path: the same times dicts
+    # drive the store record, the trace, and the phase histogram
+    from edl_tpu.cluster import recovery
+    from edl_tpu.coord.memory import MemoryKV
+    kv = MemoryKV()
+    recovery.write_launcher_half(
+        kv, "smoke", "s1", "pod0",
+        {"detect": 10.0, "killed": 10.5, "barrier": 11.0, "spawn": 11.25})
+    recovery.write_trainer_half(kv, "smoke", "s1", "pod0",
+                                restored=13.0, first_step=14.0)
+
+    from edl_tpu import obs
+    srv = obs.installed_server()
+    assert srv is not None, "EDL_TPU_METRICS_PORT did not install /metrics"
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+    text = urllib.request.urlopen(url, timeout=10).read().decode()
+    samples = obs.parse_exposition(text)  # raises if the page is invalid
+
+    def sample(name, **labels):
+        return samples.get((name, tuple(sorted(labels.items()))), 0.0)
+
+    assert sample("edl_train_steps_total") == 5.0, samples
+    assert sample("edl_train_step_seconds_count") >= 4.0, samples
+    assert sample("edl_resize_phase_seconds_count",
+                  phase="kill_to_barrier") == 1.0, samples
+    assert sample("edl_resize_phase_seconds_count",
+                  phase="restored_to_first_step") == 1.0, samples
+
+    # the dump CLI agrees with summarize_recovery by construction
+    from edl_tpu.cluster.recovery import summarize_recovery
+    from edl_tpu.obs.dump import job_report, render_report
+    report = job_report(kv, "smoke")
+    assert report["resizes"] == summarize_recovery(kv, "smoke")
+    (resize,) = report["resizes"]
+    assert resize["total"] == 4.0, resize
+    rendered = render_report(report)
+    assert "restored_to_first_step" in rendered, rendered
+    kv.close()
+    print("obs smoke OK")
+
+
+if __name__ == "__main__":
+    main()

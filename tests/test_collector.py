@@ -1,19 +1,15 @@
-"""Metrics collector: store-sourced CSV rows + job-phase accounting."""
+"""Metrics collector (edl_tpu/obs/collector.py): store-sourced CSV rows
++ job-phase accounting."""
 
 import json
-import os
-import sys
 
 from edl_tpu.cluster import paths
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.pod import Pod
 from edl_tpu.cluster.status import Status, save_job_status, save_pod_status
 from edl_tpu.cluster.train_status import TrainStatus, save_train_status
+from edl_tpu.obs.collector import FIELDS, JobPhases, collect_row
 from edl_tpu.utils import constants
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "examples", "collective"))
-from collector import FIELDS, JobPhases, collect_row  # noqa: E402
 
 
 def _seed_job(kv, job="j1"):

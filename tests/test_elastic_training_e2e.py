@@ -12,6 +12,7 @@ import re
 import subprocess
 import sys
 import time
+import urllib.request
 
 import pytest
 
@@ -109,11 +110,42 @@ def test_sigterm_preemption_checkpoint(coord_server, tmp_path):
     assert [int(x) for x in m.group(2).split(",")] == list(range(8))
 
 
+def _poll_metrics_endpoints(mdir, procs, want, deadline_s=240):
+    """Scrape every addr file in ``mdir`` until all ``want`` series have
+    nonzero counts (or every proc exits).  Returns the set seen."""
+    from edl_tpu.obs.metrics import parse_exposition
+
+    seen: set[str] = set()
+    deadline = time.time() + deadline_s
+    while time.time() < deadline and not want <= seen:
+        for f in mdir.glob("metrics-*.addr"):
+            addr = f.read_text().strip()
+            try:
+                with urllib.request.urlopen(f"http://{addr}/metrics",
+                                            timeout=5) as resp:
+                    text = resp.read().decode()
+            except OSError:
+                continue  # that process restarted/exited; others carry on
+            samples = parse_exposition(text)  # raises if page is invalid
+            for (name, _labels), value in samples.items():
+                if name in want and value > 0:
+                    seen.add(name)
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(1.0)
+    return seen
+
+
 @pytest.mark.slow
 def test_elastic_join_resumes_training(coord_server, tmp_path):
     ep = f"127.0.0.1:{coord_server.port}"
     ckpt = str(tmp_path / "ckpt")
-    pa = spawn("train-e2e", ep, str(tmp_path), "a", ckpt)
+    mdir = tmp_path / "metrics"
+    mdir.mkdir()
+    # every process (launchers + trainers) serves /metrics on a free
+    # port and advertises it via an addr file (doc/observability.md)
+    obs_env = {"EDL_TPU_METRICS_PORT": "0", "EDL_TPU_METRICS_DIR": str(mdir)}
+    pa = spawn("train-e2e", ep, str(tmp_path), "a", ckpt, extra_env=obs_env)
     # condition, not a fixed sleep (a loaded host made 12 s mean
     # anything from 1 to 6 epochs): B joins once A has COMMITTED at
     # least two epoch checkpoints solo
@@ -127,7 +159,15 @@ def test_elastic_join_resumes_training(coord_server, tmp_path):
         time.sleep(0.25)
     else:
         raise AssertionError("pod A never committed 2 epoch checkpoints")
-    pb = spawn("train-e2e", ep, str(tmp_path), "b", ckpt)
+    pb = spawn("train-e2e", ep, str(tmp_path), "b", ckpt, extra_env=obs_env)
+    # while the job runs, the live /metrics endpoints must serve valid
+    # Prometheus text; after the resize the step-latency histogram (any
+    # trainer) and the resize-phase histogram (the launchers) both have
+    # samples.  _count series prove real observations, not just TYPE
+    # lines.
+    want = {"edl_train_step_seconds_count", "edl_resize_phase_seconds_count"}
+    seen = _poll_metrics_endpoints(mdir, [pa, pb], want)
+    assert want <= seen, f"missing live metrics series: {want - seen}"
     assert finish(pa, 240) == 0
     assert finish(pb, 240) == 0
 
@@ -144,6 +184,12 @@ def test_elastic_join_resumes_training(coord_server, tmp_path):
     assert complete, stages
     assert 0 < complete[-1]["total"] < 300, stages
     print("recovery breakdown:", complete[-1])
+    # the obs dump reproduces the same per-phase totals for the
+    # completed resize — one read path over one write path
+    from edl_tpu.obs.dump import job_report, render_report
+    report = job_report(client, "train-e2e")
+    assert [s for s in report["resizes"] if "total" in s] == complete
+    assert "restored_to_first_step" in render_report(report)
     client.close()
 
     marker_a = (tmp_path / "marker-a").read_text()

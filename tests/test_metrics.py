@@ -1,0 +1,161 @@
+"""Metrics registry (edl_tpu/obs/metrics.py): thread-safe increments,
+label handling, byte-exact Prometheus text exposition (and parsing it
+back), and the stdlib /metrics HTTP endpoint."""
+
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from edl_tpu.obs.exposition import CONTENT_TYPE, MetricsServer
+from edl_tpu.obs.metrics import Registry, parse_exposition
+
+
+def test_concurrent_increments_from_threads():
+    r = Registry()
+    c = r.counter("ops_total", "ops", ("worker",))
+    h = r.histogram("lat_seconds", "lat", buckets=(0.5,))
+    g = r.gauge("depth", "depth")
+    n, nthreads = 1000, 8
+
+    def work(i):
+        child = c.labels(worker=str(i % 2))
+        for _ in range(n):
+            child.inc()
+            h.observe(0.1)
+            g.inc()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels(worker="0").value == n * nthreads / 2
+    assert c.labels(worker="1").value == n * nthreads / 2
+    assert h.count == n * nthreads
+    assert abs(h.sum - 0.1 * n * nthreads) < 1e-6
+    assert g.value == n * nthreads
+
+
+def test_label_handling():
+    r = Registry()
+    c = r.counter("x_total", "x", ("a", "b"))
+    c.labels("1", "2").inc()
+    c.labels(b="2", a="1").inc()  # kwargs in any order: same child
+    assert c.labels("1", "2").value == 2.0
+    with pytest.raises(ValueError):
+        c.labels("1")  # wrong arity
+    with pytest.raises(ValueError):
+        c.labels(a="1", z="2")  # unknown label
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric used without labels
+    with pytest.raises(ValueError):
+        c.labels("1", "2").inc(-1)  # counters only go up
+    # get-or-create: identical spec returns the same instrument;
+    # a different spec (labels or kind) is a registration error
+    assert r.counter("x_total", "x", ("a", "b")) is c
+    with pytest.raises(ValueError):
+        r.counter("x_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+
+
+def test_exposition_byte_exact_and_parse_back():
+    r = Registry()
+    c = r.counter("edl_ops_total", "Operations served", ("op",))
+    c.labels(op="get").inc(3)
+    c.labels(op='we"ird\n').inc()
+    r.gauge("edl_depth", "Queue depth").set(2.5)
+    h = r.histogram("edl_lat_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)
+    h.observe(7.0)
+    expected = (
+        '# HELP edl_depth Queue depth\n'
+        '# TYPE edl_depth gauge\n'
+        'edl_depth 2.5\n'
+        '# HELP edl_lat_seconds Latency\n'
+        '# TYPE edl_lat_seconds histogram\n'
+        'edl_lat_seconds_bucket{le="0.1"} 0.0\n'
+        'edl_lat_seconds_bucket{le="1.0"} 2.0\n'
+        'edl_lat_seconds_bucket{le="+Inf"} 3.0\n'
+        'edl_lat_seconds_sum 7.75\n'
+        'edl_lat_seconds_count 3.0\n'
+        '# HELP edl_ops_total Operations served\n'
+        '# TYPE edl_ops_total counter\n'
+        'edl_ops_total{op="get"} 3.0\n'
+        'edl_ops_total{op="we\\"ird\\n"} 1.0\n'
+    )
+    assert r.render() == expected
+
+    parsed = parse_exposition(r.render())
+    assert parsed[("edl_ops_total", (("op", "get"),))] == 3.0
+    assert parsed[("edl_ops_total", (("op", 'we"ird\n'),))] == 1.0
+    assert parsed[("edl_depth", ())] == 2.5
+    assert parsed[("edl_lat_seconds_bucket", (("le", "+Inf"),))] == 3.0
+    assert parsed[("edl_lat_seconds_count", ())] == 3.0
+    assert parsed[("edl_lat_seconds_sum", ())] == 7.75
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("this is not { prometheus\n")
+
+
+def test_histogram_gets_inf_bucket_and_labeled_children():
+    r = Registry()
+    h = r.histogram("h_seconds", "h", ("phase",), buckets=(1.0,))
+    assert h.buckets[-1] == float("inf")
+    h.labels(phase="a").observe(0.5)
+    h.labels(phase="b").observe(2.0)
+    parsed = parse_exposition(r.render())
+    assert parsed[("h_seconds_bucket",
+                   (("le", "1.0"), ("phase", "a")))] == 1.0
+    assert parsed[("h_seconds_bucket",
+                   (("le", "1.0"), ("phase", "b")))] == 0.0
+    assert parsed[("h_seconds_count", (("phase", "b"),))] == 1.0
+
+
+def test_metrics_http_endpoint():
+    r = Registry()
+    r.counter("up_total", "process up").inc()
+    srv = MetricsServer(r, host="127.0.0.1", port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            text = resp.read().decode()
+        assert parse_exposition(text)[("up_total", ())] == 1.0
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_serve_from_env_writes_addr_file(tmp_path, monkeypatch):
+    from edl_tpu.obs import exposition
+
+    monkeypatch.setattr(exposition, "_server", None)
+    monkeypatch.setenv("EDL_TPU_METRICS_PORT", "0")
+    monkeypatch.setenv("EDL_TPU_METRICS_DIR", str(tmp_path))
+    srv = exposition.serve_from_env("unit", Registry())
+    try:
+        assert srv is not None
+        # idempotent: a second call returns the same server
+        assert exposition.serve_from_env("unit") is srv
+        (addr_file,) = tmp_path.glob("metrics-unit-*.addr")
+        addr = addr_file.read_text().strip()
+        assert addr.endswith(f":{srv.port}")
+    finally:
+        srv.stop()
+
+
+def test_serve_from_env_disabled_without_env(monkeypatch):
+    from edl_tpu.obs import exposition
+
+    monkeypatch.setattr(exposition, "_server", None)
+    monkeypatch.delenv("EDL_TPU_METRICS_PORT", raising=False)
+    assert exposition.serve_from_env("unit") is None

@@ -1,0 +1,134 @@
+"""JSONL tracer + the unified resize-record write path: the store
+record (read back by summarize_recovery), the trace events, and the
+resize-phase histogram all derive from the same times dict
+(cluster/recovery.py), and the dump CLI reproduces summarize_recovery
+verbatim."""
+
+import json
+
+from edl_tpu.cluster import recovery
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.obs.dump import job_report, render_report
+
+PHASES = ("detect_to_kill", "kill_to_barrier", "barrier_to_spawn",
+          "restored_to_first_step")
+
+
+def _read_events(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def test_tracer_emit_and_span(tmp_path):
+    path = tmp_path / "t.jsonl"
+    tr = obs_trace.Tracer(str(path), component="unit")
+    tr.emit("hello", at=12.0, stage="s1")
+    with tr.span("work", k=1):
+        pass
+    tr.close()
+    first, second = _read_events(path)
+    assert first == {"ts": 12.0, "name": "hello", "component": "unit",
+                     "stage": "s1"}
+    assert second["name"] == "work" and second["k"] == 1
+    assert second["dur"] >= 0  # monotonic span duration
+
+
+def test_span_emits_on_exception(tmp_path):
+    path = tmp_path / "t.jsonl"
+    tr = obs_trace.Tracer(str(path))
+    try:
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    tr.close()
+    (event,) = _read_events(path)
+    assert event["name"] == "boom" and "dur" in event
+
+
+def test_configure_from_env_idempotent(tmp_path, monkeypatch):
+    monkeypatch.setenv("EDL_TPU_TRACE_DIR", str(tmp_path))
+    tr = obs_trace.configure_from_env("unit")
+    try:
+        assert tr is obs_trace.get_tracer()
+        assert obs_trace.configure_from_env("unit") is tr
+        obs_trace.emit("e1", at=1.0)
+        (trace_file,) = tmp_path.glob("trace-unit-*.jsonl")
+        (event,) = _read_events(trace_file)
+        assert event["name"] == "e1" and event["component"] == "unit"
+    finally:
+        tr.close()
+        obs_trace._tracer = obs_trace.NullTracer()
+
+
+def test_unified_halves_store_trace_and_histogram_agree(memkv, tmp_path):
+    tr = obs_trace.configure(str(tmp_path / "trace.jsonl"), "unit")
+    hist = recovery.RESIZE_PHASE_SECONDS
+    before = {ph: hist.labels(phase=ph).count for ph in PHASES}
+    t0 = 1000.0
+    try:
+        recovery.write_launcher_half(
+            memkv, "j", "s1", "podA",
+            {"detect": t0, "killed": t0 + 2, "barrier": t0 + 2.5,
+             "spawn": t0 + 3})
+        recovery.write_trainer_half(memkv, "j", "s1", "podA",
+                                    restored=t0 + 8, first_step=t0 + 9.5)
+    finally:
+        obs_trace._tracer = obs_trace.NullTracer()
+        tr.close()
+
+    # the store record, read back through the one read path
+    (stage,) = recovery.summarize_recovery(memkv, "j")
+    assert stage["detect_to_kill"] == 2.0
+    assert stage["kill_to_barrier"] == 0.5
+    assert stage["barrier_to_spawn"] == 0.5
+    assert stage["restored_to_first_step"] == 1.5
+    assert stage["total"] == 9.5
+
+    # the trace events carry the SAME per-phase durations (same dict)
+    events = {e["name"]: e
+              for e in _read_events(tmp_path / "trace.jsonl")}
+    for phase in PHASES:
+        assert events[f"resize/{phase}"]["dur"] == stage[phase]
+        assert events[f"resize/{phase}"]["stage"] == "s1"
+
+    # and the per-phase histogram observed each phase exactly once
+    after = {ph: hist.labels(phase=ph).count for ph in PHASES}
+    assert after == {ph: before[ph] + 1 for ph in PHASES}
+
+
+def test_dump_reproduces_summarize_recovery(memkv):
+    t0 = 50.0
+    recovery.write_launcher_half(
+        memkv, "jd", "s1", "podA",
+        {"detect": t0, "killed": t0 + 2, "barrier": t0 + 2.5,
+         "spawn": t0 + 3})
+    recovery.write_trainer_half(memkv, "jd", "s1", "podA",
+                                restored=t0 + 8, first_step=t0 + 9.5)
+    # a later, in-flight resize: launcher half only
+    recovery.write_launcher_half(
+        memkv, "jd", "s2", "podA",
+        {"detect": t0 + 100, "killed": t0 + 101, "barrier": t0 + 101.25,
+         "spawn": t0 + 101.5})
+
+    report = job_report(memkv, "jd")
+    # the dump's per-phase totals ARE summarize_recovery's — one read
+    # path, zero chance of drift
+    assert report["resizes"] == recovery.summarize_recovery(memkv, "jd")
+    assert report["job"]["resizes"] == 2
+    # the newest resize (s2) is still in flight, so the collector cell
+    # is empty; the completed s1 carries the full breakdown
+    assert report["job"]["last_recovery_sec"] == ""
+    assert report["resizes"][0]["total"] == 9.5
+
+    text = render_report(report)
+    assert "resize s1" in text and "resize s2" in text
+    assert "[launcher half only]" in text  # s2 is visibly incomplete
+    assert "total" in text and "9.500s" in text
+    assert "restored_to_first_step" in text and "1.500s" in text
+
+
+def test_dump_empty_job(memkv):
+    report = job_report(memkv, "ghost")
+    assert report["resizes"] == []
+    text = render_report(report)
+    assert "ghost" in text and "no resize records" in text

@@ -44,6 +44,49 @@ def test_merge_and_breakdown(memkv):
     assert "total" not in stages[1]
 
 
+def test_launcher_half_only(memkv):
+    """A resize whose trainer half never landed (job completed first,
+    trainer died before its first step) still reports the launcher
+    phases — and no fabricated trainer phases or total."""
+    put(memkv, "jp", "s1", "launcher", "podA",
+        {"detect": 1.0, "killed": 2.0, "barrier": 2.5, "spawn": 3.0})
+    (s,) = summarize_recovery(memkv, "jp")
+    assert s["detect_to_kill"] == 1.0
+    assert s["kill_to_barrier"] == 0.5
+    assert s["barrier_to_spawn"] == 0.5
+    for key in ("spawn_to_restored", "restored_to_first_step", "total",
+                "total_from_kill"):
+        assert key not in s
+    # kill_time only decorates COMPLETE records
+    (s,) = summarize_recovery(memkv, "jp", kill_time=0.5)
+    assert "kill_to_detect" not in s and "total_from_kill" not in s
+
+
+def test_trainer_half_only_is_skipped(memkv):
+    """A trainer half with no launcher half has no detect anchor: the
+    summary skips the stage (no crash, no partial garbage) while the
+    raw record stays loadable for debugging."""
+    put(memkv, "jt", "s1", "trainer", "podA",
+        {"restored": 5.0, "first_step": 6.0})
+    assert summarize_recovery(memkv, "jt") == []
+    recs = load_recovery_records(memkv, "jt")
+    assert recs["s1"]["trainer"]["podA"]["first_step"] == 6.0
+
+
+def test_mixed_partial_and_complete_stages(memkv):
+    """One complete stage + one trainer-only stage: the complete stage
+    summarizes normally; the orphan half can't corrupt the merge."""
+    put(memkv, "jm", "s1", "launcher", "podA",
+        {"detect": 10.0, "killed": 11.0, "barrier": 11.5, "spawn": 12.0})
+    put(memkv, "jm", "s1", "trainer", "podA",
+        {"restored": 14.0, "first_step": 15.0})
+    put(memkv, "jm", "s2", "trainer", "podA",
+        {"restored": 99.0, "first_step": 100.0})
+    stages = summarize_recovery(memkv, "jm")
+    assert [s["stage"] for s in stages] == ["s1"]
+    assert stages[0]["total"] == 5.0
+
+
 def test_earliest_detector_and_last_finisher_win(memkv):
     t0 = 50.0
     put(memkv, "j2", "s", "launcher", "podB",

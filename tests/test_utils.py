@@ -77,3 +77,31 @@ def test_retry_until_timeout_succeeds_then_gives_up():
     with pytest.raises(ValueError):
         hard_error(timeout=1.0)
     assert calls["n"] == 1
+
+
+def test_logger_configure_file_handler_idempotent(tmp_path):
+    """Repeated configure(log_dir=...) must not stack duplicate file
+    handlers (every line would log N times); a DIFFERENT file is a new
+    handler."""
+    import logging
+
+    from edl_tpu.utils.logger import configure
+
+    root = logging.getLogger("edl_tpu")
+    before = list(root.handlers)
+    try:
+        configure(log_dir=str(tmp_path), filename="a.log")
+        configure(log_dir=str(tmp_path), filename="a.log")
+        configure(log_dir=str(tmp_path), filename="a.log")
+        added = [h for h in root.handlers if h not in before]
+        files = [h for h in added if isinstance(h, logging.FileHandler)]
+        assert len(files) == 1
+        configure(log_dir=str(tmp_path), filename="b.log")
+        added = [h for h in root.handlers if h not in before]
+        files = [h for h in added if isinstance(h, logging.FileHandler)]
+        assert len(files) == 2
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+                h.close()
