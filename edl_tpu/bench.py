@@ -73,6 +73,28 @@ def _pipeline_data(size: int, per_file: int, n_files: int) -> list[str]:
 
 
 def main() -> None:
+    """Emit exactly ONE JSON line, always (VERDICT r5 headline): the
+    backend is probed in a short-timeout subprocess before jax touches
+    it (a wedged TPU runtime previously hung ``jax.devices()`` →
+    rc=124, no artifact; now it downgrades to the CPU platform), and
+    any later failure still prints whatever metrics completed, tagged
+    ``partial`` + ``error``, and exits 0."""
+    from edl_tpu.utils.backend import ensure_live_backend
+    ensure_live_backend()
+
+    out: dict = {"metric": "resnet50_train_img_s_per_chip", "value": None,
+                 "unit": "", "n_devices": 0}
+    try:
+        _main_impl(out)
+    except BaseException as e:  # noqa: BLE001 — artifact > stack trace
+        import traceback
+        traceback.print_exc()
+        out["partial"] = True
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
+def _main_impl(out: dict) -> None:
     import jax
     import jax.numpy as jnp
     import optax
@@ -138,6 +160,15 @@ def main() -> None:
     float(metrics["loss"])
     dt = time.perf_counter() - t0
     img_s_chip = bs * n_steps / dt / n_dev
+    # headline lands in ``out`` the moment it exists: a crash in any
+    # later section still ships it in the partial artifact
+    out.update({
+        "value": round(img_s_chip, 1),
+        "unit": f"img/s/chip (bf16, bs {per_dev_bs}/chip, synthetic "
+                f"{size}x{size}, ElasticTrainer dp mesh)",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
+        "n_devices": n_dev,
+    })
 
     # -- flops / MFU ----------------------------------------------------------
     tflops_chip = mfu = None
@@ -240,14 +271,16 @@ def main() -> None:
             import traceback
             traceback.print_exc()
 
-    out = {
-        "metric": "resnet50_train_img_s_per_chip",
-        "value": round(img_s_chip, 1),
-        "unit": f"img/s/chip (bf16, bs {per_dev_bs}/chip, synthetic "
-                f"{size}x{size}, ElasticTrainer dp mesh)",
-        "vs_baseline": round(img_s_chip / BASELINE_IMG_S_PER_CHIP, 3),
-        "n_devices": n_dev,
-    }
+    # -- resize cost: peer-cache vs storage restore (memstate) ---------------
+    # the number ISSUE 2 exists to move — same state, restored once from
+    # a surviving peer's RAM and once from the Orbax directory
+    if os.environ.get("EDL_TPU_BENCH_MEMSTATE", "1") != "0":
+        try:
+            out.update(_bench_memstate())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -266,7 +299,85 @@ def main() -> None:
         out["mfu"] = round(mfu, 3)
     out.update(lm_metrics)
     out.update(distill_metrics)
-    print(json.dumps(out))
+
+
+def _bench_memstate() -> dict:
+    """Resize-restore cost, cache vs storage: save one synthetic state
+    through the real CheckpointManager+tee, then time (a) the peer
+    fetch+reassemble path against a live StateCacheService and (b) the
+    Orbax storage restore of the same step.  Loopback RPC understates
+    the LAN case's bandwidth but keeps every protocol cost real
+    (chunking, CRC, manifest scan, make_array_from_callback)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu import memstate
+    from edl_tpu.cluster.state import State
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.memstate import restore as ms_restore
+    from edl_tpu.memstate.service import StateCacheService
+    from edl_tpu.memstate.tee import StateCacheTee
+    from edl_tpu.rpc.server import RpcServer
+    from edl_tpu.train.checkpoint import CheckpointManager
+
+    mb = int(os.environ.get("EDL_TPU_BENCH_MEMSTATE_MB", 64))
+    n_arrays = 8
+    per = max(1, (mb << 20) // 4 // n_arrays)   # float32 elements each
+    state = {f"w{i}": jnp.asarray(
+        np.random.default_rng(i).normal(size=(per,)).astype(np.float32))
+        for i in range(n_arrays)}
+
+    store = MemoryKV(sweep_period=1.0)
+    tmp = tempfile.mkdtemp(prefix="edl-memstate-bench-")
+    servers, regs = [], []
+    try:
+        # two pods so the measured fetch includes a real replica copy
+        for pid in ("bench-a", "bench-b"):
+            srv = RpcServer("127.0.0.1", 0)
+            srv.register_instance(StateCacheService(store, "bench", pid))
+            srv.start()
+            servers.append(srv)
+            regs.append(memstate.advertise(store, "bench", pid,
+                                           f"127.0.0.1:{srv.port}", ttl=60))
+        tee = StateCacheTee(store, "bench", "bench-a")
+        ck = CheckpointManager(tmp, tee=tee)
+        ck.save(1, state, State())
+        ck.wait()
+        deadline = time.monotonic() + 60
+        while memstate.read_committed_step(store, "bench") is None:
+            if time.monotonic() > deadline:
+                raise TimeoutError("tee never sealed the bench state")
+            time.sleep(0.05)
+
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), state)
+        t0 = time.perf_counter()
+        res = ms_restore.try_restore(store, "bench", abstract, expect_step=1)
+        cache_s = time.perf_counter() - t0
+        assert res is not None, "bench cache restore missed"
+        t0 = time.perf_counter()
+        stored = ck.restore(abstract)
+        storage_s = time.perf_counter() - t0
+        assert stored is not None
+        ck.close()
+        return {
+            "memstate_state_mb": round(sum(
+                v.nbytes for v in state.values()) / 1e6, 1),
+            "memstate_restore_s": round(cache_s, 3),
+            "memstate_storage_restore_s": round(storage_s, 3),
+            "memstate_speedup": round(storage_s / max(cache_s, 1e-9), 2),
+        }
+    finally:
+        for r in regs:
+            r.stop()
+        for s in servers:
+            s.stop()
+        store.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _forever(feed, limit: int):

@@ -61,10 +61,17 @@ def write_launcher_half(store, job_id: str, stage: str, pod_id: str,
 
 
 def write_trainer_half(store, job_id: str, stage: str, pod_id: str,
-                       restored: float, first_step: float) -> None:
+                       restored: float, first_step: float,
+                       restore_source: str | None = None) -> None:
     """Trainer half (checkpoint restored / first post-resize step) —
-    same unified write path as :func:`write_launcher_half`."""
+    same unified write path as :func:`write_launcher_half`.
+    ``restore_source`` records where the state came from:
+    ``"peer"`` (memstate in-RAM cache) or ``"storage"`` (Orbax) — the
+    cache-vs-storage split is the thing the memstate subsystem exists
+    to move, so it lives in the same record as the phase timings."""
     times = {"restored": restored, "first_step": first_step}
+    if restore_source is not None:
+        times["restore_source"] = restore_source
     store.put(
         paths.key(job_id, constants.ETCD_RECOVERY,
                   f"{stage}/trainer/{pod_id}"),
@@ -119,6 +126,12 @@ def summarize_recovery(store, job_id: str,
                     tt["first_step"] - tt["restored"], 3),
                 "total": round(tt["first_step"] - lt["detect"], 3),
             })
+            # "peer" only when EVERY pod restored from the cache — one
+            # storage fallback means the resize still paid storage
+            sources = {t.get("restore_source") for t in trainers.values()}
+            if sources != {None}:
+                entry["restore_source"] = (
+                    "peer" if sources == {"peer"} else "storage")
             if kill_time is not None:
                 entry["kill_to_detect"] = round(lt["detect"] - kill_time, 3)
                 entry["total_from_kill"] = round(

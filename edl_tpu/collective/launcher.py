@@ -60,6 +60,8 @@ class Launcher:
         self._ttl = register_ttl
         self._server = None
         self._data_service: DataService | None = None
+        self._cache_service = None        # memstate peer checkpoint cache
+        self._cache_register = None       # its TTL-leased advert
         self._resource_register = None
         self._elector: LeaderElector | None = None
         self._generator: ClusterGenerator | None = None
@@ -72,11 +74,23 @@ class Launcher:
         self._preempt_deadline: float | None = None
 
     def request_preempt(self) -> None:
-        """SIGTERM entry (signal-handler safe: just sets a flag).  The
-        supervisor loop writes the stage's preempt flag; trainers
-        checkpoint at an agreed step and exit PREEMPT_EXIT_CODE; this
-        pod then departs DESCALED and peers stop-resume from the
-        preemption-point checkpoint (cluster/preempt.py)."""
+        """SIGTERM entry (signal-handler safe: a flag and a deadline,
+        no locks, no I/O).  The supervisor loop writes the stage's
+        preempt flag; trainers checkpoint at an agreed step and exit
+        PREEMPT_EXIT_CODE; this pod then departs DESCALED and peers
+        stop-resume from the preemption-point checkpoint
+        (cluster/preempt.py).
+
+        The grace deadline is armed HERE, not in the supervise loop
+        (ADVICE r5): a SIGTERM that lands before the first barrier
+        completes — ``cluster`` still None — previously armed nothing,
+        so the launcher ignored its eviction notice until the kubelet's
+        SIGKILL.  Now the deadline always ticks from signal time and
+        the deadline check in _supervise (cluster-independent) departs
+        DESCALED with whatever checkpoint exists."""
+        if self._preempt_deadline is None:
+            self._preempt_deadline = (time.monotonic()
+                                      + constants.PREEMPT_GRACE)
         self._preempt_event.set()
 
     # -- lifecycle -----------------------------------------------------------
@@ -91,6 +105,15 @@ class Launcher:
         # — the integration the reference's WIP data server never had
         self._data_service = DataService()
         self._server.register_instance(self._data_service)
+        # the peer checkpoint cache rides the same server for the same
+        # reason: the launcher outlives every trainer kill, so the
+        # latest committed checkpoint stays resident in this host's RAM
+        # across the resize and serves restarting peers (doc/memstate.md)
+        from edl_tpu import memstate
+        if memstate.enabled():
+            self._cache_service = memstate.StateCacheService(
+                self._store, job_id, self._pod.pod_id)
+            self._server.register_instance(self._cache_service)
         self._pod.port = self._server.port
         try:
             final = self._run()
@@ -111,6 +134,14 @@ class Launcher:
         job_id = self._job_env.job_id
         self._resource_register = resource.register_pod(self._store, job_id,
                                                         self._pod, ttl=self._ttl)
+        if self._cache_service is not None:
+            # TTL-leased cache advert next to the pod resource advert:
+            # the advert dying with this launcher is exactly the
+            # liveness signal restoring peers key their fetch plan on
+            from edl_tpu import memstate
+            self._cache_register = memstate.advertise(
+                self._store, job_id, self._pod.pod_id,
+                self._server.endpoint, ttl=self._ttl)
         self._elector = LeaderElector(
             self._store, job_id, self._pod.pod_id,
             on_become_leader=self._start_generator,
@@ -220,10 +251,8 @@ class Launcher:
                     and self._preempt_stage != cluster.stage):
                 # (re)flag for THIS stage — a resize between SIGTERM and
                 # here would otherwise leave the flag on a stage no
-                # trainer reads anymore
-                if self._preempt_deadline is None:
-                    self._preempt_deadline = (time.monotonic()
-                                              + constants.PREEMPT_GRACE)
+                # trainer reads anymore (the grace deadline was already
+                # armed in request_preempt, at signal time)
                 logger.warning("SIGTERM: flagging preemption for stage %s",
                                cluster.stage[:8])
                 from edl_tpu.cluster import preempt
@@ -457,6 +486,8 @@ class Launcher:
         if self._elector:
             self._elector.stop()
         self._stop_generator()
+        if self._cache_register:
+            self._cache_register.stop()
         if self._resource_register:
             self._resource_register.stop()
         if self._server:

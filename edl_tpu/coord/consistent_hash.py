@@ -64,3 +64,24 @@ class ConsistentHash:
     def get_node(self, key: str) -> str | None:
         """Owner of ``key`` (reference get_node_nodes, :138-141)."""
         return self._ring.lookup(key)
+
+    def get_replica(self, key: str, exclude: str) -> str | None:
+        """Owner of ``key`` among nodes other than ``exclude`` — the
+        replica seat for data whose primary is ``exclude`` (memstate
+        peer checkpoint cache: a pod's shards replicate to its ring
+        neighbor, so losing the pod never loses its cache entries).
+        Deterministic for a given node set, and consistent-hash stable:
+        membership changes only move placements that hashed to the
+        changed nodes.  None when no other node exists."""
+        ring = self._ring  # one snapshot: lookups must agree mid-update
+        others = [n for n in ring.nodes if n != exclude]
+        if not others:
+            return None
+        # salt the key until the placement leaves ``exclude``; the salt
+        # cap only guards pathological hash streaks — the deterministic
+        # sorted-order fallback keeps the result total either way
+        for salt in range(64):
+            node = ring.lookup(f"{key}#replica{salt}" if salt else key)
+            if node != exclude:
+                return node
+        return others[0]

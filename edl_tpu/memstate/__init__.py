@@ -1,0 +1,25 @@
+"""In-memory peer checkpoint cache (ISSUE 2).
+
+Keeps the latest committed checkpoint resident in host RAM (the
+launcher process, which survives trainer kills) and serves it to
+restarting trainers over the EDL1 RPC layer, turning the resize
+restore — the measured long pole of stop-resume elasticity — from a
+storage round-trip into a LAN fetch (Gemini, SOSP '23; CheckFreq,
+FAST '21).  Every miss falls back to the Orbax/storage path; the cache
+can make a restore faster, never less safe.  See doc/memstate.md.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.memstate.advert import (  # noqa: F401
+    advertise, list_adverts, read_committed_step, write_committed_step,
+)
+from edl_tpu.memstate.placement import replica_for  # noqa: F401
+from edl_tpu.memstate.service import StateCacheService  # noqa: F401
+from edl_tpu.memstate.tee import StateCacheTee  # noqa: F401
+from edl_tpu.utils import constants as _c
+
+
+def enabled() -> bool:
+    """EDL_TPU_MEMSTATE=0 turns the whole subsystem off."""
+    return bool(_c.MEMSTATE)

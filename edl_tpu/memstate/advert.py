@@ -1,0 +1,67 @@
+"""Coordination-store surface of the peer checkpoint cache.
+
+Two kinds of record under the ``memstate`` table:
+
+- ``nodes/<pod_id>`` → JSON ``{"endpoint": "ip:port"}`` — a TTL-leased
+  advert (coord/register.py) the launcher keeps alive next to its pod
+  resource advert.  The RPC endpoint is the pod server's, which hosts
+  the :class:`~edl_tpu.memstate.service.StateCacheService`; the advert
+  dying with the launcher is the liveness signal restore relies on.
+- ``committed`` → JSON ``{"step": N, "ts": ...}`` — the job-wide
+  "latest checkpoint step fully sealed in the cache" record, written by
+  the primary trainer process only after (a) the Orbax save committed
+  to storage and (b) its shard-set sealed in the local cache.  The
+  cache-first restore refuses any cached step that does not match this
+  record AND the storage's own latest step, so a torn push can never be
+  restored.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+
+
+def _nodes_prefix(job_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_MEMSTATE, "nodes/")
+
+
+def advertise(store, job_id: str, pod_id: str, endpoint: str,
+              ttl: float = constants.ETCD_TTL) -> Register:
+    """TTL-leased cache advert; returns the Register to ``stop()``."""
+    return Register(store,
+                    paths.key(job_id, constants.ETCD_MEMSTATE,
+                              f"nodes/{pod_id}"),
+                    json.dumps({"endpoint": endpoint}).encode(), ttl=ttl)
+
+
+def list_adverts(store, job_id: str) -> dict[str, str]:
+    """Live cache services: ``{pod_id: endpoint}``."""
+    prefix = _nodes_prefix(job_id)
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, str] = {}
+    for rec in recs:
+        try:
+            out[rec.key[len(prefix):]] = json.loads(rec.value.decode())["endpoint"]
+        except (ValueError, KeyError):
+            continue  # torn advert: skip, the lease will expire it
+    return out
+
+
+def write_committed_step(store, job_id: str, step: int) -> None:
+    store.put(paths.key(job_id, constants.ETCD_MEMSTATE, "committed"),
+              json.dumps({"step": int(step), "ts": time.time()}).encode())
+
+
+def read_committed_step(store, job_id: str) -> int | None:
+    rec = store.get(paths.key(job_id, constants.ETCD_MEMSTATE, "committed"))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return int(json.loads(rec.value.decode())["step"])
+    except (ValueError, KeyError):
+        return None

@@ -1,0 +1,23 @@
+"""Replica placement for the peer checkpoint cache.
+
+A pod's cached shard-set replicates to exactly ONE other pod so a
+single pod loss never empties the cache (Gemini's in-memory checkpoint
+replication, SOSP '23, at checkpoint granularity).  Placement rides the
+repo's consistent-hash ring (coord/consistent_hash.py) rather than
+rank-neighbor math: ranks are reassigned on every resize, which would
+re-home every replica per membership change, while the hash ring moves
+only the placements that touched the changed pod.
+"""
+
+from __future__ import annotations
+
+from edl_tpu.coord.consistent_hash import ConsistentHash
+
+
+def replica_for(owner: str, pods: list[str]) -> str | None:
+    """The pod that should hold ``owner``'s replica shard-set, or None
+    when ``owner`` is the only pod.  Pure function of the pod set —
+    every caller (the replicating service, tests, the restore path's
+    expectations) computes the same answer with no coordination."""
+    ring = ConsistentHash(sorted(set(pods)))
+    return ring.get_replica(owner, exclude=owner)

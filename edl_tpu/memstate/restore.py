@@ -1,0 +1,292 @@
+"""Cache-first restore: rebuild a TrainState from surviving peers' RAM.
+
+The consumer half of the peer checkpoint cache.  On a post-resize
+restart the trainer calls :func:`try_restore` BEFORE touching storage;
+the answer is either a fully verified ``(state, meta, info)`` or
+``None`` — never a partial result — and every ``None`` reason is
+counted, so the fallback matrix in doc/memstate.md is observable:
+
+- no live cache adverts / no committed-step record  -> miss
+- committed step != the storage's latest step       -> stale, miss
+- any leaf without full shard coverage at that step -> miss
+- CRC mismatch on a fetched shard (after trying
+  every peer that advertises the shard)             -> miss
+- missing State sidecar                             -> miss
+
+Resharding to the NEW mesh falls out of assembly: shards are placed
+into the full global array by their manifest index boxes, then cut to
+the restore target's sharding via ``jax.make_array_from_callback`` —
+the old and new meshes never need to agree.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+
+from edl_tpu.memstate import advert, shards
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_HITS = obs_metrics.counter(
+    "edl_memstate_cache_hits_total", "Cache-first restores served from peers")
+_MISSES = obs_metrics.counter(
+    "edl_memstate_cache_misses_total",
+    "Cache-first restores that fell back to storage, by reason", ("reason",))
+_FETCHED = obs_metrics.counter(
+    "edl_memstate_bytes_fetched_total",
+    "Checkpoint-cache bytes fetched from peers during restore")
+# the restore the user feels, labeled by where the bytes came from —
+# observed by the trainer for BOTH paths so the cache-vs-storage win is
+# one PromQL ratio (doc/memstate.md)
+RESTORE_SECONDS = obs_metrics.histogram(
+    "edl_state_restore_seconds",
+    "Train-state restore wall time, by source", ("source",),
+    buckets=obs_metrics.RESIZE_BUCKETS)
+
+
+def _miss(reason: str) -> None:
+    _MISSES.labels(reason=reason).inc()
+    logger.info("memstate: cache miss (%s); falling back to storage", reason)
+
+
+def try_restore(store, job_id: str, abstract_state,
+                expect_step: int | None = None):
+    """Returns ``(state, meta_json_str, info)`` or None (= use storage).
+
+    ``abstract_state``: pytree of ShapeDtypeStructs WITH target
+    shardings (the trainer's AOT skeleton for the new mesh).
+    ``expect_step``: the storage's latest committed step — a cached set
+    at any other step is stale by definition and refused.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    committed = advert.read_committed_step(store, job_id)
+    if committed is None:
+        _miss("no_committed_record")
+        return None
+    if expect_step is not None and committed != expect_step:
+        _miss("stale")
+        return None
+    endpoints = advert.list_adverts(store, job_id)
+    if not endpoints:
+        _miss("no_adverts")
+        return None
+
+    from edl_tpu.rpc.client import RpcClient
+    clients: dict[str, RpcClient] = {}
+    try:
+        # where is each shard of the committed step? several pods may
+        # hold a copy (owner + its ring replica): keep them ALL as
+        # candidates so one bad/corrupt holder doesn't fail the restore
+        holders: dict[str, list[tuple[str, dict, str]]] = {}
+        meta_holders: list[tuple[str, str]] = []  # (pod, owner)
+        for pod, ep in endpoints.items():
+            try:
+                clients[pod] = RpcClient(ep)
+                listing = clients[pod].call("cache_manifest")
+            except Exception:  # noqa: BLE001 — a dead peer is not fatal
+                logger.warning("memstate: peer %s unreachable", pod[:8])
+                continue
+            for owner, info in listing.items():
+                if info["step"] != committed:
+                    continue
+                for key, ent in info["shards"].items():
+                    holders.setdefault(key, []).append((pod, ent, owner))
+                if info.get("has_meta"):
+                    meta_holders.append((pod, owner))
+        if not holders:
+            _miss("empty")
+            return None
+
+        info = {"step": committed, "shards": 0, "bytes": 0,
+                "peers": sorted({p for hs in holders.values()
+                                 for p, _, _ in hs})}
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+        out_leaves = []
+        for path, leaf in leaves:
+            if not hasattr(leaf, "sharding") or leaf.sharding is None:
+                _miss("unsupported_leaf")
+                return None
+            leaf_name = jax.tree_util.keystr(path)
+            local = _assemble_leaf(leaf_name, leaf, holders, clients, info)
+            if local is None:
+                return None  # _assemble_leaf counted the reason
+            gshape = tuple(int(d) for d in leaf.shape)
+            out_leaves.append(jax.make_array_from_callback(
+                leaf.shape, leaf.sharding,
+                lambda idx, a=local, g=gshape: a[_norm_box(idx, g)]))
+        meta_json = _fetch_meta(meta_holders, clients)
+        if meta_json is None:
+            _miss("no_meta")
+            return None
+        state = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        _HITS.inc()
+        info["seconds"] = round(time.perf_counter() - t0, 3)
+        logger.info("memstate: restored step %d from peers %s "
+                    "(%d shards, %.1f MB, %.2fs)", committed,
+                    [p[:8] for p in info["peers"]], info["shards"],
+                    info["bytes"] / 1e6, info["seconds"])
+        return state, meta_json, info
+    finally:
+        for c in clients.values():
+            c.close()
+
+
+def _np_dtype(name: str):
+    """np.dtype by name, including jax's ml_dtypes extras (bfloat16)."""
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# the ONE slice->box normalizer, shared with the producing tee so the
+# two ends of the wire format can never drift (shards.norm_box)
+_norm_box = shards.norm_box
+
+
+def _assemble_leaf(leaf_name, leaf, holders, clients, info):
+    """The boxes THIS process's addressable target shards need, as
+    ``{box: np array}``, or None (miss counted).
+
+    Only manifest shards intersecting a locally-needed box are fetched
+    — the restore's network and host-RAM cost scale with this
+    process's share of the model, not the whole checkpoint (a
+    full-model materialization would OOM exactly the sharded models
+    the cache exists for, and silently demote every restore to
+    storage).  Each fetched shard is verified then scattered into the
+    needed boxes it overlaps; exact per-box coverage masks (bounded by
+    local shard size) replace a global coverage array."""
+    import numpy as np
+
+    gshape = tuple(int(d) for d in leaf.shape)
+    # distinct boxes available for this leaf (same-key entries across
+    # pods are candidate copies of the SAME box)
+    boxes = {k: hs for k, hs in holders.items()
+             if hs[0][1].get("leaf") == leaf_name}
+    if not boxes:
+        _miss("missing_leaf")
+        return None
+    ent0 = next(iter(boxes.values()))[0][1]
+    if tuple(ent0["gshape"]) != gshape or \
+            str(ent0["dtype"]) != str(np.dtype(leaf.dtype)):
+        _miss("shape_mismatch")
+        return None
+    needed = {_norm_box(idx, gshape)
+              for idx in leaf.sharding.addressable_devices_indices_map(
+                  gshape).values()}
+    out: dict[tuple, np.ndarray] = {}
+    cov: dict[tuple, np.ndarray] = {}
+    for box in needed:
+        shape = tuple(b - a for a, b in box)
+        out[box] = np.empty(shape, dtype=leaf.dtype)
+        cov[box] = np.zeros(shape, dtype=bool)
+    for key, candidates in boxes.items():
+        ent = candidates[0][1]
+        src = tuple((int(a), int(b)) for a, b in ent["index"])
+        # `is not None`, not truthiness: a scalar leaf's intersection
+        # is the empty box () — falsy, but a real overlap
+        overlaps = [b for b in needed if _intersect(src, b) is not None]
+        if not overlaps:
+            continue  # another process's share
+        data = _fetch_verified(key, candidates, clients)
+        if data is None:
+            # every advertised holder failed (unreachable or CRC-bad)
+            _miss("shard_unavailable")
+            return None
+        piece = np.frombuffer(data, dtype=_np_dtype(ent["dtype"])) \
+            .reshape(ent["shape"])
+        for box in overlaps:
+            isect = _intersect(src, box)
+            psel = tuple(slice(a - s[0], b - s[0])
+                         for (a, b), s in zip(isect, src))
+            osel = tuple(slice(a - t[0], b - t[0])
+                         for (a, b), t in zip(isect, box))
+            out[box][osel] = piece[psel]
+            cov[box][osel] = True
+        info["shards"] += 1
+        info["bytes"] += len(data)
+        _FETCHED.inc(len(data))
+    if not all(c.all() for c in cov.values()):
+        _miss("incomplete_coverage")
+        return None
+    return out
+
+
+def _intersect(a: tuple, b: tuple):
+    """Intersection box of two ((start, stop), ...) boxes, or None.
+    Zero-dim (scalar) boxes always intersect as the empty box."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _fetch_verified(key, candidates, clients) -> bytes | None:
+    """Fetch one shard from any holder whose bytes match the manifest
+    CRC; every candidate exhausted -> None."""
+    import functools
+
+    from edl_tpu.rpc import chunks
+    for pod, ent, owner in candidates:
+        client = clients.get(pod)
+        if client is None:
+            continue
+        try:
+            data = chunks.fetch_bytes(
+                functools.partial(client.call, "cache_fetch",
+                                  owner=owner, key=key),
+                int(ent["nbytes"]))
+        except Exception:  # noqa: BLE001 — try the next holder
+            logger.warning("memstate: fetch of %s from %s failed",
+                           key, pod[:8])
+            continue
+        if zlib.crc32(data) == int(ent["crc"]):
+            return data
+        logger.warning("memstate: CRC mismatch for %s from %s", key, pod[:8])
+    return None
+
+
+def _fetch_meta(meta_holders, clients) -> str | None:
+    for pod, owner in meta_holders:
+        client = clients.get(pod)
+        if client is None:
+            continue
+        try:
+            raw = client.call("cache_meta", owner=owner)
+        except Exception:  # noqa: BLE001
+            continue
+        if raw:
+            return bytes(raw).decode()
+    return None
+
+
+def assert_bit_identical(cache_state, storage_state) -> None:
+    """Every addressable shard of every leaf equal, bit for bit — the
+    e2e verification hook (EDL_TPU_MEMSTATE_VERIFY=1)."""
+    import jax
+    import numpy as np
+
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(cache_state)[0],
+            jax.tree_util.tree_flatten_with_path(storage_state)[0]):
+        assert pa == pb, f"leaf order diverged: {pa} vs {pb}"
+        if not hasattr(a, "addressable_shards"):
+            continue
+        sa = sorted(a.addressable_shards, key=lambda s: str(s.index))
+        sb = sorted(b.addressable_shards, key=lambda s: str(s.index))
+        for x, y in zip(sa, sb):
+            if not np.array_equal(np.asarray(x.data), np.asarray(y.data),
+                                  equal_nan=True):
+                raise AssertionError(
+                    f"peer restore diverged from storage at "
+                    f"{jax.tree_util.keystr(pa)}{x.index}")

@@ -1,0 +1,277 @@
+"""StateCacheService: the per-pod in-RAM checkpoint shard cache.
+
+Lives in the LAUNCHER process, registered on the pod's RPC server next
+to the DataService — the launcher survives every trainer kill (resize,
+hang restart, preemption), so the cache does too; that lifetime split
+is the whole point (ISSUE 2: resize restores from surviving hosts' RAM,
+not storage).
+
+Data model: one *shard-set* per (owner pod, step) — the host-local
+array shards the owner's trainers pushed from their most recent
+committed save, plus the JSON State sidecar.  A service holds at most
+one committed set per owner: its own pod's, and replicas of any owner
+that placed here via the hash ring (placement.replica_for — normally
+exactly one ring neighbor).  Staged (uncommitted) chunks live apart and
+are promoted atomically by ``cache_commit`` after per-shard CRC
+verification, so a reader can never observe a torn set.
+
+All methods are RPC handlers (thread-per-connection server): one lock
+around the maps; chunk appends hold it only for the append.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+from edl_tpu.memstate import advert, placement
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlInternalError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_BYTES_SERVED = obs_metrics.counter(
+    "edl_memstate_bytes_served_total",
+    "Checkpoint-cache bytes served to restoring peers")
+_BYTES_CACHED = obs_metrics.gauge(
+    "edl_memstate_bytes_cached", "Bytes resident in the checkpoint cache")
+_PUSH_REJECTS = obs_metrics.counter(
+    "edl_memstate_push_rejects_total",
+    "Shard pushes rejected (memory cap / protocol)", ("reason",))
+_SETS_COMMITTED = obs_metrics.counter(
+    "edl_memstate_sets_committed_total",
+    "Shard-sets sealed in the cache, by role", ("role",))
+
+
+class _Set:
+    """One committed shard-set: ``{key: bytes}`` + manifest + sidecar."""
+
+    __slots__ = ("step", "shards", "manifest", "meta")
+
+    def __init__(self, step: int):
+        self.step = step
+        self.shards: dict[str, bytes] = {}
+        self.manifest: dict[str, dict] = {}
+        self.meta: bytes | None = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(b) for b in self.shards.values())
+
+
+class _Staging:
+    __slots__ = ("buf", "next_seq", "done", "t_start")
+
+    def __init__(self):
+        self.buf = bytearray()
+        self.next_seq = 0
+        self.done = False
+        self.t_start = time.monotonic()
+
+
+class StateCacheService:
+    """RPC-facing cache; every public method is wire surface (the pod
+    server's ``register_instance`` exposes them), hence the ``cache_``
+    prefix to keep the shared method namespace collision-free."""
+
+    def __init__(self, store, job_id: str, pod_id: str,
+                 max_bytes: int | None = None):
+        self._store = store
+        self._job_id = job_id
+        self._pod_id = pod_id
+        self._max_bytes = (constants.MEMSTATE_MAX_BYTES
+                           if max_bytes is None else max_bytes)
+        self._lock = threading.Lock()
+        self._sets: dict[str, _Set] = {}            # owner -> committed set
+        self._staging: dict[tuple[str, int, str], _Staging] = {}
+
+    # -- push (trainer tee / replicating peer) -----------------------------
+    def cache_put_chunk(self, owner: str, step: int, key: str, seq: int,
+                        data: bytes, eof: bool) -> dict:
+        with self._lock:
+            sk = (owner, int(step), key)
+            st = self._staging.get(sk)
+            if seq == 0:
+                # a fresh stream REPLACES any stale staging for this
+                # key: the service outlives trainer processes, so a
+                # push killed mid-stream (resize, preemption) must not
+                # poison the restarted trainer's re-push of the step
+                st = self._staging[sk] = _Staging()
+            elif st is None or seq != st.next_seq:
+                self._staging.pop(sk, None)
+                _PUSH_REJECTS.labels(reason="seq").inc()
+                raise EdlInternalError(
+                    f"chunk seq {seq} != expected "
+                    f"{st.next_seq if st else 0} for {key}")
+            if self._over_cap(len(data), owner, int(step)):
+                # drop the whole stream: a partial shard is useless and
+                # the bytes are better spent on sets that can complete
+                self._staging.pop(sk, None)
+                _PUSH_REJECTS.labels(reason="cap").inc()
+                raise EdlInternalError(
+                    f"cache over {self._max_bytes}B cap; rejecting {key}")
+            st.buf.extend(data)
+            st.next_seq += 1
+            st.done = bool(eof)
+        return {"ok": True}
+
+    def cache_commit(self, owner: str, step: int, manifest: dict,
+                     meta: bytes | None = None) -> dict:
+        """Seal the staged shards named by ``manifest`` into ``owner``'s
+        committed set (merging with an existing set at the SAME step —
+        multi-process pods push independently).  CRC/length verified
+        here, under the lock, so the committed map only ever holds
+        shards that match their manifest entries."""
+        step = int(step)
+        with self._lock:
+            staged: dict[str, bytes] = {}
+            for key, ent in manifest.items():
+                st = self._staging.get((owner, step, key))
+                if st is None or not st.done:
+                    raise EdlInternalError(f"commit of unstaged shard {key}")
+                data = bytes(st.buf)
+                if len(data) != int(ent["nbytes"]) or \
+                        zlib.crc32(data) != int(ent["crc"]):
+                    self._staging.pop((owner, step, key), None)
+                    _PUSH_REJECTS.labels(reason="crc").inc()
+                    raise EdlInternalError(
+                        f"shard {key} failed CRC/length verification")
+                staged[key] = data
+            cur = self._sets.get(owner)
+            if cur is not None and cur.step > step:
+                # a newer set already committed; this late push is stale
+                for key in manifest:
+                    self._staging.pop((owner, step, key), None)
+                return {"ok": False, "stale": True}
+            if cur is None or cur.step < step:
+                cur = self._sets[owner] = _Set(step)
+            for key, data in staged.items():
+                cur.shards[key] = data
+                cur.manifest[key] = dict(manifest[key])
+                self._staging.pop((owner, step, key), None)
+            if meta is not None:
+                cur.meta = bytes(meta)
+            # older staged chunks for this owner can never commit now
+            for sk in [sk for sk in self._staging
+                       if sk[0] == owner and sk[1] < step]:
+                self._staging.pop(sk, None)
+            self._account_locked()
+        _SETS_COMMITTED.labels(
+            role="own" if owner == self._pod_id else "replica").inc()
+        if owner == self._pod_id:
+            # replicate own sets only (a replica replicating onward
+            # would walk the whole ring); thread keeps commit non-blocking
+            threading.Thread(target=self._replicate, args=(owner, step),
+                             daemon=True,
+                             name=f"memstate-repl:{step}").start()
+        return {"ok": True}
+
+    # -- read (restoring trainers) -----------------------------------------
+    def cache_manifest(self) -> dict:
+        """Every committed set held here:
+        ``{owner: {"step", "shards": manifest, "has_meta"}}``."""
+        with self._lock:
+            return {owner: {"step": s.step, "shards": s.manifest,
+                            "has_meta": s.meta is not None}
+                    for owner, s in self._sets.items()}
+
+    def cache_fetch(self, owner: str, key: str, offset: int,
+                    length: int) -> bytes:
+        with self._lock:
+            s = self._sets.get(owner)
+            if s is None or key not in s.shards:
+                raise EdlInternalError(f"no cached shard {owner}/{key}")
+            data = s.shards[key][int(offset):int(offset) + int(length)]
+        _BYTES_SERVED.inc(len(data))
+        return data
+
+    def cache_meta(self, owner: str) -> bytes | None:
+        with self._lock:
+            s = self._sets.get(owner)
+            return None if s is None else s.meta
+
+    def cache_stats(self) -> dict:
+        with self._lock:
+            return {
+                "pod": self._pod_id,
+                "owners": {o: {"step": s.step, "shards": len(s.shards),
+                               "nbytes": s.nbytes}
+                           for o, s in self._sets.items()},
+                "staging": len(self._staging),
+                "max_bytes": self._max_bytes,
+            }
+
+    # -- internals ---------------------------------------------------------
+    def _over_cap(self, incoming: int, owner: str, step: int) -> bool:
+        """Admission check for one more chunk of ``owner``'s ``step``.
+
+        The owner's own committed set at an OLDER step does not count:
+        the incoming step supersedes it at commit, and counting it
+        would deadlock any cap between 1x and 2x the working set (the
+        old set can only be evicted by the very commit the cap keeps
+        rejecting).  The cap is therefore a soft bound — residency can
+        transiently reach cap + one superseded set while a replacement
+        stages."""
+        if not self._max_bytes:
+            return False
+        held = sum(s.nbytes for o, s in self._sets.items()
+                   if not (o == owner and s.step < step)) + \
+            sum(len(st.buf) for st in self._staging.values())
+        return held + incoming > self._max_bytes
+
+    def _account_locked(self) -> None:
+        _BYTES_CACHED.set(sum(s.nbytes for s in self._sets.values()))
+
+    def _replicate(self, owner: str, step: int) -> None:
+        """Push ``owner``'s committed set to its ring-placed replica pod
+        (best-effort: a failed replication only costs redundancy; the
+        next commit retries from scratch)."""
+        try:
+            adverts = advert.list_adverts(self._store, self._job_id)
+            target = placement.replica_for(owner, list(adverts))
+            if target is None or target == self._pod_id:
+                return
+            endpoint = adverts.get(target)
+            if endpoint is None:
+                return
+            with self._lock:
+                s = self._sets.get(owner)
+                if s is None or s.step != step:
+                    return  # superseded while the thread started
+                shards = dict(s.shards)
+                manifest = {k: dict(v) for k, v in s.manifest.items()}
+                meta = s.meta
+            import functools
+
+            from edl_tpu.rpc import chunks
+            from edl_tpu.rpc.client import RpcClient
+            with RpcClient(endpoint) as client:
+                # delta replication: skip shards the target already
+                # holds at this step with the same CRC — a sidecar-only
+                # patch (save_meta -> update_meta -> re-commit) must
+                # not re-ship the whole multi-GB set per epoch
+                theirs = {}
+                try:
+                    listing = client.call("cache_manifest").get(owner)
+                    if listing and listing["step"] == step:
+                        theirs = listing["shards"]
+                except Exception:  # noqa: BLE001 — treat as cold target
+                    pass
+                todo = {k: v for k, v in shards.items()
+                        if k not in theirs
+                        or theirs[k].get("crc") != manifest[k]["crc"]}
+                for key, data in todo.items():
+                    chunks.push_bytes(
+                        functools.partial(client.call, "cache_put_chunk",
+                                          owner=owner, step=step, key=key),
+                        data)
+                client.call("cache_commit", owner=owner, step=step,
+                            manifest={k: manifest[k] for k in todo},
+                            meta=meta)
+            logger.info("replicated step %d (%d/%d shards) to %s", step,
+                        len(todo), len(shards), target[:8])
+        except Exception:  # noqa: BLE001 — redundancy is best-effort
+            logger.exception("replication of step %d failed", step)

@@ -1,0 +1,87 @@
+"""Shard naming + host snapshotting shared by the tee (producer) and
+the cache-first restore (consumer).
+
+A *shard* is one host-local piece of one array leaf: the bytes of
+``np.asarray(jax_shard.data)`` plus enough manifest metadata to place
+it back into a global array of any NEW sharding — leaf path, global
+shape/dtype, and the global index box.  Producer and consumer meeting
+only through these keys/manifests is what lets a restore assemble a
+pod's arrays from whichever surviving peer holds them.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any
+
+import numpy as np
+
+
+def norm_box(index, gshape) -> tuple:
+    """Index tuple of slices -> hashable ``((start, stop), ...)`` box.
+
+    THE canonical slice normalizer for the shard wire format: the tee
+    writes manifests with it and the restore re-derives boxes with it,
+    so the two sides can never drift on None/0 handling."""
+    return tuple((int(sl.start or 0),
+                  int(dim if sl.stop is None else sl.stop))
+                 for sl, dim in zip(index, gshape))
+
+
+def _norm_index(index, gshape) -> list[list[int]]:
+    """:func:`norm_box` as nested lists (the manifest JSON shape)."""
+    return [[a, b] for a, b in norm_box(index, gshape)]
+
+
+def shard_key(leaf: str, box: list[list[int]]) -> str:
+    return leaf + "@" + ",".join(f"{a}:{b}" for a, b in box)
+
+
+def snapshot(state: Any) -> tuple[list[tuple[str, np.ndarray]], dict]:
+    """Host-copy every addressable shard of every array leaf of
+    ``state``.  Returns ``(shards, manifest)`` where shards is
+    ``[(key, np_array)]`` and manifest maps key -> entry (CRC left 0 —
+    the tee's worker computes it off the step path; the device->host
+    copy itself must happen HERE, before the caller's next donated step
+    invalidates the buffers).
+
+    Only ``replica_id == 0`` shards are taken, so replicated arrays are
+    pushed once per distinct data box per host set; the union over a
+    pod's processes covers every leaf at least once."""
+    import jax
+
+    shards: list[tuple[str, np.ndarray]] = []
+    manifest: dict[str, dict] = {}
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, arr in leaves:
+        if not hasattr(arr, "addressable_shards"):
+            continue  # non-array leaf: Orbax owns it; cache skips it
+        leaf = jax.tree_util.keystr(path)
+        gshape = tuple(int(d) for d in arr.shape)
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            data = np.asarray(sh.data)
+            box = _norm_index(sh.index, gshape)
+            key = shard_key(leaf, box)
+            shards.append((key, data))
+            manifest[key] = {
+                "crc": 0, "nbytes": int(data.nbytes),
+                "dtype": str(data.dtype),
+                "shape": [int(d) for d in data.shape],
+                "index": box, "gshape": list(gshape), "leaf": leaf,
+            }
+    return shards, manifest
+
+
+def finish_manifest(shards: list[tuple[str, np.ndarray]],
+                    manifest: dict) -> dict[str, bytes]:
+    """CRC + serialize (the worker-thread half): returns key->bytes and
+    fills the manifest's ``crc`` fields in place."""
+    blobs: dict[str, bytes] = {}
+    for key, arr in shards:
+        data = np.ascontiguousarray(arr).tobytes()
+        manifest[key]["crc"] = zlib.crc32(data)
+        manifest[key]["nbytes"] = len(data)
+        blobs[key] = data
+    return blobs

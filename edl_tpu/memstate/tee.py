@@ -1,0 +1,160 @@
+"""StateCacheTee: trainer-side producer for the peer checkpoint cache.
+
+``CheckpointManager.save`` calls :meth:`stage` after queueing the Orbax
+save: the device->host shard copy happens synchronously (the very next
+train step donates the state buffers, so it cannot be deferred — the
+same constraint Orbax's own async save works under), everything else
+(CRC, serialization, chunked RPC push to the local pod's cache service)
+runs on one background worker thread, off the step path.
+
+Sealing is two-phase on purpose: a pushed set stays *staged* in the
+service until :meth:`mark_committed` — called from
+``CheckpointManager.wait()``, i.e. only once Orbax confirms the save is
+durable — promotes it and (primary process only) writes the job-wide
+committed-step record.  A cache entry can therefore never claim a step
+that storage does not also have, which is the invariant the cache-first
+restore's staleness check leans on.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+
+from edl_tpu.memstate import advert, shards
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class StateCacheTee:
+    def __init__(self, store, job_id: str, pod_id: str):
+        self._store = store
+        self._job_id = job_id
+        self._pod_id = pod_id
+        self._q: queue.Queue = queue.Queue()
+        self._client = None
+        self._pushed_step: int | None = None   # worker-local state
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="memstate-tee")
+        self._worker.start()
+
+    # -- producer side (train loop; must stay cheap) ------------------------
+    def stage(self, step: int, state, meta) -> None:
+        """Host-snapshot ``state``'s shards and queue the push.  The
+        snapshot is the only synchronous cost (same D2H copy Orbax's
+        async save already pays for its own staging)."""
+        import jax
+        shard_list, manifest = shards.snapshot(state)
+        meta_json = None
+        if meta is not None and jax.process_index() == 0:
+            meta_json = meta.to_json().encode()
+        self._q.put(("push", int(step), shard_list, manifest, meta_json))
+
+    def mark_committed(self, flush_timeout: float = 10.0) -> None:
+        """The storage save is durable (wait_until_finished returned):
+        seal everything pushed so far, and wait (bounded) for the seal
+        to land.  The bounded wait matters at the exits — preemption
+        and final-epoch teardown ``os._exit`` right after
+        ``CheckpointManager.wait()``, and an unsealed set means the
+        survivors restore from storage at exactly the moment the cache
+        is most valuable.  In steady state the shards were already
+        pushed during the epoch, so this waits only for the commit
+        RPC; ``flush_timeout`` caps the cost when a peer is slow."""
+        self._q.put(("commit",))
+        if flush_timeout > 0:
+            done = threading.Event()
+            self._q.put(("flush", done))
+            done.wait(flush_timeout)
+
+    def update_meta(self, step: int, meta) -> None:
+        """Re-push just the sidecar of an already-sealed step (mirrors
+        CheckpointManager.save_meta's cheap sidecar patch)."""
+        import jax
+        if jax.process_index() != 0:
+            return
+        self._q.put(("meta", int(step), meta.to_json().encode()))
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._worker.join(timeout=30.0)
+        if self._client is not None:
+            self._client.close()
+
+    # -- worker side ---------------------------------------------------------
+    def _run(self) -> None:
+        pending: dict[int, tuple[dict, bytes | None]] = {}  # pushed, unsealed
+        while True:
+            op = self._q.get()
+            if op is None:
+                return
+            try:
+                if op[0] == "push":
+                    _, step, shard_list, manifest, meta_json = op
+                    # a newer save supersedes anything older still queued
+                    if self._pushed_step is not None and \
+                            step <= self._pushed_step:
+                        continue
+                    self._push(step, shard_list, manifest)
+                    pending[step] = (manifest, meta_json)
+                    self._pushed_step = step
+                elif op[0] == "commit":
+                    for step in sorted(pending):
+                        manifest, meta_json = pending.pop(step)
+                        resp = self._call("cache_commit", owner=self._pod_id,
+                                          step=step, manifest=manifest,
+                                          meta=meta_json)
+                        if not (resp or {}).get("ok"):
+                            # the service refused (e.g. a newer step
+                            # already sealed): publishing the record
+                            # would advertise a step with no shard-set
+                            continue
+                        if meta_json is not None:
+                            advert.write_committed_step(self._store,
+                                                        self._job_id, step)
+                elif op[0] == "flush":
+                    op[1].set()
+                elif op[0] == "meta":
+                    _, step, meta_json = op
+                    import zlib
+                    key = "__meta__"  # sealed sidecar patch: tiny re-commit
+                    from edl_tpu.rpc import chunks
+                    chunks.push_bytes(
+                        functools.partial(self._call, "cache_put_chunk",
+                                          owner=self._pod_id, step=step,
+                                          key=key), meta_json)
+                    self._call("cache_commit", owner=self._pod_id, step=step,
+                               manifest={key: {"crc": zlib.crc32(meta_json),
+                                               "nbytes": len(meta_json),
+                                               "dtype": "meta", "shape": [],
+                                               "index": [], "gshape": [],
+                                               "leaf": key}},
+                               meta=meta_json)
+            except Exception:  # noqa: BLE001 — the cache is best-effort
+                logger.exception("memstate tee op %s failed; the next "
+                                 "restore will fall back to storage", op[0])
+                self._client = None  # reconnect on next op
+
+    def _push(self, step: int, shard_list, manifest) -> None:
+        from edl_tpu.rpc import chunks
+        blobs = shards.finish_manifest(shard_list, manifest)
+        for key, data in blobs.items():
+            chunks.push_bytes(
+                functools.partial(self._call, "cache_put_chunk",
+                                  owner=self._pod_id, step=step, key=key),
+                data)
+        logger.info("memstate: staged step %d (%d shards, %d bytes) to "
+                    "local cache", step, len(blobs),
+                    sum(len(b) for b in blobs.values()))
+
+    def _call(self, method: str, **kw):
+        if self._client is None:
+            eps = advert.list_adverts(self._store, self._job_id)
+            ep = eps.get(self._pod_id)
+            if ep is None:
+                raise ConnectionError(
+                    f"no memstate advert for own pod {self._pod_id[:8]}")
+            from edl_tpu.rpc.client import RpcClient
+            self._client = RpcClient(ep)
+        return self._client.call(method, **kw)

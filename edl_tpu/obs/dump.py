@@ -52,7 +52,10 @@ def render_report(report: dict) -> str:
     ]
     for s in resizes:
         done = "" if "total" in s else "  [launcher half only]"
-        lines.append(f"  resize {s['stage']} @ {s['detect_at']:.3f}{done}")
+        src = (f"  restore_source={s['restore_source']}"
+               if "restore_source" in s else "")
+        lines.append(f"  resize {s['stage']} @ {s['detect_at']:.3f}"
+                     f"{done}{src}")
         for phase in PHASE_ORDER:
             if phase in s:
                 lines.append(f"    {phase:<24} {s[phase]:>9.3f}s")

@@ -1,0 +1,67 @@
+"""Chunked byte-blob transfer over the EDL1 RPC envelope.
+
+The framing layer caps a frame at 1 GiB, but a multi-MB payload in one
+frame still serializes the whole blob through msgpack, holds it twice
+in memory on each side, and monopolizes the pooled connection for the
+full transfer.  Checkpoint shards (memstate peer cache) are tens to
+hundreds of MB, so they stream as a sequence of bounded chunks instead:
+
+- **push**: ``call(seq=i, data=<chunk>, eof=bool)`` per chunk, strictly
+  ordered on one connection; the receiver appends and validates ``seq``
+  so a dropped/duplicated frame surfaces as a typed error, not silent
+  corruption;
+- **fetch**: ``call(offset=o, length=n) -> bytes`` per chunk; the
+  caller knows the total size from the shard manifest and re-assembles.
+
+Both helpers take a ``call`` callable (typically
+``functools.partial(RpcClient.call, "method", **identity_kwargs)``) so
+any service can reuse them without this module knowing method names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from edl_tpu.utils import constants
+
+DEFAULT_CHUNK_BYTES = constants.MEMSTATE_CHUNK_BYTES
+
+
+def push_bytes(call: Callable[..., object], data: bytes,
+               chunk_bytes: int = 0) -> int:
+    """Send ``data`` as an ordered chunk sequence; returns chunk count.
+
+    ``call`` receives ``seq`` (0-based), ``data`` (the chunk) and
+    ``eof`` (True on the final chunk).  Empty payloads still send one
+    empty eof chunk so the receiver always observes a complete stream.
+    """
+    chunk_bytes = chunk_bytes or DEFAULT_CHUNK_BYTES
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    n = max(1, -(-len(data) // chunk_bytes))  # ceil; >=1 for empty data
+    for seq in range(n):
+        off = seq * chunk_bytes
+        call(seq=seq, data=bytes(data[off:off + chunk_bytes]),
+             eof=seq == n - 1)
+    return n
+
+
+def fetch_bytes(call: Callable[..., bytes], nbytes: int,
+                chunk_bytes: int = 0) -> bytes:
+    """Fetch ``nbytes`` as bounded chunks; ``call(offset=, length=)``
+    must return exactly the requested slice (short reads are protocol
+    errors — the size came from the same manifest as the data)."""
+    chunk_bytes = chunk_bytes or DEFAULT_CHUNK_BYTES
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    out = bytearray()
+    while len(out) < nbytes:
+        want = min(chunk_bytes, nbytes - len(out))
+        got = call(offset=len(out), length=want)
+        if not isinstance(got, (bytes, bytearray)) or len(got) != want:
+            raise ConnectionError(
+                f"chunk fetch at {len(out)} returned "
+                f"{len(got) if isinstance(got, (bytes, bytearray)) else type(got)}"
+                f" of {want} requested bytes")
+        out.extend(got)
+    return bytes(out)

@@ -162,6 +162,8 @@ class ContinuousBatcher:
         self._enqueue_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._done_requests = 0
+        self._submitted_requests = 0  # accepted submits (enqueue lock)
+        self._failed_requests = 0     # futures failed while engine lives
         self._emitted_tokens = 0
         self._moe_drops = 0       # MoE prefill capacity overflow (see stats)
         self._lane_steps = 0          # slot-steps actually dispatched
@@ -210,6 +212,7 @@ class ContinuousBatcher:
         with self._enqueue_lock:
             if self._stopping:
                 raise RuntimeError("engine stopping")
+            self._submitted_requests += 1
             self._queue.put(req)
         return req.future
 
@@ -224,7 +227,21 @@ class ContinuousBatcher:
         PREFILL_KS sub-batch size — BEFORE traffic arrives.  A compile
         inside the serving path stalls every live lane (minutes on a
         remote-compiler backend); call this after construction, before
-        submitting.  Thread-safe only while no requests are in flight."""
+        submitting.  Thread-safe only while no requests are in flight —
+        ENFORCED here: a warm() racing live traffic shares the donated
+        pool-cache buffers with the engine thread's step/insert jits,
+        so misuse must fail loudly, not corrupt running generations.
+        The guard counts submitted-vs-completed requests (not slot/
+        queue state, which goes momentarily empty while the engine
+        thread is mid-admission between queue pop and slot insert)."""
+        with self._enqueue_lock, self._stats_lock:
+            in_flight = (self._submitted_requests - self._done_requests
+                         - self._failed_requests)
+        if in_flight:
+            raise RuntimeError(
+                f"ContinuousBatcher.warm() called with {in_flight} "
+                "request(s) in flight; warm() must run after "
+                "construction, before the first submit()")
         key = jax.random.key(0)
         P = self._bucket(prompt_len)
         for K in self.PREFILL_KS:   # __init__ already filtered by slots
@@ -479,6 +496,8 @@ class ContinuousBatcher:
             if pre is not None:
                 for req in pre[4]:
                     req.future.set_exception(e)
+                with self._stats_lock:
+                    self._failed_requests += len(pre[4])
             raise
         if dec_np is not None:
             self._finish_decode(dec_np, len(active))
@@ -486,10 +505,14 @@ class ContinuousBatcher:
             self._finish_prefill(slots, reqs, ptoks_np, drops)
 
     def _fail_all(self, e: Exception) -> None:
+        n = 0
         for s in self._slots:
             if s.request is not None:
                 s.request.future.set_exception(e)
                 s.request = None
+                n += 1
+        with self._stats_lock:
+            self._failed_requests += n
 
     def _any_active(self) -> bool:
         return any(not s.free for s in self._slots)
@@ -543,6 +566,8 @@ class ContinuousBatcher:
             logger.exception("prefill failed (bucket %d, %d reqs)", P, K)
             for req in reqs:
                 req.future.set_exception(e)
+            with self._stats_lock:
+                self._failed_requests += len(reqs)
             return None
 
     def _finish_prefill(self, slots: list[int], reqs: list[_Request],

@@ -40,13 +40,26 @@ _RESTORE_SECONDS = obs_metrics.histogram(
     "edl_checkpoint_restore_seconds", "Checkpoint restore (seconds)")
 _SAVES_TOTAL = obs_metrics.counter(
     "edl_checkpoint_saves_total", "Checkpoint saves accepted")
+# the memstate tee's synchronous D2H snapshot is metered apart from
+# _SAVE_SECONDS so enabling the cache never skews the Orbax save metric
+_TEE_STAGE_SECONDS = obs_metrics.histogram(
+    "edl_memstate_stage_seconds",
+    "Synchronous memstate tee snapshot during save() (seconds)")
 _RESTORES_TOTAL = obs_metrics.counter(
     "edl_checkpoint_restores_total", "Checkpoint restores completed")
 
 
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True, save_interval_steps: int = 0):
+                 async_save: bool = True, save_interval_steps: int = 0,
+                 tee=None):
+        # ``tee`` (memstate.StateCacheTee): every committed save is
+        # mirrored into the pod's in-RAM peer cache — staged at save()
+        # (the D2H snapshot can't outlive the donated buffers), sealed
+        # at wait() (only a storage-durable step may become servable).
+        # Strictly best-effort: a tee failure costs a cache miss, never
+        # the checkpoint.
+        self._tee = tee
         if "://" in directory:  # object store (gs://...): Orbax/epath I/O
             self._dir = directory
         else:
@@ -74,6 +87,14 @@ class CheckpointManager:
         saved = self._mngr.save(step, args=ocp.args.Composite(**args), force=force)
         if saved:
             _SAVE_SECONDS.observe(time.perf_counter() - t0)
+        if saved and self._tee is not None:
+            t1 = time.perf_counter()
+            try:
+                self._tee.stage(step, state, meta)
+            except Exception:  # noqa: BLE001 — cache is best-effort
+                logger.exception("memstate tee stage failed (step %d)", step)
+            _TEE_STAGE_SECONDS.observe(time.perf_counter() - t1)
+        if saved:
             _SAVES_TOTAL.inc()
             logger.info("checkpoint step %d queued to %s", step, self._dir)
         return saved
@@ -131,13 +152,25 @@ class CheckpointManager:
             # object store (gs://...): a single-object write is atomic;
             # there is no cross-object rename to lean on
             (d / "metadata").write_text(body)
+            self._tee_meta(step, meta)
             return True
         path = os.path.join(str(d), "metadata")
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(body)
         os.replace(tmp, path)
+        self._tee_meta(step, meta)
         return True
+
+    def _tee_meta(self, step: int, meta: State) -> None:
+        """Mirror a sidecar patch into the cache so a peer restore sees
+        the same post-hook State the storage sidecar holds."""
+        if self._tee is None:
+            return
+        try:
+            self._tee.update_meta(step, meta)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            logger.exception("memstate tee meta update failed (step %d)", step)
 
     def _has_item(self, step: int, name: str) -> bool:
         """Whether the checkpoint at ``step`` contains item ``name``."""
@@ -149,8 +182,15 @@ class CheckpointManager:
     def wait(self) -> None:
         t0 = time.perf_counter()
         self._mngr.wait_until_finished()
+        if self._tee is not None:
+            # storage is durable up to every queued step: staged cache
+            # sets may now seal and advertise themselves as restorable
+            self._tee.mark_committed()
         _WAIT_SECONDS.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        if self._tee is not None:
+            self._tee.mark_committed()
+            self._tee.close()
         self._mngr.close()

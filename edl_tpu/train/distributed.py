@@ -43,6 +43,24 @@ def force_platform_from_env() -> None:
         jax.config.update("jax_platforms", plats)
 
 
+def _enable_cpu_collectives() -> None:
+    """Multi-process worlds on the CPU platform (integration tests, the
+    virtual mesh) need an explicit cross-process collectives backend:
+    without one, every collective dies with "Multiprocess computations
+    aren't implemented on the CPU backend".  The config knob was
+    renamed across jax versions — try the current name, then the old
+    boolean; on TPU/GPU platforms neither is needed."""
+    for update in (("jax_cpu_collectives_implementation", "gloo"),
+                   ("jax_cpu_enable_gloo_collectives", True)):
+        try:
+            jax.config.update(*update)
+            return
+        except Exception:  # noqa: BLE001 — knob absent in this version
+            continue
+    logger.warning("no CPU collectives knob in this jax; multi-process "
+                   "CPU worlds may not support collectives")
+
+
 def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
     """Idempotently bootstrap the multi-process JAX runtime.  Single-host
     (world_size <= 1) is a no-op so the same trainer script runs
@@ -61,6 +79,9 @@ def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
             raise RuntimeError(
                 "world_size > 1 but no coordinator address: set "
                 "EDL_TPU_COORDINATOR or EDL_TPU_TRAINER_ENDPOINTS")
+        if jax.config.jax_platforms == "cpu" or \
+                os.environ.get("JAX_PLATFORMS") == "cpu":
+            _enable_cpu_collectives()
         timeout = int(os.environ.get("EDL_TPU_DIST_INIT_TIMEOUT", "120"))
         retries = max(1, int(os.environ.get("EDL_TPU_DIST_INIT_RETRIES", "3")))
         logger.info("jax.distributed.initialize(coordinator=%s, n=%d, rank=%d)",

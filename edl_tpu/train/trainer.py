@@ -104,16 +104,37 @@ class ElasticTrainer:
         # trainer is where the per-process observability surfaces attach
         from edl_tpu import obs
         obs.install_from_env("trainer")
+        if tenv is not None and tenv.pod_id:
+            # under the launcher, stderr IS the workerlog: install the
+            # edl_tpu log handler (idempotent) so restore/preempt/
+            # heartbeat INFO lines — restore_source above all — reach
+            # the operator instead of dying in logging.lastResort
+            from edl_tpu.utils import logger as _logger_mod
+            _logger_mod.configure()
         self.tenv = tenv
         self.store = store
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
         self.rules = self.cfg.rules
         self.adjust = AdjustRegistry()
+        # under the elastic launcher, committed saves tee into the pod's
+        # in-RAM peer checkpoint cache (memstate) so a post-resize
+        # restore can come from surviving hosts instead of storage
+        tee = None
+        if (self.cfg.checkpoint_dir and store is not None
+                and tenv is not None and tenv.pod_id):
+            from edl_tpu import memstate
+            if memstate.enabled():
+                try:
+                    tee = memstate.StateCacheTee(store, tenv.job_id,
+                                                 tenv.pod_id)
+                except Exception:  # noqa: BLE001 — cache is best-effort
+                    logger.exception("memstate tee unavailable")
         self.ckpt = (CheckpointManager(self.cfg.checkpoint_dir,
-                                       self.cfg.max_to_keep)
+                                       self.cfg.max_to_keep, tee=tee)
                      if self.cfg.checkpoint_dir else None)
         self._step_fn = None
         self._t_restored: float | None = None  # recovery instrumentation
+        self._restore_source: str | None = None  # "peer" | "storage"
         # id -> (metric_fn, jitted): holding metric_fn pins its id so a
         # recycled id can never alias a different function; bounded so
         # fresh closures per call can't leak jitted executables forever
@@ -181,12 +202,19 @@ class ElasticTrainer:
         meta = State(total_batch_size=self.cfg.global_batch_size)
         if self.ckpt is None or self.ckpt.latest_step() is None:
             return self.create_state(init_fn, tx, param_logical), meta
-        with obs_trace.get_tracer().span("train/restore",
-                                         step=self.ckpt.latest_step()):
-            restored = self.ckpt.restore(
-                self._abstract_state(init_fn, tx, param_logical))
-        assert restored is not None
-        state, saved_meta = restored
+        latest = self.ckpt.latest_step()
+        abstract = self._abstract_state(init_fn, tx, param_logical)
+        state, saved_meta = self._cache_first_restore(abstract, latest)
+        if state is None:
+            from edl_tpu.memstate.restore import RESTORE_SECONDS
+            t0 = time.perf_counter()
+            with obs_trace.get_tracer().span("train/restore", step=latest):
+                restored = self.ckpt.restore(abstract)
+            assert restored is not None
+            state, saved_meta = restored
+            self._restore_source = "storage"
+            RESTORE_SECONDS.labels(source="storage").observe(
+                time.perf_counter() - t0)
         if saved_meta is not None:
             meta = saved_meta
         self._t_restored = time.time()  # recovery-time instrumentation
@@ -196,6 +224,49 @@ class ElasticTrainer:
             logger.info("world size %d -> %d; running adjust functions",
                         old_world, new_world)
             self.adjust.run(old_world, new_world, meta)
+        return state, meta
+
+    def _cache_first_restore(self, abstract, latest: int
+                             ) -> tuple[Any, State | None]:
+        """Try the peer checkpoint cache (memstate) before storage:
+        fetch shards from surviving pods' RAM, reassemble to THIS
+        mesh's shardings, verify CRCs and that the cached step matches
+        both the coord store's committed record and storage's latest.
+        ``(None, None)`` on any miss — the caller falls back to the
+        Orbax path.  EDL_TPU_MEMSTATE_VERIFY=1 additionally restores
+        from storage and asserts bit-identity (e2e proof hook)."""
+        if self.store is None or self.tenv is None or not self.tenv.pod_id:
+            return None, None
+        from edl_tpu import memstate
+        if not memstate.enabled():
+            return None, None
+        from edl_tpu.memstate import restore as ms_restore
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.get_tracer().span("train/restore_peer",
+                                             step=latest):
+                res = ms_restore.try_restore(self.store, self.tenv.job_id,
+                                             abstract, expect_step=latest)
+        except Exception:  # noqa: BLE001 — cache must never fail a restore
+            logger.exception("peer-cache restore errored; using storage")
+            return None, None
+        if res is None:
+            return None, None
+        state, meta_json, info = res
+        meta = State().from_json(meta_json)
+        if os.environ.get("EDL_TPU_MEMSTATE_VERIFY") == "1":
+            stored = self.ckpt.restore(abstract)
+            assert stored is not None
+            ms_restore.assert_bit_identical(state, stored[0])
+            logger.info("memstate: peer restore verified bit-identical to "
+                        "storage (step %d)", latest)
+        self._restore_source = "peer"
+        ms_restore.RESTORE_SECONDS.labels(source="peer").observe(
+            time.perf_counter() - t0)
+        logger.info("restored checkpoint step %d from peer cache "
+                    "(restore_source=peer, %d shards, %.1f MB from %s)",
+                    latest, info["shards"], info["bytes"] / 1e6,
+                    [p[:8] for p in info["peers"]])
         return state, meta
 
     # -- the step ------------------------------------------------------------
@@ -414,7 +485,8 @@ class ElasticTrainer:
             recovery.write_trainer_half(
                 self.store, self.tenv.job_id, self.tenv.cluster_stage,
                 self.tenv.pod_id, restored=t_restored,
-                first_step=time.time())
+                first_step=time.time(),
+                restore_source=self._restore_source)
         except Exception:  # noqa: BLE001 — metrics must never fail a job
             logger.exception("recovery record write failed")
 
@@ -498,6 +570,8 @@ class ElasticTrainer:
             logger.exception("heartbeat write failed")
 
     _preempt_seen = False
+    _preempt_next_check: int | None = None   # agreed next check step (multi)
+    _preempt_last_check_t = 0.0              # wall clock of last check (solo)
 
     def _maybe_preempt(self, state, meta, step: int) -> None:
         """SIGTERM-preemption grace (cluster/preempt.py): at a
@@ -507,16 +581,40 @@ class ElasticTrainer:
         On agreement: checkpoint (state + data spans) at this exact
         step and exit PREEMPT_EXIT_CODE — the launcher reads that as a
         clean coordinated departure, survivors resume from this
-        checkpoint with no span reprocessed."""
+        checkpoint with no span reprocessed.
+
+        Cadence (ADVICE r5): the check costs a store read + a world
+        allgather, so it runs on a WALL-CLOCK cadence
+        (~PREEMPT_CHECK_SECONDS), not a fixed step count — a fixed
+        every-8-steps collective taxed millisecond-step jobs hundreds
+        of times a minute.  It stays step-aligned: solo processes gate
+        on local wall clock directly; multi-process worlds agree on the
+        NEXT check step inside the current check's allgather (the
+        proposal derives from each process's step-time EMA; the
+        allgathered max is identical everywhere), so every process
+        still enters the same collectives at the same steps.  The
+        first check lands on a PREEMPT_CHECK_STEPS multiple — the only
+        cadence every process can know before any agreement exists."""
         from edl_tpu.utils import constants as _c
         # participation is decided from ENV facts only (identical for
         # every process the launcher spawned): a process whose store
         # connect failed must still enter the allgather below with
         # seen=0, or the world's collectives mismatch and hang
         if (self.tenv is None or not self.tenv.pod_id
-                or not self.tenv.cluster_stage
-                or step % max(1, _c.PREEMPT_CHECK_STEPS)):
+                or not self.tenv.cluster_stage):
             return
+        multi = jax.process_count() > 1
+        if multi:
+            if self._preempt_next_check is None:
+                if step % max(1, _c.PREEMPT_CHECK_STEPS):
+                    return
+            elif step != self._preempt_next_check:
+                return
+        else:
+            now = time.monotonic()
+            if now - self._preempt_last_check_t < _c.PREEMPT_CHECK_SECONDS:
+                return
+            self._preempt_last_check_t = now
         # only rank-0-in-pod reads the store (the _heartbeat convention
         # — N identical reads per pod would be pure traffic); the
         # allgather below fans a single sighting out to every process
@@ -530,9 +628,24 @@ class ElasticTrainer:
             except Exception:  # noqa: BLE001 — a store blip is not a preempt
                 logger.exception("preempt flag read failed")
         agreed = self._preempt_seen
-        if jax.process_count() > 1:
+        if multi:
+            # ONE allgather carries both the sighting and this process's
+            # cadence proposal (steps ~= PREEMPT_CHECK_SECONDS of wall
+            # time, from the step-time EMA); max() of each half is the
+            # same on every process, so sighting fan-out and next-check
+            # agreement cost a single collective
+            proposal = _c.PREEMPT_CHECK_STEPS
+            if self._step_ema:
+                proposal = round(
+                    _c.PREEMPT_CHECK_SECONDS / max(self._step_ema, 1e-4))
+            # pack sighting + proposal into one int32: proposal must
+            # stay under the sighting's radix whatever the env says
+            proposal = max(1, min(999_999, proposal))
             from edl_tpu.parallel.sharding import allgather_flag
-            agreed = bool(allgather_flag(int(self._preempt_seen)).sum())
+            packed = allgather_flag(
+                int(self._preempt_seen) * 1_000_000 + proposal)
+            agreed = bool((packed // 1_000_000).any())
+            self._preempt_next_check = step + int((packed % 1_000_000).max())
         if not agreed:
             return
         logger.warning("preemption flagged: checkpointing at step %d and "

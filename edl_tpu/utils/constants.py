@@ -18,6 +18,7 @@ ETCD_DIST_READER = "dist_reader"
 ETCD_RECOVERY = "recovery"          # per-stage resize timing records
 ETCD_HEARTBEAT = "heartbeat"        # per-pod trainer liveness beats
 ETCD_SCALE = "scale"                # controller desired-size + nodes_range
+ETCD_MEMSTATE = "memstate"          # peer checkpoint-cache adverts + commit record
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -32,6 +33,7 @@ ALL_TABLES = [
     ETCD_RECOVERY,
     ETCD_HEARTBEAT,
     ETCD_SCALE,
+    ETCD_MEMSTATE,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
@@ -85,11 +87,32 @@ HANG_MAX_RESTARTS = int(_f("EDL_TPU_HANG_MAX_RESTARTS", 3))
 # launcher "clean coordinated departure", not success and not a crash
 PREEMPT_EXIT_CODE = 94
 # trainers poll the preempt flag (and, multi-process, OR the sightings
-# via allgather so the save step is agreed) every this many steps —
-# bounds preemption latency at K steps while keeping the per-step loop
-# collective-free
+# via allgather so the save step is agreed) at a step-aligned cadence.
+# PREEMPT_CHECK_STEPS is the INITIAL cadence (the first check lands on
+# a step multiple so every process enters the collective together);
+# after that the cadence adapts so checks cost the hot loop one tiny
+# collective roughly every PREEMPT_CHECK_SECONDS of wall time, however
+# long a step takes (ADVICE r5: a fixed every-8-steps allgather taxed
+# fast-step jobs and starved slow-step ones)
 PREEMPT_CHECK_STEPS = int(_f("EDL_TPU_PREEMPT_CHECK_STEPS", 8))
+PREEMPT_CHECK_SECONDS = _f("EDL_TPU_PREEMPT_CHECK_SECONDS", 2.0)
 # how long the signalled launcher waits for its trainers to finish the
 # preemption-point checkpoint before giving up and departing with
-# whatever the last periodic checkpoint was
+# whatever the last periodic checkpoint was.  NOTE the deployment
+# coupling: the pod's terminationGracePeriodSeconds (k8s/train-job.yaml)
+# must exceed this value, or the kubelet SIGKILLs the launcher before
+# the grace path can run (doc/usage.md "Preemption grace").
 PREEMPT_GRACE = _f("EDL_TPU_PREEMPT_GRACE", 120.0)
+
+# -- in-memory peer checkpoint cache (edl_tpu/memstate) -------------------
+# 0 disables the cache entirely (saves are not teed, restores go
+# straight to storage); on by default — the cache is best-effort and
+# every miss falls back to the Orbax/storage path
+MEMSTATE = int(_f("EDL_TPU_MEMSTATE", 1))
+# per-RPC chunk size for multi-MB shard transfers (rpc/chunks.py)
+MEMSTATE_CHUNK_BYTES = int(_f("EDL_TPU_MEMSTATE_CHUNK_BYTES", 4 << 20))
+# cap on bytes a pod's cache service will hold (staged + committed);
+# 0 = unlimited.  An over-cap push is REJECTED (the set never commits,
+# restore sees a miss and falls back to storage) — RAM safety beats
+# cache completeness
+MEMSTATE_MAX_BYTES = int(_f("EDL_TPU_MEMSTATE_MAX_BYTES", 0))

@@ -1,0 +1,34 @@
+"""Version shims for jax API drift.
+
+The repo targets the ``jax.shard_map`` public API (jax >= 0.6, per
+pyproject), but deployment images pin older runtimes where shard_map
+still lives in ``jax.experimental`` with the pre-rename kwargs
+(``check_rep``; manual-axes via ``auto=`` complement instead of
+``axis_names=``).  One shim so kernels never branch on version.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None):
+    """``jax.shard_map`` when available, else the experimental one with
+    the kwargs translated (check_vma -> check_rep; axis_names -> the
+    complementary ``auto`` set)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # NB: no ``auto=`` translation for axis_names — the old partial-
+    # automatic mode is broken on SPMD backends ("PartitionId ... not
+    # supported"), so the fallback runs FULL manual: axes the caller
+    # wanted automatic see replicated specs (P() entries), trading
+    # their data parallelism for redundant compute on old runtimes.
+    # Correct either way; the parity tests pin that down.
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -25,12 +25,26 @@ fi
 # CLI must reproduce summarize_recovery's per-phase totals
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
+# memstate smoke: two-pod kill-one-restore-from-peer on the CPU mesh —
+# a checkpoint teed into pod A's in-RAM cache + ring-replicated to pod
+# B must restore bit-identically from B alone after A dies, and a
+# checksum-corrupted replica must fall back to Orbax storage
+JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
-# line (tiny CPU knobs; LM/pipeline sections skipped off-TPU)
+# line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
+# now exits 0 even on failure (partial-artifact contract), so CI must
+# assert the artifact is COMPLETE — no error/partial keys, real value
 EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
 EDL_TPU_BENCH_WIDTH=8 EDL_TPU_BENCH_PIPELINE=0 EDL_TPU_BENCH_LM=0 \
+EDL_TPU_BENCH_MEMSTATE_MB=8 \
 JAX_PLATFORMS=cpu python bench.py | tail -1 \
-    | python -c "import json,sys; json.loads(sys.stdin.read()); print('bench smoke OK')"
+    | python -c "
+import json, sys
+out = json.loads(sys.stdin.read())
+assert 'error' not in out and not out.get('partial'), out
+assert out.get('value'), out
+print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve
 edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }

@@ -1,0 +1,117 @@
+"""CI smoke: two-pod kill-one-restore-from-peer on the CPU mesh.
+
+The memstate contract in one minute, no launcher subprocesses: two
+simulated pods (StateCacheService + RpcServer each) over an in-process
+MemoryKV, a real CheckpointManager save teed through pod A, ring
+replication to pod B, then pod A dies — and the restore must still
+come out of pod B's RAM, bit-identical to the original, with the
+checksum-corruption case falling back to Orbax storage.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from edl_tpu import memstate
+    from edl_tpu.cluster.state import State
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.memstate import restore as ms_restore
+    from edl_tpu.memstate.service import StateCacheService
+    from edl_tpu.memstate.tee import StateCacheTee
+    from edl_tpu.rpc.server import RpcServer
+    from edl_tpu.train.checkpoint import CheckpointManager
+
+    store = MemoryKV(sweep_period=0.25)
+    job = "smoke"
+    pods = {}
+    for pid in ("pod-a", "pod-b"):
+        srv = RpcServer("127.0.0.1", 0)
+        svc = StateCacheService(store, job, pid)
+        srv.register_instance(svc)
+        srv.start()
+        reg = memstate.advertise(store, job, pid, f"127.0.0.1:{srv.port}",
+                                 ttl=60)
+        pods[pid] = (svc, srv, reg)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sharded = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    state = {
+        "w": jax.device_put(np.random.default_rng(0).normal(
+            size=(64, 32)).astype(np.float32), sharded),
+        "b": jax.device_put(np.arange(16, dtype=np.float32), repl),
+    }
+    abstract = {"w": jax.ShapeDtypeStruct((64, 32), np.float32,
+                                          sharding=repl),
+                "b": jax.ShapeDtypeStruct((16,), np.float32,
+                                          sharding=sharded)}
+
+    tmp = tempfile.mkdtemp(prefix="edl-memstate-smoke-")
+    tee = StateCacheTee(store, job, "pod-a")
+    ck = CheckpointManager(tmp, tee=tee)
+    assert ck.save(3, state, State(total_batch_size=8))
+    ck.wait()
+    deadline = time.monotonic() + 60
+    while memstate.read_committed_step(store, job) != 3:
+        assert time.monotonic() < deadline, "tee never sealed step 3"
+        time.sleep(0.05)
+    while "pod-a" not in pods["pod-b"][0].cache_manifest():
+        assert time.monotonic() < deadline, "replica never landed on pod-b"
+        time.sleep(0.05)
+    print("smoke: save teed to pod-a and replicated to pod-b")
+
+    # kill pod A (server down, advert gone): the owner of every shard
+    pods["pod-a"][2].stop()
+    pods["pod-a"][1].stop()
+    store.delete(f"/edl_tpu/{job}/memstate/nodes/pod-a")
+
+    res = ms_restore.try_restore(store, job, abstract, expect_step=3)
+    assert res is not None, "restore must hit pod-b's replica"
+    got, meta_json, info = res
+    assert info["peers"] == ["pod-b"], info
+    for k in state:
+        assert np.array_equal(np.asarray(got[k]), np.asarray(state[k])), k
+    assert State().from_json(meta_json).total_batch_size == 8
+    print(f"smoke: peer restore from surviving pod OK ({info['shards']} "
+          f"shards, {info['bytes']} bytes, resharded)")
+
+    # corrupt the replica -> checksum miss -> storage fallback
+    sset = pods["pod-b"][0]._sets["pod-a"]  # noqa: SLF001 — fault injection
+    for key in list(sset.shards):
+        if "w" in key:
+            sset.shards[key] = b"\x00" * len(sset.shards[key])
+    assert ms_restore.try_restore(store, job, abstract,
+                                  expect_step=3) is None
+    stored = ck.restore(abstract)
+    assert stored is not None
+    assert np.array_equal(np.asarray(stored[0]["w"]), np.asarray(state["w"]))
+    print("smoke: checksum-bad replica refused; storage fallback OK")
+
+    ck.close()
+    pods["pod-b"][2].stop()
+    pods["pod-b"][1].stop()
+    store.close()
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("memstate smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -336,10 +336,33 @@ def test_controller_observes_capacity_from_live_pods(memkv):
     # converged at the mark -> no shrink, no flapping
     _put_cluster(memkv, "j3", pods + [extra])
     assert ctl.reconcile_once() == {}
-    # the high-water mark survives adverts expiring (capacity is the
-    # infra's demonstrated size, not the instantaneous liveness)
-    ctl._capacity_observed = 5
+    # the high-water mark survives adverts expiring WITHIN the window
+    # (capacity is the infra's recently demonstrated size, not the
+    # instantaneous liveness)
+    ctl._capacity_samples.append((time.monotonic(), 5))
     assert ctl._effective_capacity([view]) == 5
+
+
+def test_observed_capacity_highwater_decays(memkv):
+    """ADVICE r5: the observed-capacity mark is WINDOWED — infra that
+    permanently shrank ages out, so the controller stops proposing
+    unschedulable scale-ups forever."""
+    ctl = Controller(memkv, capacity=0, actuator=FakeActuator(),
+                     cooldown=0.0, observe_window_s=100.0)
+    views = [JobView("j", 1, 16, 2, pending_pods=0)]
+    t0 = 1000.0
+    # a burst demonstrated 8 slots at t0
+    ctl._capacity_samples.append((t0, 8))
+    assert ctl._effective_capacity(views, now=t0 + 1) == 8
+    # still inside the window: the mark holds even though only 2 live
+    assert ctl._effective_capacity(views, now=t0 + 99) == 8
+    # past the window: the 8-slot sample expired; the mark decays to
+    # the current liveness, never below 1
+    assert ctl._effective_capacity(views, now=t0 + 101) == 2
+    assert ctl._effective_capacity([JobView("j", 1, 16, 0)],
+                                   now=t0 + 102) == 2  # 2 is still in-window
+    assert ctl._effective_capacity([JobView("j", 1, 16, 0)],
+                                   now=t0 + 300) == 1  # floor
 
 
 def test_controller_cooldown_scales_with_resize_cost(memkv):

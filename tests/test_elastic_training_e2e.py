@@ -136,6 +136,93 @@ def _poll_metrics_endpoints(mdir, procs, want, deadline_s=240):
     return seen
 
 
+def _wait_for_checkpoints(ckpt, procs, n, deadline_s=180):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        done = [d for d in (os.listdir(ckpt) if os.path.isdir(ckpt) else [])
+                if d.isdigit()]
+        if len(done) >= n:
+            return
+        for p in procs:
+            assert p.poll() is None, "pod died during warmup"
+        time.sleep(0.25)
+    raise AssertionError(f"never committed {n} epoch checkpoints")
+
+
+def _complete_stages(ep, job):
+    from edl_tpu.cluster.recovery import summarize_recovery
+    client = CoordClient(ep)
+    try:
+        return [s for s in summarize_recovery(client, job) if "total" in s]
+    finally:
+        client.close()
+
+
+@pytest.mark.slow
+def test_peer_cache_restore_after_resize(coord_server, tmp_path):
+    """ISSUE 2 acceptance: a mid-run join resizes the world; the
+    restarted trainers restore from the surviving launcher's in-RAM
+    cache (recovery record ``restore_source=peer``), and the restored
+    state is verified bit-identical to the storage path in situ
+    (EDL_TPU_MEMSTATE_VERIFY=1 restores BOTH and asserts equality
+    inside the trainer)."""
+    ep = f"127.0.0.1:{coord_server.port}"
+    ckpt = str(tmp_path / "ckpt")
+    env = {"EDL_TPU_MEMSTATE_VERIFY": "1"}
+    pa = spawn("memstate-e2e", ep, str(tmp_path), "a", ckpt, extra_env=env)
+    _wait_for_checkpoints(ckpt, [pa], 2)
+    pb = spawn("memstate-e2e", ep, str(tmp_path), "b", ckpt, extra_env=env)
+    assert finish(pa, 240) == 0
+    assert finish(pb, 240) == 0
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "memstate-e2e") == Status.SUCCEED
+    client.close()
+    complete = _complete_stages(ep, "memstate-e2e")
+    assert complete, "no complete resize record"
+    assert complete[-1]["restore_source"] == "peer", complete
+    # the trainers logged the in-situ bit-identity proof (cache restore
+    # AND storage restore of the same step compared leaf by leaf)
+    la = (tmp_path / "launcher-a.log").read_bytes().decode(errors="replace")
+    assert "restore_source=peer" in la, la[-3000:]
+    assert "verified bit-identical to storage" in la, la[-3000:]
+    # the full epoch set still completed exactly once, world=2
+    marker_a = (tmp_path / "marker-a").read_text()
+    done = [l for l in marker_a.splitlines() if l.startswith("done")]
+    m = re.search(r"world=(\d+) epochs=\[([0-9, ]+)\]", done[-1])
+    assert m and m.group(1) == "2", marker_a
+    assert [int(x) for x in m.group(2).split(",")] == list(range(10))
+
+
+@pytest.mark.slow
+def test_peer_cache_miss_falls_back_to_storage(coord_server, tmp_path):
+    """Forced cache miss: a 1-byte cache cap rejects every shard push
+    (eviction-class miss — the set never seals, no committed record),
+    so the post-resize restore must fall back to Orbax storage and the
+    recovery record says ``restore_source=storage``.  Same resize
+    choreography as the peer-restore test; only the cache differs."""
+    ep = f"127.0.0.1:{coord_server.port}"
+    ckpt = str(tmp_path / "ckpt")
+    env = {"EDL_TPU_MEMSTATE_MAX_BYTES": "1"}
+    pa = spawn("miss-e2e", ep, str(tmp_path), "a", ckpt, extra_env=env)
+    _wait_for_checkpoints(ckpt, [pa], 2)
+    pb = spawn("miss-e2e", ep, str(tmp_path), "b", ckpt, extra_env=env)
+    assert finish(pa, 240) == 0
+    assert finish(pb, 240) == 0
+
+    client = CoordClient(ep)
+    assert load_job_status(client, "miss-e2e") == Status.SUCCEED
+    client.close()
+    complete = _complete_stages(ep, "miss-e2e")
+    assert complete, "no complete resize record"
+    assert complete[-1]["restore_source"] == "storage", complete
+    la = (tmp_path / "launcher-a.log").read_bytes().decode(errors="replace")
+    assert "restore_source=peer" not in la
+    marker_a = (tmp_path / "marker-a").read_text()
+    done = [l for l in marker_a.splitlines() if l.startswith("done")]
+    assert done and "world=2" in done[-1], marker_a
+
+
 @pytest.mark.slow
 def test_elastic_join_resumes_training(coord_server, tmp_path):
     ep = f"127.0.0.1:{coord_server.port}"

@@ -1,0 +1,344 @@
+"""In-memory peer checkpoint cache (edl_tpu/memstate): ring replica
+placement, chunked shard RPC, CRC rejection, tee + cache-first restore
+bit-identity, staleness/eviction fallbacks, and the recovery-record
+``restore_source`` field.
+
+Everything runs in-process on the 8-device virtual CPU mesh: pods are
+(StateCacheService, RpcServer) pairs over a MemoryKV coordination
+store — the launcher-integration strategy, without subprocesses.
+"""
+
+import functools
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu import memstate
+from edl_tpu.cluster.state import State
+from edl_tpu.memstate import placement
+from edl_tpu.memstate import restore as ms_restore
+from edl_tpu.memstate.service import StateCacheService
+from edl_tpu.memstate.tee import StateCacheTee
+from edl_tpu.rpc import chunks
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.exceptions import EdlInternalError
+
+
+# -- ring replica placement ---------------------------------------------------
+def test_replica_placement_deterministic_and_never_self():
+    pods = [f"pod-{i}" for i in range(6)]
+    for owner in pods:
+        r = placement.replica_for(owner, pods)
+        assert r in pods and r != owner
+        # pure function of the pod set: same answer on every caller
+        assert r == placement.replica_for(owner, list(reversed(pods)))
+
+
+def test_replica_placement_single_pod_and_two_pods():
+    assert placement.replica_for("a", ["a"]) is None
+    # two pods always pick each other — the 2-pod kill-one e2e relies
+    # on exactly this
+    assert placement.replica_for("a", ["a", "b"]) == "b"
+    assert placement.replica_for("b", ["a", "b"]) == "a"
+
+
+def test_replica_placement_stable_under_unrelated_change():
+    """Consistent hashing: removing one pod must not re-home every
+    other owner's replica (the rank-neighbor scheme would)."""
+    pods = [f"pod-{i}" for i in range(10)]
+    before = {o: placement.replica_for(o, pods) for o in pods}
+    gone = "pod-7"
+    after = {o: placement.replica_for(o, [p for p in pods if p != gone])
+             for o in pods if o != gone}
+    moved = [o for o in after if before[o] != after[o] and before[o] != gone]
+    # owners whose replica was NOT the removed pod mostly keep it
+    assert len(moved) <= 3, (moved, before, after)
+
+
+# -- service + chunked RPC ----------------------------------------------------
+@pytest.fixture
+def pod(memkv):
+    """One live cache pod: (service, server, client)."""
+    srv = RpcServer("127.0.0.1", 0)
+    svc = StateCacheService(memkv, "job", "pod-a")
+    srv.register_instance(svc)
+    srv.start()
+    reg = memstate.advertise(memkv, "job", "pod-a",
+                             f"127.0.0.1:{srv.port}", ttl=30)
+    client = RpcClient(f"127.0.0.1:{srv.port}")
+    yield svc, srv, client
+    client.close()
+    reg.stop()
+    srv.stop()
+
+
+def _push_shard(client, owner, step, key, data, chunk=1 << 16):
+    n = chunks.push_bytes(
+        functools.partial(client.call, "cache_put_chunk",
+                          owner=owner, step=step, key=key),
+        data, chunk_bytes=chunk)
+    return n, {key: {"crc": zlib.crc32(data), "nbytes": len(data),
+                     "dtype": "uint8", "shape": [len(data)],
+                     "index": [[0, len(data)]], "gshape": [len(data)],
+                     "leaf": key}}
+
+
+def test_chunked_shard_roundtrip(pod):
+    svc, srv, client = pod
+    data = np.random.default_rng(0).bytes(3 * (1 << 20) + 17)  # ~3 MB
+    n, manifest = _push_shard(client, "pod-a", 5, "['w']@0:N", data)
+    assert n == -(-len(data) // (1 << 16))  # really went in chunks
+    assert client.call("cache_commit", owner="pod-a", step=5,
+                       manifest=manifest, meta=b"{}")["ok"]
+    got = chunks.fetch_bytes(
+        functools.partial(client.call, "cache_fetch",
+                          owner="pod-a", key="['w']@0:N"),
+        len(data), chunk_bytes=1 << 16)
+    assert got == data
+    listing = client.call("cache_manifest")
+    assert listing["pod-a"]["step"] == 5
+    assert listing["pod-a"]["has_meta"]
+
+
+def test_chunk_sequence_violation_rejected(pod):
+    svc, srv, client = pod
+    client.call("cache_put_chunk", owner="pod-a", step=1, key="k",
+                seq=0, data=b"xx", eof=False)
+    with pytest.raises(EdlInternalError):
+        client.call("cache_put_chunk", owner="pod-a", step=1, key="k",
+                    seq=5, data=b"yy", eof=True)  # hole in the stream
+
+
+def test_commit_rejects_bad_crc(pod):
+    svc, srv, client = pod
+    data = b"a" * 1024
+    _, manifest = _push_shard(client, "pod-a", 2, "k", data)
+    manifest["k"]["crc"] = 123  # wrong
+    with pytest.raises(EdlInternalError):
+        client.call("cache_commit", owner="pod-a", step=2,
+                    manifest=manifest, meta=None)
+    # the poisoned staging is dropped; nothing committed
+    assert client.call("cache_manifest") == {}
+
+
+def test_memory_cap_rejects_push(memkv):
+    srv = RpcServer("127.0.0.1", 0)
+    svc = StateCacheService(memkv, "job", "pod-cap", max_bytes=64)
+    srv.register_instance(svc)
+    srv.start()
+    try:
+        client = RpcClient(f"127.0.0.1:{srv.port}")
+        with pytest.raises(EdlInternalError):
+            client.call("cache_put_chunk", owner="pod-cap", step=1, key="k",
+                        seq=0, data=b"z" * 128, eof=True)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_memory_cap_allows_superseding_step(memkv):
+    """A cap between 1x and 2x the working set must not deadlock: the
+    owner's committed step N set is superseded by step N+1's staging,
+    so it does not count against the cap; commit evicts it."""
+    srv = RpcServer("127.0.0.1", 0)
+    svc = StateCacheService(memkv, "job", "pod-cap2", max_bytes=48)
+    srv.register_instance(svc)
+    srv.start()
+    try:
+        client = RpcClient(f"127.0.0.1:{srv.port}")
+        data = b"a" * 40  # ~0.83x of the cap: two sets never co-fit
+        for step in (1, 2):
+            _, manifest = _push_shard(client, "pod-cap2", step, "k", data)
+            assert client.call("cache_commit", owner="pod-cap2", step=step,
+                               manifest=manifest, meta=b"{}")["ok"]
+        listing = client.call("cache_manifest")
+        assert listing["pod-cap2"]["step"] == 2  # replaced, not wedged
+        client.close()
+    finally:
+        srv.stop()
+
+
+# -- tee + cache-first restore ------------------------------------------------
+def _two_pods(memkv):
+    pods = {}
+    for pid in ("pod-a", "pod-b"):
+        srv = RpcServer("127.0.0.1", 0)
+        svc = StateCacheService(memkv, "job", pid)
+        srv.register_instance(svc)
+        srv.start()
+        reg = memstate.advertise(memkv, "job", pid,
+                                 f"127.0.0.1:{srv.port}", ttl=30)
+        pods[pid] = (svc, srv, reg)
+    return pods
+
+
+def _teardown(pods):
+    for svc, srv, reg in pods.values():
+        reg.stop()
+        srv.stop()
+
+
+def _wait_sealed(memkv, step, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while memstate.read_committed_step(memkv, "job") != step:
+        assert time.monotonic() < deadline, "tee never sealed the step"
+        time.sleep(0.02)
+
+
+def _state_and_abstract():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    state = {
+        "w": jax.device_put(
+            np.arange(64, dtype=np.float32).reshape(8, 8), sh),
+        "b": jax.device_put(np.linspace(0, 1, 6).astype(np.float32), rep),
+        "step": jax.device_put(np.int32(7), rep),
+    }
+    # restore target RESHARDED: w replicated, b dp-sharded (pad to 8?
+    # 6 doesn't divide 4 -> keep replicated), proving old/new meshes
+    # need not agree
+    abstract = {
+        "w": jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=rep),
+        "b": jax.ShapeDtypeStruct((6,), jnp.float32, sharding=rep),
+        "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+    }
+    return state, abstract
+
+
+def test_tee_restore_bit_identical_and_resharded(memkv, tmp_path):
+    from edl_tpu.train.checkpoint import CheckpointManager
+    pods = _two_pods(memkv)
+    try:
+        state, abstract = _state_and_abstract()
+        tee = StateCacheTee(memkv, "job", "pod-a")
+        ck = CheckpointManager(str(tmp_path / "ck"), tee=tee)
+        assert ck.save(7, state, State(total_batch_size=32))
+        ck.wait()
+        _wait_sealed(memkv, 7)
+
+        res = ms_restore.try_restore(memkv, "job", abstract, expect_step=7)
+        assert res is not None, "expected a cache hit"
+        got, meta_json, info = res
+        assert info["step"] == 7 and info["shards"] >= 3
+        for k in state:
+            assert np.array_equal(np.asarray(got[k]), np.asarray(state[k])), k
+        assert got["w"].sharding == abstract["w"].sharding  # resharded
+        assert State().from_json(meta_json).total_batch_size == 32
+        # the cache path and the storage path agree bit for bit
+        stored = ck.restore(abstract)
+        assert stored is not None
+        ms_restore.assert_bit_identical(got, stored[0])
+        ck.close()
+    finally:
+        _teardown(pods)
+
+
+def test_restore_survives_owner_pod_loss(memkv, tmp_path):
+    """The 2-pod kill-one scenario: pod-a saves, dies; its ring replica
+    on pod-b alone serves the restore."""
+    from edl_tpu.train.checkpoint import CheckpointManager
+    pods = _two_pods(memkv)
+    try:
+        state, abstract = _state_and_abstract()
+        tee = StateCacheTee(memkv, "job", "pod-a")
+        ck = CheckpointManager(str(tmp_path / "ck"), tee=tee)
+        assert ck.save(7, state, State())
+        ck.wait()
+        _wait_sealed(memkv, 7)
+        # replication to pod-b is async: wait for its copy
+        deadline = time.monotonic() + 30
+        while "pod-a" not in pods["pod-b"][0].cache_manifest():
+            assert time.monotonic() < deadline, "replica never landed"
+            time.sleep(0.02)
+        # kill pod-a: server down, advert gone
+        pods["pod-a"][2].stop()
+        pods["pod-a"][1].stop()
+        memkv.delete("/edl_tpu/job/memstate/nodes/pod-a")
+
+        res = ms_restore.try_restore(memkv, "job", abstract, expect_step=7)
+        assert res is not None, "replica on pod-b should serve the restore"
+        got, _meta, info = res
+        assert info["peers"] == ["pod-b"]
+        assert np.array_equal(np.asarray(got["w"]), np.asarray(state["w"]))
+        ck.close()
+    finally:
+        _teardown({k: v for k, v in pods.items() if k != "pod-a"})
+
+
+def test_restore_checksum_rejection_falls_back(memkv, tmp_path):
+    from edl_tpu.train.checkpoint import CheckpointManager
+    pods = _two_pods(memkv)
+    try:
+        state, abstract = _state_and_abstract()
+        tee = StateCacheTee(memkv, "job", "pod-a")
+        ck = CheckpointManager(str(tmp_path / "ck"), tee=tee)
+        assert ck.save(7, state, State())
+        ck.wait()
+        _wait_sealed(memkv, 7)
+        deadline = time.monotonic() + 30
+        while "pod-a" not in pods["pod-b"][0].cache_manifest():
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # corrupt EVERY copy of one shard, owner and replica alike
+        for svc, _srv, _reg in pods.values():
+            sset = svc._sets["pod-a"]
+            for key in list(sset.shards):
+                if "w" in key:
+                    sset.shards[key] = b"\x00" * len(sset.shards[key])
+        assert ms_restore.try_restore(memkv, "job", abstract,
+                                      expect_step=7) is None
+        # ...but the storage path still restores fine (the fallback)
+        stored = ck.restore(abstract)
+        assert stored is not None
+        assert np.array_equal(np.asarray(stored[0]["w"]),
+                              np.asarray(state["w"]))
+        ck.close()
+    finally:
+        _teardown(pods)
+
+
+def test_restore_refuses_stale_and_missing_record(memkv, tmp_path):
+    from edl_tpu.train.checkpoint import CheckpointManager
+    pods = _two_pods(memkv)
+    try:
+        state, abstract = _state_and_abstract()
+        # no committed record at all -> miss
+        assert ms_restore.try_restore(memkv, "job", abstract,
+                                      expect_step=1) is None
+        tee = StateCacheTee(memkv, "job", "pod-a")
+        ck = CheckpointManager(str(tmp_path / "ck"), tee=tee)
+        assert ck.save(7, state, State())
+        ck.wait()
+        _wait_sealed(memkv, 7)
+        # storage moved on (step 9) but the cache still holds 7 -> stale
+        assert ms_restore.try_restore(memkv, "job", abstract,
+                                      expect_step=9) is None
+        ck.close()
+    finally:
+        _teardown(pods)
+
+
+# -- recovery record carries the source ---------------------------------------
+def test_trainer_half_records_restore_source(memkv):
+    from edl_tpu.cluster.recovery import (
+        summarize_recovery, write_launcher_half, write_trainer_half,
+    )
+    write_launcher_half(memkv, "j", "stg", "p1",
+                        {"detect": 10.0, "killed": 11.0, "barrier": 12.0,
+                         "spawn": 13.0})
+    write_trainer_half(memkv, "j", "stg", "p1", restored=15.0,
+                       first_step=16.0, restore_source="peer")
+    [entry] = summarize_recovery(memkv, "j")
+    assert entry["restore_source"] == "peer"
+    # one pod falling back to storage downgrades the stage's source
+    write_trainer_half(memkv, "j", "stg", "p2", restored=15.5,
+                       first_step=16.5, restore_source="storage")
+    [entry] = summarize_recovery(memkv, "j")
+    assert entry["restore_source"] == "storage"

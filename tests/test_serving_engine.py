@@ -6,6 +6,8 @@ Greedy sampling makes that exact, so parity against
 models/generate.generate() is the core assertion.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -324,6 +326,29 @@ def test_warm_then_serve(small):
     want = np.asarray(generate(cfg, params, jnp.asarray(p[None]), 5,
                                temperature=0.0))[0]
     np.testing.assert_array_equal(out, want)
+
+
+def test_warm_mid_traffic_fails_loudly(small):
+    """warm() shares the donated pool cache with the engine thread, so
+    calling it with requests in flight must raise, not race (ISSUE 2
+    satellite): occupied slots or queued work both refuse."""
+    cfg, params = small
+    eng = _engine(cfg, params, slots=2)
+    try:
+        fut = eng.submit(np.asarray([3, 1, 4], np.int32), 8)
+        # wait until the request occupies a slot (not the queue->pending
+        # handoff instant) so the guard trips on a deterministic state
+        deadline = time.monotonic() + 120
+        while not eng.stats()["active_slots"]:
+            assert time.monotonic() < deadline, "request never admitted"
+            time.sleep(0.01)
+        with pytest.raises(RuntimeError, match="in flight"):
+            eng.warm(7)
+        fut.result(timeout=120)   # the live request still completes
+        # drained again: warm() is legal once traffic is gone
+        eng.warm(7)
+    finally:
+        eng.stop()
 
 
 def test_stop_fails_pending(small):

@@ -193,9 +193,10 @@ def test_evaluate_uneven_batches_two_processes(tmp_path):
 
 
 def test_maybe_preempt_unit(memkv, monkeypatch):
-    """Preempt check in isolation: flag set -> the trainer exits with
-    PREEMPT_EXIT_CODE at the next aligned step; no flag -> no-op; an
-    unaligned step never reads the store."""
+    """Preempt check in isolation (single-process: WALL-CLOCK cadence,
+    ADVICE r5): the first step checks, a step inside the cadence
+    window never reads the store, and a due check with the flag
+    visible checkpoints-and-exits PREEMPT_EXIT_CODE."""
     from edl_tpu.cluster import preempt
     from edl_tpu.cluster.env import TrainerEnv
     from edl_tpu.utils import constants
@@ -209,12 +210,12 @@ def test_maybe_preempt_unit(memkv, monkeypatch):
     exits = []
     monkeypatch.setattr("os._exit", lambda code: exits.append(code))
 
-    K = constants.PREEMPT_CHECK_STEPS
-    tr._maybe_preempt(None, None, K + 1)     # unaligned: no-op
-    tr._maybe_preempt(None, None, K)         # aligned, no flag: no-op
+    tr._maybe_preempt(None, None, 1)   # first call checks; no flag yet
     assert exits == []
     preempt.flag_preempt(memkv, "pj", "stg", "pod2")
-    tr._maybe_preempt(None, None, K + 1)     # still unaligned: no read
+    tr._maybe_preempt(None, None, 2)   # inside the window: no store read
     assert exits == []
-    tr._maybe_preempt(None, None, 2 * K)     # aligned + flagged: exit
+    # force the cadence window to elapse without sleeping through it
+    tr._preempt_last_check_t -= constants.PREEMPT_CHECK_SECONDS + 1
+    tr._maybe_preempt(None, None, 3)   # due + flagged: exit
     assert exits == [constants.PREEMPT_EXIT_CODE]
