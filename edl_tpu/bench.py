@@ -281,6 +281,16 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- serving gateway: fleet-level request latency/throughput -------------
+    # the ISSUE 3 number: what a caller sees THROUGH the front door
+    # (admission, routing, chunked fetch) vs the engine-only tokens/s
+    if os.environ.get("EDL_TPU_BENCH_GATEWAY", "1") != "0":
+        try:
+            out.update(_bench_gateway())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -378,6 +388,115 @@ def _bench_memstate() -> dict:
             s.stop()
         store.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_gateway() -> dict:
+    """Elastic-serving front-door cost: a replica fleet (in-process
+    ReplicaServers over a MemoryKV, real ContinuousBatcher engines, the
+    real RPC wire + chunked result fetch) behind a Gateway, under a
+    closed-loop burst.  Reports p50/p99 request latency, delivered
+    tokens/s, and the reject/hedge/retry counts for the run — the
+    fleet-level analog of ``engine_tokens_s``.  Loopback RPC keeps
+    every protocol cost real while understating LAN latency."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.gateway import Gateway, GatewayConfig
+    from edl_tpu.gateway.gateway import _HEDGES, _RETRIES
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.serving import ContinuousBatcher
+    from edl_tpu.serving.replica import ReplicaServer
+    from edl_tpu.utils.exceptions import EdlOverloadedError
+
+    n_replicas = int(os.environ.get("EDL_TPU_BENCH_GATEWAY_REPLICAS", 2))
+    slots = int(os.environ.get("EDL_TPU_BENCH_GATEWAY_SLOTS", 4))
+    n_req = int(os.environ.get("EDL_TPU_BENCH_GATEWAY_REQS", 32))
+    new = int(os.environ.get("EDL_TPU_BENCH_GATEWAY_NEW", 16))
+    hedge = float(os.environ.get("EDL_TPU_BENCH_GATEWAY_HEDGE", 0.0))
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=1, embed_dim=16,
+                            num_heads=2, mlp_dim=32, max_len=64,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    store = MemoryKV(sweep_period=1.0)
+    servers = []
+    gw = None
+    try:
+        for i in range(n_replicas):
+            eng = ContinuousBatcher(cfg, params, slots=slots,
+                                    temperature=0.0, prefill_buckets=(8, 16),
+                                    steps_per_sync=4)
+            eng.warm(4)
+            servers.append(ReplicaServer(store, "bench", eng,
+                                         replica_id=f"bench-{i}",
+                                         host="127.0.0.1", ttl=60))
+        gw = Gateway(store, "bench", GatewayConfig(
+            max_inflight=2 * n_replicas * slots, max_queue=4 * n_req,
+            hedge_after_s=hedge, request_timeout_s=600.0,
+            wait_slice_s=0.05, poll_period_s=0.1))
+        assert gw.wait_for_replicas(n_replicas, 60)
+        hedges0, retries0 = _HEDGES.value, _RETRIES.value
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(1, 61, (int(rng.integers(3, 9)),))
+                   .astype(np.int32) for _ in range(n_req)]
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+
+        def record(dt_req: float) -> None:
+            with lat_lock:
+                lat.append(dt_req)
+
+        rejects = 0
+        t0 = time.perf_counter()
+        futs = []
+        for p in prompts:
+            t_sub = time.perf_counter()
+            try:
+                fut = gw.submit(p, new)
+            except EdlOverloadedError:
+                rejects += 1
+                continue
+            fut.add_done_callback(
+                lambda _f, t=t_sub: record(time.perf_counter() - t))
+            futs.append(fut)
+        total = sum(len(f.result(timeout=600)) for f in futs)
+        dt = time.perf_counter() - t0
+        # set_result wakes result() waiters BEFORE running done
+        # callbacks, so the slowest request's sample — the one that IS
+        # the p99 — may still be in flight here; drain until it lands
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with lat_lock:
+                if len(lat) >= len(futs):
+                    break
+            time.sleep(0.001)
+        with lat_lock:
+            lat_ms = sorted(1e3 * x for x in lat)
+
+        def pct(q: float) -> float:
+            return lat_ms[min(len(lat_ms) - 1,
+                              int(q * (len(lat_ms) - 1)))] if lat_ms else 0.0
+
+        return {
+            "gateway_replicas": n_replicas,
+            "gateway_requests": len(futs),
+            "gateway_p50_ms": round(pct(0.50), 1),
+            "gateway_p99_ms": round(pct(0.99), 1),
+            "gateway_tokens_s": round(total / dt, 1),
+            "gateway_rejects": rejects,
+            "gateway_hedges": int(_HEDGES.value - hedges0),
+            "gateway_retries": int(_RETRIES.value - retries0),
+        }
+    finally:
+        if gw is not None:
+            gw.close()
+        for s in servers:
+            s.close()
+        store.close()
 
 
 def _forever(feed, limit: int):

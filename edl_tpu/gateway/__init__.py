@@ -1,0 +1,18 @@
+"""Elastic LM serving gateway.
+
+The serving-side analog of the elastic training control plane: LM
+replicas (``edl_tpu.serving.replica.ReplicaServer``) register TTL-leased
+adverts carrying live load stats in the coordination store; the
+:class:`~edl_tpu.gateway.gateway.Gateway` watches that fleet, routes
+each generate request least-loaded (optional session affinity over the
+consistent-hash ring), applies admission control (bounded queue + token
+bucket), hedges requests stuck past a latency deadline, and retries
+transparently when a replica dies mid-request — so accepted work
+survives replica churn the way training steps survive resizes.
+"""
+
+from edl_tpu.gateway.fleet import FleetView, advertise, list_replicas
+from edl_tpu.gateway.gateway import Gateway, GatewayConfig, GatewayServer
+
+__all__ = ["Gateway", "GatewayConfig", "GatewayServer", "FleetView",
+           "advertise", "list_replicas"]

@@ -1,0 +1,6 @@
+"""``python -m edl_tpu.gateway`` — the gateway front-door CLI
+(avoids runpy's re-execution warning for the submodule form)."""
+
+from edl_tpu.gateway.gateway import main
+
+main()

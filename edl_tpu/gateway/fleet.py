@@ -1,0 +1,135 @@
+"""Coordination-store surface of the serving fleet.
+
+One record per live replica under the ``serving`` table::
+
+    serving/nodes/<replica_id> -> JSON {
+        "endpoint": "ip:port",        # the replica's EDL1 RPC server
+        "slots": 8, "free_slots": 5,  # engine capacity right now
+        "queue_depth": 0,             # engine queue + pending
+        "prefill_stall_s": 0.12,      # cumulative admission stall
+        "tokens_per_s": 812.3,
+        "max_prompt_len": 1023,
+        "draining": false,            # graceful removal in progress
+        "ts": 1700000000.5,
+    }
+
+The advert is TTL-leased (``coord/register.py``) by the replica process
+itself, so the advert dying IS the liveness signal — exactly the
+``memstate/advert.py`` pattern.  Load stats ride on the same record via
+``Register.update()`` at ``SERVING_ADVERT_PERIOD``, so the gateway's
+fleet view is at most one advert period stale (its own per-replica
+in-flight counts cover the gap between refreshes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.consistent_hash import ConsistentHash
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def _nodes_prefix(job_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_SERVING, "nodes/")
+
+
+def node_key(job_id: str, replica_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_SERVING, f"nodes/{replica_id}")
+
+
+def advertise(store, job_id: str, replica_id: str, payload: dict,
+              ttl: float = constants.ETCD_TTL) -> Register:
+    """TTL-leased replica advert; returns the Register (``update()`` to
+    refresh load stats, ``stop()`` to release the lease)."""
+    return Register(store, node_key(job_id, replica_id),
+                    json.dumps(payload).encode(), ttl=ttl)
+
+
+def list_replicas(store, job_id: str) -> dict[str, dict]:
+    """Live replica adverts: ``{replica_id: payload}``."""
+    prefix = _nodes_prefix(job_id)
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, dict] = {}
+    for rec in recs:
+        try:
+            payload = json.loads(rec.value.decode())
+            payload["endpoint"]  # torn advert without an endpoint: skip
+        except (ValueError, KeyError):
+            continue  # the lease will expire it
+        out[rec.key[len(prefix):]] = payload
+    return out
+
+
+class FleetView:
+    """Background-refreshed view of the replica fleet.
+
+    A poll thread re-reads the adverts every ``period`` seconds and
+    keeps a consistent-hash ring of the live replica ids in step (for
+    session affinity).  Readers get copy-on-write snapshots — the same
+    single-writer/many-readers split as the hash ring itself.  The
+    gateway additionally calls :meth:`refresh` inline after a transport
+    failure so a death is acted on before the next tick.
+    """
+
+    def __init__(self, store, job_id: str,
+                 period: float = constants.GATEWAY_POLL_PERIOD):
+        self._store = store
+        self._job_id = job_id
+        self._period = period
+        self._lock = threading.Lock()       # writers only
+        self._replicas: dict[str, dict] = {}
+        self.ring = ConsistentHash()
+        self._halt = threading.Event()
+        self.refresh()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"fleet:{job_id}")
+        self._thread.start()
+
+    def refresh(self) -> dict[str, dict]:
+        try:
+            fresh = list_replicas(self._store, self._job_id)
+        except Exception as e:  # noqa: BLE001 — store blips must not kill the view
+            logger.warning("fleet refresh failed: %s", e)
+            return self.replicas()
+        with self._lock:
+            if set(fresh) != set(self._replicas):
+                self.ring.set_nodes(sorted(fresh))
+            self._replicas = fresh
+        return dict(fresh)
+
+    def replicas(self) -> dict[str, dict]:
+        with self._lock:
+            return dict(self._replicas)
+
+    def drop(self, replica_id: str) -> None:
+        """Remove a replica the caller observed dead (its advert may
+        outlive the process by up to the lease TTL); the next refresh
+        re-adds it only if the advert is still being kept alive."""
+        with self._lock:
+            if replica_id in self._replicas:
+                del self._replicas[replica_id]
+                self.ring.set_nodes(sorted(self._replicas))
+
+    def wait_for(self, n: int, timeout: float) -> bool:
+        """Block until at least ``n`` replicas are advertised."""
+        deadline = time.monotonic() + timeout
+        while len(self.refresh()) < n:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(0.05, self._period))
+        return True
+
+    def _run(self) -> None:
+        while not self._halt.wait(self._period):
+            self.refresh()
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,497 @@
+"""The serving front door: route, admit, hedge, fail over.
+
+A :class:`Gateway` accepts generate requests and drives them to
+completion against the advertised replica fleet (``gateway/fleet.py``):
+
+- **routing** — least-loaded by live advert stats corrected with the
+  gateway's own per-replica in-flight counts (the advert is up to one
+  refresh period stale; without the correction a burst lands entirely
+  on whichever replica advertised free slots last).  A ``session`` key
+  opts into consistent-hash affinity (``coord/consistent_hash.py``):
+  the session's ring owner is preferred while it is routable, so its
+  KV-adjacent state (prefix caches, future speculative state) stays
+  warm; an unroutable owner falls back to least-loaded rather than
+  queueing behind a dying replica.
+- **admission control** — a bounded accepted-set (``max_inflight``
+  dispatching + ``max_queue`` waiting) and an optional token bucket
+  (``rate``/``burst``).  Saturation REJECTS with
+  :class:`EdlOverloadedError` carrying ``retry_after`` — the gateway
+  never hangs callers it cannot serve (load shedding beats convoying,
+  the Orca/vLLM admission stance lifted to the fleet level).
+- **hedging** — a request not done ``hedge_after_s`` after dispatch is
+  re-issued on a second replica; first finisher wins, the loser's
+  result buffer is released (the engine lane still completes — lane
+  preemption is not worth the cache surgery for a tail-latency hedge).
+- **transparent failover** — a replica dying mid-request (transport
+  error, drain refusal) quarantines it from routing and replays the
+  request on a survivor.  Once ``submit()`` returns a future, the
+  request only fails on a request-level error or the deadline — never
+  because a replica died.
+
+Every accepted request runs on one pool thread (bounded by
+``max_inflight``); each replica attempt ("leg") gets its own thread +
+RPC connection so hedged legs progress independently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import queue as queue_mod
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from edl_tpu.gateway.fleet import FleetView
+from edl_tpu.obs import metrics as obs_metrics, trace
+from edl_tpu.rpc import chunks
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import (
+    EdlCoordError, EdlOverloadedError, EdlUnavailableError,
+)
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_REQUESTS = obs_metrics.counter(
+    "edl_gateway_requests_total",
+    "Accepted gateway requests resolved, by outcome", ("outcome",))
+_REJECTS = obs_metrics.counter(
+    "edl_gateway_rejects_total",
+    "Requests rejected at admission, by reason", ("reason",))
+_RETRIES = obs_metrics.counter(
+    "edl_gateway_retries_total",
+    "Requests replayed on another replica after a replica failure")
+_HEDGES = obs_metrics.counter(
+    "edl_gateway_hedges_total",
+    "Hedge legs fired for requests stuck past the latency deadline")
+_HEDGE_WINS = obs_metrics.counter(
+    "edl_gateway_hedge_wins_total",
+    "Requests whose hedge leg finished first")
+_REQ_SECONDS = obs_metrics.histogram(
+    "edl_gateway_request_seconds",
+    "Accepted-request latency (admission to resolution)")
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "edl_gateway_queue_depth", "Requests admitted and not yet resolved")
+_REPLICAS_G = obs_metrics.gauge(
+    "edl_gateway_replicas", "Replicas the gateway currently routes to")
+
+
+class _TokenBucket:
+    """Non-blocking token bucket: ``take()`` returns 0.0 on grant, else
+    the seconds until a token will exist (the caller's retry-after)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst) or math.ceil(rate))
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return (1.0 - self._tokens) / self.rate
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    max_inflight: int = 64          # concurrently dispatching requests
+    max_queue: int = 128            # admitted beyond that, awaiting a worker
+    rate: float = 0.0               # requests/s token bucket; 0 = unlimited
+    burst: float = 0.0              # bucket size (default: ceil(rate))
+    hedge_after_s: float = 0.0      # 0 disables hedging
+    request_timeout_s: float = 600.0
+    wait_slice_s: float = 0.2       # serve_wait quantum (failure-detect bound)
+    rpc_timeout_s: float = 10.0
+    poll_period_s: float = constants.GATEWAY_POLL_PERIOD
+    quarantine_s: float = constants.GATEWAY_QUARANTINE_S
+
+
+class _GwRequest:
+    __slots__ = ("id", "prompt", "max_new", "session", "future")
+
+    def __init__(self, prompt: list[int], max_new: int, session: str | None):
+        self.id = uuid.uuid4().hex
+        self.prompt = prompt
+        self.max_new = max_new
+        self.session = session
+        self.future: Future = Future()
+
+
+class Gateway:
+    """``submit(prompt_1d, max_new) -> Future[np.ndarray]`` over a
+    leased replica fleet.  Use as a library front door in-process, or
+    behind :class:`GatewayServer` over the wire."""
+
+    def __init__(self, store, job_id: str, cfg: GatewayConfig | None = None):
+        self.cfg = cfg or GatewayConfig()
+        self.job_id = job_id
+        self._fleet = FleetView(store, job_id, period=self.cfg.poll_period_s)
+        self._pool = ThreadPoolExecutor(max_workers=self.cfg.max_inflight,
+                                        thread_name_prefix="gw-req")
+        self._adm_lock = threading.Lock()
+        self._admitted = 0
+        self._bucket = (_TokenBucket(self.cfg.rate, self.cfg.burst)
+                        if self.cfg.rate > 0 else None)
+        self._state_lock = threading.Lock()
+        self._inflight: dict[str, int] = {}      # replica -> active legs
+        self._quarantined: dict[str, float] = {}  # replica -> until (mono)
+        self._closed = False
+
+    # -- public --------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               session: str | None = None) -> Future:
+        """Admit one request or raise :class:`EdlOverloadedError` with a
+        ``retry_after`` hint.  The returned future resolves to the
+        generated tokens (np.int32) and survives replica death."""
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._adm_lock:
+            if self._closed:
+                raise RuntimeError("gateway closed")
+            cap = self.cfg.max_inflight + self.cfg.max_queue
+            if self._admitted >= cap:
+                _REJECTS.labels(reason="queue_full").inc()
+                raise EdlOverloadedError(
+                    f"gateway saturated: {self._admitted} admitted "
+                    f"(cap {cap}); retry_after=1.0", retry_after=1.0)
+            if self._bucket is not None:
+                ra = self._bucket.take()
+                if ra > 0.0:
+                    _REJECTS.labels(reason="rate").inc()
+                    raise EdlOverloadedError(
+                        f"rate limit {self.cfg.rate}/s exceeded; "
+                        f"retry_after={ra:.3f}", retry_after=ra)
+            self._admitted += 1
+            _QUEUE_DEPTH.set(self._admitted)
+        req = _GwRequest(ids.tolist(), int(max_new_tokens), session)
+        try:
+            self._pool.submit(self._run, req)
+        except BaseException:
+            with self._adm_lock:
+                self._admitted -= 1
+                _QUEUE_DEPTH.set(self._admitted)
+            raise
+        return req.future
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 session: str | None = None,
+                 timeout: float | None = None) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens,
+                           session=session).result(timeout)
+
+    def stats(self) -> dict:
+        reps = self._fleet.replicas()
+        _REPLICAS_G.set(len(reps))
+        with self._adm_lock:
+            admitted = self._admitted
+        with self._state_lock:
+            now = time.monotonic()
+            quarantined = sorted(r for r, t in self._quarantined.items()
+                                 if t > now)
+            inflight = dict(self._inflight)
+        return {"replicas": reps, "admitted": admitted,
+                "inflight": inflight, "quarantined": quarantined}
+
+    def wait_for_replicas(self, n: int, timeout: float = 60.0) -> bool:
+        ok = self._fleet.wait_for(n, timeout)
+        _REPLICAS_G.set(len(self._fleet.replicas()))
+        return ok
+
+    def close(self) -> None:
+        with self._adm_lock:
+            self._closed = True
+        self._fleet.stop()
+        self._pool.shutdown(wait=False)
+
+    # -- routing -------------------------------------------------------------
+    def _pick(self, session: str | None,
+              exclude: set[str]) -> tuple[str, dict] | None:
+        """Choose a routable replica: session ring owner if routable,
+        else least loaded by ``queue_depth + gateway legs - free_slots``
+        (advert staleness corrected by our own assignment counts)."""
+        reps = self._fleet.replicas()
+        _REPLICAS_G.set(len(reps))
+        now = time.monotonic()
+        with self._state_lock:
+            self._quarantined = {r: t for r, t in self._quarantined.items()
+                                 if t > now}
+            quarantined = set(self._quarantined)
+            inflight = dict(self._inflight)
+        cands = {rid: p for rid, p in reps.items()
+                 if rid not in exclude and rid not in quarantined
+                 and not p.get("draining")}
+        if not cands:
+            return None
+        if session is not None:
+            pref = self._fleet.ring.get_node(session)
+            if pref in cands:
+                return pref, cands[pref]
+
+        def load(rid: str):
+            p = cands[rid]
+            return (int(p.get("queue_depth", 0)) + inflight.get(rid, 0)
+                    - int(p.get("free_slots", 0)), inflight.get(rid, 0), rid)
+
+        rid = min(cands, key=load)
+        return rid, cands[rid]
+
+    def _quarantine(self, replica_id: str) -> None:
+        self._fleet.drop(replica_id)
+        with self._state_lock:
+            self._quarantined[replica_id] = (time.monotonic()
+                                             + self.cfg.quarantine_s)
+
+    # -- the request driver --------------------------------------------------
+    def _run(self, req: _GwRequest) -> None:
+        t0 = time.monotonic()
+        deadline = t0 + self.cfg.request_timeout_s
+        hedge_at = (t0 + self.cfg.hedge_after_s
+                    if self.cfg.hedge_after_s > 0 else math.inf)
+        results: queue_mod.Queue = queue_mod.Queue()
+        winner = threading.Event()
+        hedge_legs: set[str] = set()
+        active = 0
+        tried: set[str] = set()
+        err: Exception | None = None
+        try:
+            while not req.future.done():
+                now = time.monotonic()
+                if now >= deadline:
+                    err = err or TimeoutError(
+                        f"request {req.id[:8]} exceeded "
+                        f"{self.cfg.request_timeout_s}s deadline")
+                    break
+                if active == 0:
+                    picked = self._pick(req.session, tried)
+                    if picked is None and tried:
+                        tried = set()   # all replicas tried once: start over
+                        picked = self._pick(req.session, tried)
+                    if picked is None:
+                        # fleet momentarily empty (resize, mass preempt):
+                        # keep watching until the deadline — an admitted
+                        # request outlives a fleet gap
+                        self._fleet.refresh()
+                        time.sleep(min(self.cfg.poll_period_s,
+                                       max(0.01, deadline - now)))
+                        continue
+                    rid, _ = picked
+                    tried.add(rid)
+                    self._launch(req, rid, picked[1]["endpoint"], winner,
+                                 results, deadline, hedged=False)
+                    active += 1
+                wait_until = min(deadline, hedge_at)
+                try:
+                    kind, rid, val = results.get(
+                        timeout=max(0.01, wait_until - time.monotonic()))
+                except queue_mod.Empty:
+                    if time.monotonic() >= hedge_at and active == 1:
+                        picked = self._pick(req.session, tried)
+                        if picked is None:
+                            # no second replica routable right now
+                            # (quarantine, drain): re-arm rather than
+                            # forfeit hedging for the request's lifetime
+                            hedge_at = (time.monotonic()
+                                        + self.cfg.hedge_after_s)
+                        else:
+                            hedge_at = math.inf      # hedge once
+                            rid, payload = picked
+                            tried.add(rid)
+                            hedge_legs.add(rid)
+                            _HEDGES.inc()
+                            trace.emit("gateway/hedge", request=req.id,
+                                       replica=rid)
+                            self._launch(req, rid, payload["endpoint"],
+                                         winner, results, deadline,
+                                         hedged=True)
+                            active += 1
+                    continue
+                active -= 1
+                if kind == "ok":
+                    winner.set()
+                    req.future.set_result(val)
+                    if rid in hedge_legs:
+                        _HEDGE_WINS.inc()
+                    return
+                if kind == "moved":
+                    # replica-level failure: quarantine + replay elsewhere
+                    err = val
+                    self._quarantine(rid)
+                    _RETRIES.inc()
+                    trace.emit("gateway/retry", request=req.id, replica=rid,
+                               error=f"{type(val).__name__}: {val}"[:200])
+                    continue
+                err = val            # request-level error
+                if active == 0:
+                    break            # no other leg can still save it
+        except BaseException as e:  # noqa: BLE001 — future must resolve
+            err = e
+        finally:
+            winner.set()
+            if not req.future.done():
+                req.future.set_exception(
+                    err or RuntimeError("gateway request dropped"))
+            with self._adm_lock:
+                self._admitted -= 1
+                _QUEUE_DEPTH.set(self._admitted)
+            _REQ_SECONDS.observe(time.monotonic() - t0)
+            _REQUESTS.labels(
+                outcome="ok" if req.future.exception() is None
+                else "error").inc()
+
+    def _launch(self, req: _GwRequest, rid: str, endpoint: str,
+                winner: threading.Event, results: queue_mod.Queue,
+                deadline: float, hedged: bool) -> None:
+        with self._state_lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+
+        def leg():
+            t0 = time.monotonic()
+            status = "ok"
+            try:
+                out = self._attempt(req, endpoint, winner, deadline)
+                if out is None:
+                    status = "cancelled"     # winner elsewhere; released
+                    results.put(("cancelled", rid, None))
+                else:
+                    results.put(("ok", rid, out))
+            except (EdlCoordError, EdlUnavailableError,
+                    EdlOverloadedError) as e:
+                status = "moved"
+                results.put(("moved", rid, e))
+            except Exception as e:  # noqa: BLE001 — leg must report, not die
+                status = "error"
+                results.put(("err", rid, e))
+            finally:
+                with self._state_lock:
+                    n = self._inflight.get(rid, 1) - 1
+                    if n <= 0:
+                        self._inflight.pop(rid, None)
+                    else:
+                        self._inflight[rid] = n
+                trace.emit("gateway/route", request=req.id, replica=rid,
+                           dur=time.monotonic() - t0, hedged=hedged,
+                           status=status)
+
+        threading.Thread(target=leg, daemon=True,
+                         name=f"gw-leg:{rid[:8]}").start()
+
+    def _attempt(self, req: _GwRequest, endpoint: str,
+                 winner: threading.Event,
+                 deadline: float) -> np.ndarray | None:
+        """One replica attempt over its own connection: submit, poll
+        ``serve_wait`` in bounded slices (so a winner elsewhere or a
+        dead replica is noticed within one slice), then chunk-fetch the
+        token buffer and release it.  Returns None when cancelled."""
+        with RpcClient(endpoint, timeout=self.cfg.rpc_timeout_s) as client:
+            client.call("serve_submit", request_id=req.id,
+                        prompt=req.prompt, max_new=req.max_new)
+            while True:
+                if winner.is_set():
+                    self._release(client, req.id)
+                    return None
+                if time.monotonic() >= deadline:
+                    self._release(client, req.id)
+                    raise TimeoutError("request deadline passed in flight")
+                r = client.call("serve_wait", request_id=req.id,
+                                timeout=self.cfg.wait_slice_s,
+                                _timeout=self.cfg.rpc_timeout_s
+                                + self.cfg.wait_slice_s)
+                if r.get("done"):
+                    break
+            data = chunks.fetch_bytes(
+                functools.partial(client.call, "serve_fetch",
+                                  request_id=req.id), int(r["nbytes"]))
+            self._release(client, req.id)
+            return np.frombuffer(data, np.int32)
+
+    @staticmethod
+    def _release(client: RpcClient, request_id: str) -> None:
+        try:
+            client.call("serve_release", request_id=request_id)
+        except Exception:  # noqa: BLE001 — result TTL evicts anyway
+            pass
+
+
+class GatewayServer:
+    """The gateway behind the EDL1 RPC wire (``gate_generate`` /
+    ``gate_stats``).  One request per client connection is in flight at
+    a time (thread-per-connection server); clients wanting pipelining
+    open more connections or use the in-process :class:`Gateway`."""
+
+    def __init__(self, store, job_id: str, cfg: GatewayConfig | None = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        self.gateway = Gateway(store, job_id, cfg)
+        self._rpc = RpcServer(host=host, port=port)
+        self._rpc.register("gate_generate", self._gate_generate)
+        self._rpc.register("gate_stats", self.gateway.stats)
+        self._rpc.start()
+        self.endpoint = self._rpc.endpoint
+        logger.info("gateway for job %s on %s", job_id, self.endpoint)
+
+    def _gate_generate(self, prompt, max_new: int, session: str | None = None,
+                       timeout: float | None = None) -> dict:
+        toks = self.gateway.generate(prompt, max_new, session=session,
+                                     timeout=timeout)
+        return {"tokens": [int(t) for t in toks]}
+
+    def stop(self) -> None:
+        self._rpc.stop()
+        self.gateway.close()
+
+
+def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
+    """``edl-gateway`` / ``python -m edl_tpu.gateway.gateway``."""
+    import argparse
+
+    from edl_tpu.coord.client import connect
+    from edl_tpu.obs import exposition
+    from edl_tpu.utils.logger import configure
+
+    p = argparse.ArgumentParser("edl_tpu.gateway")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max_inflight", type=int, default=64)
+    p.add_argument("--max_queue", type=int, default=128)
+    p.add_argument("--rate", type=float, default=0.0)
+    p.add_argument("--burst", type=float, default=0.0)
+    p.add_argument("--hedge_after", type=float, default=0.0)
+    p.add_argument("--request_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+    configure()
+    trace.configure_from_env("gateway")
+    exposition.serve_from_env("gateway")
+    cfg = GatewayConfig(max_inflight=args.max_inflight,
+                        max_queue=args.max_queue, rate=args.rate,
+                        burst=args.burst, hedge_after_s=args.hedge_after,
+                        request_timeout_s=args.request_timeout)
+    server = GatewayServer(connect(args.coord_endpoints), args.job_id,
+                           cfg, host=args.host, port=args.port)
+    print(f"[edl-gateway] serving on {server.endpoint}", flush=True)
+    try:
+        threading.Event().wait()
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -155,6 +155,7 @@ class ContinuousBatcher:
         self._toks = np.zeros((slots,), np.int32)   # last token per slot
         self._queue: queue.Queue[_Request | None] = queue.Queue()
         self._stopping = False
+        self._draining = False
         # makes check-stopping + enqueue atomic vs stop()'s drain (the
         # TeacherServer guard — without it a submit racing stop() can
         # land its request in the already-drained queue, stranding the
@@ -212,6 +213,8 @@ class ContinuousBatcher:
         with self._enqueue_lock:
             if self._stopping:
                 raise RuntimeError("engine stopping")
+            if self._draining:
+                raise RuntimeError("engine draining")
             self._submitted_requests += 1
             self._queue.put(req)
         return req.future
@@ -282,7 +285,36 @@ class ContinuousBatcher:
                 "prefill_stall_s": round(self._prefill_stall_s, 3),
                 "max_prompt_len": self._dcfg.max_len - 1,
                 "uptime_s": round(dt, 3),
+                "draining": self._draining,
             }
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown: stop admission (submit() raises), let every
+        queued + in-flight request run to completion, then stop the
+        engine.  This is the replica-removal path — :meth:`stop` remains
+        the hard path that FAILS outstanding futures.  Returns True when
+        everything completed; on ``timeout`` (seconds) the engine falls
+        back to the hard stop and returns False (leftover futures get
+        the stop() RuntimeError, so callers never hang either way).
+        Idempotent and safe to call concurrently with submits: the
+        draining flag and the enqueue share one lock, so a submit either
+        lands before the flag (and completes) or raises."""
+        with self._enqueue_lock:
+            self._draining = True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._enqueue_lock, self._stats_lock:
+                in_flight = (self._submitted_requests - self._done_requests
+                             - self._failed_requests)
+            if in_flight == 0:
+                self.stop()
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                logger.warning("drain timed out with %d request(s) left; "
+                               "falling back to hard stop", in_flight)
+                self.stop()
+                return False
+            time.sleep(0.01)
 
     def stop(self) -> None:
         with self._enqueue_lock:

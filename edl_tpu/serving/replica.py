@@ -1,0 +1,361 @@
+"""ReplicaServer: a ContinuousBatcher behind the EDL1 RPC wire, leased
+into the gateway fleet.
+
+One replica = one engine + one RPC server + one TTL-leased advert
+(``gateway/fleet.py``) that carries live load stats.  The wire protocol
+is poll-based so a gateway leg detects replica death within one wait
+slice and long generations never monopolize a connection:
+
+- ``serve_submit(request_id, prompt, max_new)`` — enqueue (idempotent
+  on ``request_id``, so a gateway transport retry is safe);
+- ``serve_wait(request_id, timeout)`` — bounded block; ``{"done":
+  False}`` or ``{"done": True, "nbytes": N}``;
+- ``serve_fetch(request_id, offset, length)`` — chunk reads of the
+  finished int32 token buffer (``rpc/chunks.fetch_bytes``), so a
+  multi-KB generation streams in bounded frames;
+- ``serve_release(request_id)`` — drop the buffer (ack, or a hedge
+  loser's cancel; un-acked buffers expire after
+  ``EDL_TPU_SERVING_RESULT_TTL``);
+- ``serve_stats`` / ``serve_drain`` — introspection + graceful removal.
+
+**Elastic integration**: ``drain()`` is the preempt path — stop
+admission (new submits get :class:`EdlUnavailableError`, and the advert
+flips ``draining`` so gateways stop routing here), let queued +
+in-flight requests finish, then release the lease.  The RPC server
+stays up until ``close()`` so gateways can still fetch finished
+buffers.  The engine's own stats are republished as ``edl_serving_*``
+gauges on every advert refresh, so a replica's /metrics endpoint covers
+the engine, not just the RPC plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+
+from edl_tpu.gateway import fleet
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.serving.engine import ContinuousBatcher
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlInternalError, EdlUnavailableError
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+_FREE_SLOTS = obs_metrics.gauge(
+    "edl_serving_free_slots", "Engine decode slots currently free")
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "edl_serving_queue_depth", "Engine requests queued awaiting a slot")
+_PREFILL_STALL = obs_metrics.gauge(
+    "edl_serving_prefill_stall_seconds",
+    "Cumulative host time dispatching prefills while decode lanes were live")
+_TOKENS_PER_S = obs_metrics.gauge(
+    "edl_serving_tokens_per_s", "Engine tokens emitted per second (lifetime)")
+_ACTIVE_SLOTS = obs_metrics.gauge(
+    "edl_serving_active_slots", "Engine decode slots serving a live request")
+_REPLICA_REQS = obs_metrics.counter(
+    "edl_serving_requests_total",
+    "Requests accepted by this replica's RPC surface")
+_RELEASED = obs_metrics.counter(
+    "edl_serving_releases_total",
+    "Result buffers released, by cause", ("cause",))
+
+
+def publish_engine_stats(stats: dict) -> None:
+    """Mirror :meth:`ContinuousBatcher.stats` into the metrics registry
+    (the replica's /metrics page must cover the engine itself)."""
+    _FREE_SLOTS.set(stats["slots"] - stats["active_slots"])
+    _QUEUE_DEPTH.set(stats["queue_depth"])
+    _PREFILL_STALL.set(stats["prefill_stall_s"])
+    _TOKENS_PER_S.set(stats["tokens_per_s"])
+    _ACTIVE_SLOTS.set(stats["active_slots"])
+
+
+class ReplicaServer:
+    """Own the wire + advert around one engine.  ``store`` is any
+    KVStore (MemoryKV in tests, CoordClient in a job)."""
+
+    def __init__(self, store, job_id: str, engine: ContinuousBatcher, *,
+                 replica_id: str | None = None, host: str = "0.0.0.0",
+                 port: int = 0, ttl: float = constants.ETCD_TTL,
+                 advert_period: float = constants.SERVING_ADVERT_PERIOD,
+                 result_ttl: float = constants.SERVING_RESULT_TTL):
+        self._engine = engine
+        self.replica_id = replica_id or (
+            f"{local_ip()}-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+        self._lock = threading.Lock()
+        self._futures: dict[str, Future] = {}
+        self._results: dict[str, tuple[bytes, float]] = {}  # rid -> (buf, t)
+        self._result_ttl = result_ttl
+        self._draining = False
+        self._drained = threading.Event()
+        self._rpc = RpcServer(host=host, port=port)
+        for name in ("serve_submit", "serve_wait", "serve_fetch",
+                     "serve_release", "serve_stats", "serve_drain"):
+            self._rpc.register(name, getattr(self, name))
+        self._rpc.start()
+        self.endpoint = self._rpc.endpoint
+        self._register = fleet.advertise(store, job_id, self.replica_id,
+                                         self._payload(), ttl=ttl)
+        self._halt = threading.Event()
+        self._advert_thread = threading.Thread(
+            target=self._refresh_loop, args=(advert_period,), daemon=True,
+            name=f"replica-advert:{self.replica_id[:8]}")
+        self._advert_thread.start()
+        logger.info("replica %s serving on %s", self.replica_id,
+                    self.endpoint)
+
+    # -- wire surface --------------------------------------------------------
+    def serve_submit(self, request_id: str, prompt, max_new: int) -> dict:
+        with self._lock:
+            if self._draining:
+                raise EdlUnavailableError(
+                    f"replica {self.replica_id} draining")
+            if request_id in self._futures or request_id in self._results:
+                return {"ok": True}      # idempotent transport retry
+        try:
+            fut = self._engine.submit(np.asarray(prompt, np.int32),
+                                      int(max_new))
+        except RuntimeError as e:
+            # engine draining/stopping: replica-level, go elsewhere
+            raise EdlUnavailableError(str(e)) from e
+        with self._lock:
+            self._futures[request_id] = fut
+        _REPLICA_REQS.inc()
+        return {"ok": True}
+
+    def serve_wait(self, request_id: str, timeout: float = 0.2) -> dict:
+        with self._lock:
+            buf = self._results.get(request_id)
+            fut = self._futures.get(request_id)
+        if buf is not None:
+            return {"done": True, "nbytes": len(buf[0])}
+        if fut is None:
+            raise EdlInternalError(f"unknown request {request_id}")
+        try:
+            toks = fut.result(timeout=min(float(timeout), 30.0))
+        except FutureTimeout:
+            return {"done": False}
+        except RuntimeError as e:
+            with self._lock:
+                self._futures.pop(request_id, None)
+            # "engine stopped mid-generation" etc.: the work is not
+            # coming; typed retryable so the gateway replays elsewhere
+            raise EdlUnavailableError(str(e)) from e
+        except Exception as e:
+            with self._lock:
+                self._futures.pop(request_id, None)
+            raise EdlInternalError(
+                f"generation failed: {type(e).__name__}: {e}") from e
+        data = np.asarray(toks, np.int32).tobytes()
+        with self._lock:
+            self._futures.pop(request_id, None)
+            self._results[request_id] = (data, time.monotonic())
+        return {"done": True, "nbytes": len(data)}
+
+    def serve_fetch(self, request_id: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            buf = self._results.get(request_id)
+        if buf is None:
+            raise EdlInternalError(f"no result for request {request_id}")
+        return buf[0][int(offset):int(offset) + int(length)]
+
+    def serve_release(self, request_id: str) -> dict:
+        with self._lock:
+            had_result = self._results.pop(request_id, None) is not None
+            fut = self._futures.pop(request_id, None)
+        if fut is not None and not fut.done():
+            # hedge loser cancelled mid-generation: the engine lane
+            # still finishes; discard its output on arrival
+            fut.add_done_callback(lambda _f: _RELEASED.labels(
+                cause="cancelled").inc())
+        elif had_result:
+            _RELEASED.labels(cause="acked").inc()
+        return {"ok": True}
+
+    def serve_stats(self) -> dict:
+        with self._lock:
+            tracked = len(self._futures) + len(self._results)
+            draining = self._draining
+        return {"replica": self.replica_id, "endpoint": self.endpoint,
+                "draining": draining, "tracked_requests": tracked,
+                "engine": self._engine.stats()}
+
+    def serve_drain(self, timeout: float | None = None) -> dict:
+        """Kick off a graceful drain in the background and return
+        immediately (the caller may be the preempting launcher on its
+        grace budget)."""
+        threading.Thread(target=self.drain, args=(timeout,), daemon=True,
+                         name=f"replica-drain:{self.replica_id[:8]}").start()
+        return {"ok": True}
+
+    # -- lifecycle -----------------------------------------------------------
+    def drain(self, timeout: float | None = None) -> bool:
+        """The preempt path: stop admission, advertise ``draining`` so
+        gateways route elsewhere, finish queued + in-flight requests,
+        then release the lease.  The RPC server stays up (finished
+        buffers remain fetchable) until :meth:`close`."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            return self._drained.wait(timeout)
+        try:
+            self._register.update(json.dumps(self._payload()).encode())
+        except Exception:  # noqa: BLE001 — advert refresh is best-effort
+            pass
+        ok = self._engine.drain(timeout)
+        self._halt.set()
+        self._register.stop()
+        self._drained.set()
+        logger.info("replica %s drained (complete=%s)", self.replica_id, ok)
+        return ok
+
+    def close(self) -> None:
+        """Hard teardown: advert gone, engine stopped (in-flight futures
+        FAIL — use :meth:`drain` first for graceful removal)."""
+        self._halt.set()
+        self._advert_thread.join(timeout=5.0)
+        self._register.stop()
+        self._engine.stop()
+        self._rpc.stop()
+
+    # -- internals -----------------------------------------------------------
+    def _payload(self) -> dict:
+        s = self._engine.stats()
+        with self._lock:
+            draining = self._draining
+        return {"endpoint": self.endpoint, "slots": s["slots"],
+                "free_slots": s["slots"] - s["active_slots"],
+                "queue_depth": s["queue_depth"],
+                "prefill_stall_s": s["prefill_stall_s"],
+                "tokens_per_s": s["tokens_per_s"],
+                "max_prompt_len": s["max_prompt_len"],
+                "draining": draining, "ts": time.time()}
+
+    def _refresh_loop(self, period: float) -> None:
+        while not self._halt.wait(period):
+            if not self._register.is_stopped:
+                try:
+                    self._register.update(
+                        json.dumps(self._payload()).encode())
+                except Exception as e:  # noqa: BLE001 — Register self-heals
+                    logger.warning("advert refresh failed: %s", e)
+            publish_engine_stats(self._engine.stats())
+            self._evict_stale_results()
+
+    def _evict_stale_results(self) -> None:
+        if not self._result_ttl:
+            return
+        cutoff = time.monotonic() - self._result_ttl
+        with self._lock:
+            stale = [rid for rid, (_, t) in self._results.items()
+                     if t < cutoff]
+            for rid in stale:
+                del self._results[rid]
+        for _ in stale:
+            _RELEASED.labels(cause="expired").inc()
+
+
+def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
+    """``edl-replica`` / ``python -m edl_tpu.serving.replica``: build a
+    TransformerLM engine (seeded init, or a TrainState checkpoint via
+    ``--checkpoint_dir``) and lease it into the fleet."""
+    import argparse
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.coord.client import connect
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.obs import exposition, trace
+    from edl_tpu.utils.logger import configure
+
+    p = argparse.ArgumentParser("edl_tpu.serving.replica")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--replica_id", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--checkpoint_dir", default=None)
+    p.add_argument("--vocab", type=int, default=53)
+    p.add_argument("--layers", type=int, default=1)
+    p.add_argument("--embed", type=int, default=32)
+    p.add_argument("--heads", type=int, default=2)
+    p.add_argument("--mlp", type=int, default=64)
+    p.add_argument("--max_len", type=int, default=64)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--steps_per_sync", type=int, default=4)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ttl", type=float, default=constants.ETCD_TTL)
+    args = p.parse_args(argv)
+    configure()
+    trace.configure_from_env("replica")
+    exposition.serve_from_env("replica")
+
+    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
+                            embed_dim=args.embed, num_heads=args.heads,
+                            mlp_dim=args.mlp, max_len=args.max_len,
+                            remat=False, dtype=jnp.float32)
+    if args.checkpoint_dir:
+        import optax
+
+        from edl_tpu.train.checkpoint import CheckpointManager
+        from edl_tpu.train.state import TrainState
+
+        model = TransformerLM(cfg)
+        shape = jax.eval_shape(
+            lambda: model.init(jax.random.key(0),
+                               jnp.zeros((1, 4), jnp.int32))["params"])
+        abstract = TrainState.create(shape, optax.adamw(1e-3))
+        ck = CheckpointManager(args.checkpoint_dir)
+        restored = ck.restore(abstract)
+        if restored is None:
+            raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+        params = restored[0].params
+        ck.close()
+    else:
+        params = TransformerLM(cfg).init(
+            jax.random.key(args.seed), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    engine = ContinuousBatcher(cfg, params, slots=args.slots,
+                               temperature=args.temperature,
+                               top_k=args.top_k,
+                               steps_per_sync=args.steps_per_sync)
+    store = connect(args.coord_endpoints)
+    server = ReplicaServer(store, args.job_id, engine,
+                           replica_id=args.replica_id, host=args.host,
+                           port=args.port, ttl=args.ttl)
+    print(f"[edl-replica] {server.replica_id} serving on {server.endpoint}",
+          flush=True)
+
+    import signal
+    done = threading.Event()
+
+    def _sigterm(_sig, _frm):
+        # preemption: drain gracefully, then exit (SIGKILL is the hard
+        # path the gateway's failover covers)
+        threading.Thread(target=lambda: (server.drain(), done.set()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        done.wait()
+        server.close()
+    except KeyboardInterrupt:
+        server.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

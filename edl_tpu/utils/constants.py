@@ -19,6 +19,7 @@ ETCD_RECOVERY = "recovery"          # per-stage resize timing records
 ETCD_HEARTBEAT = "heartbeat"        # per-pod trainer liveness beats
 ETCD_SCALE = "scale"                # controller desired-size + nodes_range
 ETCD_MEMSTATE = "memstate"          # peer checkpoint-cache adverts + commit record
+ETCD_SERVING = "serving"            # leased LM replica adverts (gateway fleet)
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -34,6 +35,7 @@ ALL_TABLES = [
     ETCD_HEARTBEAT,
     ETCD_SCALE,
     ETCD_MEMSTATE,
+    ETCD_SERVING,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
@@ -116,3 +118,17 @@ MEMSTATE_CHUNK_BYTES = int(_f("EDL_TPU_MEMSTATE_CHUNK_BYTES", 4 << 20))
 # restore sees a miss and falls back to storage) — RAM safety beats
 # cache completeness
 MEMSTATE_MAX_BYTES = int(_f("EDL_TPU_MEMSTATE_MAX_BYTES", 0))
+
+# -- elastic serving gateway (edl_tpu/gateway, serving/replica) -----------
+# how often a replica refreshes its leased advert with live load stats
+# (free slots, queue depth, prefill stall) and republishes engine gauges
+SERVING_ADVERT_PERIOD = _f("EDL_TPU_SERVING_ADVERT_PERIOD", 1.0)
+# gateway fleet-view refresh cadence (store poll; failures also trigger
+# an immediate refresh)
+GATEWAY_POLL_PERIOD = _f("EDL_TPU_GATEWAY_POLL_PERIOD", 0.25)
+# after a transport failure a replica is quarantined from routing this
+# long (its advert may outlive the process by up to the lease TTL)
+GATEWAY_QUARANTINE_S = _f("EDL_TPU_GATEWAY_QUARANTINE", 5.0)
+# completed-generation buffers a replica holds for gateway fetch are
+# evicted after this long without an ack (gateway died mid-fetch)
+SERVING_RESULT_TTL = _f("EDL_TPU_SERVING_RESULT_TTL", 600.0)

@@ -10,6 +10,7 @@ in the RPC envelope instead of a proto ``Status``.
 
 from __future__ import annotations
 
+import re
 import traceback
 
 
@@ -52,6 +53,27 @@ class EdlStopIteration(EdlError):
     """Remote signals end-of-data (maps to StopIteration client-side)."""
 
 
+# -- serving gateway --------------------------------------------------------
+class EdlOverloadedError(EdlRetryableError):
+    """Admission control rejected the request (queue full / rate limit /
+    no live replicas).  Carries ``retry_after`` seconds; since only the
+    (type, detail) pair crosses the RPC wire, the constructor recovers
+    it from a ``retry_after=N`` token in the detail string, so gateways
+    embed it there and remote callers still see the backoff hint."""
+
+    def __init__(self, detail: str = "", retry_after: float | None = None):
+        super().__init__(detail)
+        if retry_after is None:
+            m = re.search(r"retry_after=([0-9.]+)", detail)
+            retry_after = float(m.group(1)) if m else 1.0
+        self.retry_after = float(retry_after)
+
+
+class EdlUnavailableError(EdlRetryableError):
+    """This server cannot take or finish the work (draining, stopped
+    mid-generation) — try another replica or retry later."""
+
+
 # -- data plane -------------------------------------------------------------
 class EdlDataError(EdlRetryableError):
     """Data-server state not ready (e.g. balanced metas not computed)."""
@@ -80,6 +102,8 @@ _REGISTRY = {
         EdlLeaderChangedError,
         EdlTableError,
         EdlRegisterError,
+        EdlOverloadedError,
+        EdlUnavailableError,
         EdlStopIteration,
         EdlDataError,
         EdlFileListNotMatchError,

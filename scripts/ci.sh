@@ -31,6 +31,13 @@ JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 # checksum-corrupted replica must fall back to Orbax storage
 JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
 
+# gateway smoke: 2 replica processes + gateway on the virtual CPU mesh —
+# SIGKILL one under sustained load and every accepted request must still
+# complete on the survivor; a saturated gateway must reject (not hang);
+# edl_gateway_*/edl_serving_* metrics and route/hedge/retry trace spans
+# must be served
+JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
 # now exits 0 even on failure (partial-artifact contract), so CI must
@@ -51,10 +58,12 @@ edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
 edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
 edl-obs-dump --help >/dev/null 2>&1 || { echo "edl-obs-dump missing"; exit 1; }
+edl-gateway --help >/dev/null 2>&1 || { echo "edl-gateway missing"; exit 1; }
+edl-replica --help >/dev/null 2>&1 || { echo "edl-replica missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
 for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
-           edl-obs-dump; do
+           edl-obs-dump edl-gateway edl-replica; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

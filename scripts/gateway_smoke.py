@@ -1,0 +1,241 @@
+"""CI smoke: the elastic serving gateway's transparent-failover proof.
+
+Two REAL replica processes (``python -m edl_tpu.serving.replica``, each
+a ContinuousBatcher behind the EDL1 RPC wire with a TTL-leased advert)
+against an in-process coordination server, fronted by an in-process
+Gateway.  The contract under test, end to end:
+
+1. both replicas serve greedy-parity-correct tokens through the
+   gateway (least-loaded routing, chunked result fetch);
+2. hedging rescues a slow tail: with a tight hedge deadline, hedge
+   legs fire and every result is still correct (losers released);
+3. **SIGKILL one replica under sustained load** — every accepted
+   request still completes (replayed on the survivor), with at least
+   one observed retry;
+4. a saturated gateway REJECTS (EdlOverloadedError + retry_after)
+   immediately instead of hanging;
+5. ``edl_gateway_*`` metrics appear on this process's /metrics page,
+   ``edl_serving_*`` engine gauges on the surviving replica's page,
+   and gateway/route + gateway/hedge + gateway/retry spans land in the
+   trace JSONL.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
+"""
+
+import json
+import os
+import selectors
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+_TRACE_DIR = os.environ.setdefault("EDL_TPU_TRACE_DIR",
+                                   tempfile.mkdtemp(prefix="edl-gw-trace-"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VOCAB, LAYERS, EMBED, HEADS, MLP, MAX_LEN = 53, 1, 32, 2, 64, 64
+
+
+def _spawn_replica(coord_ep: str, rid: str, metrics_dir: str):
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               EDL_TPU_METRICS_PORT="0", EDL_TPU_METRICS_DIR=metrics_dir)
+    env.pop("XLA_FLAGS", None)   # single-device replicas
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.serving.replica",
+         "--coord_endpoints", coord_ep, "--job_id", "smoke",
+         "--replica_id", rid, "--host", "127.0.0.1",
+         "--vocab", str(VOCAB), "--layers", str(LAYERS),
+         "--embed", str(EMBED), "--heads", str(HEADS), "--mlp", str(MLP),
+         "--max_len", str(MAX_LEN), "--slots", "2", "--steps_per_sync", "4",
+         "--temperature", "0", "--seed", "0", "--ttl", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if not sel.select(timeout=1.0):
+            if proc.poll() is not None:
+                raise AssertionError(f"replica {rid} died silently")
+            continue
+        line = proc.stdout.readline()
+        if "serving on" in line:
+            return proc
+        if not line and proc.poll() is not None:
+            raise AssertionError(f"replica {rid} died before announcing")
+    raise AssertionError(f"replica {rid} never announced")
+
+
+def main() -> None:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.gateway import Gateway, GatewayConfig
+    from edl_tpu.gateway.gateway import _HEDGES, _RETRIES
+    from edl_tpu.models.generate import generate
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.obs import exposition, trace
+    from edl_tpu.obs.metrics import parse_exposition
+    from edl_tpu.utils.exceptions import EdlOverloadedError
+
+    trace.configure_from_env("gateway")
+    srv_metrics = exposition.serve_from_env("gateway")
+    assert srv_metrics is not None, "metrics endpoint must be up for the smoke"
+
+    cfg = TransformerConfig(vocab_size=VOCAB, num_layers=LAYERS,
+                            embed_dim=EMBED, num_heads=HEADS, mlp_dim=MLP,
+                            max_len=MAX_LEN, remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(                    # replica --seed 0
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    def want(prompt, n):
+        return np.asarray(generate(cfg, params, jnp.asarray(prompt[None]),
+                                   n, temperature=0.0))[0]
+
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    metrics_dir = tempfile.mkdtemp(prefix="edl-gw-metrics-")
+    procs = {rid: _spawn_replica(coord_ep, rid, metrics_dir)
+             for rid in ("rep-0", "rep-1")}
+    store = CoordClient(coord_ep)
+    gw = Gateway(store, "smoke", GatewayConfig(
+        max_inflight=8, max_queue=32, request_timeout_s=300.0,
+        wait_slice_s=0.1, poll_period_s=0.1, quarantine_s=30.0))
+    try:
+        assert gw.wait_for_replicas(2, 60), "replicas never advertised"
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, VOCAB, (n,)).astype(np.int32)
+                   for n in (3, 7, 5, 9, 4, 6)]
+
+        # 1 -- both replicas, correctness through the full stack
+        futs = [gw.submit(p, 8) for p in prompts]
+        for p, f in zip(prompts, futs):
+            np.testing.assert_array_equal(f.result(timeout=300), want(p, 8))
+        print("smoke: 2-replica routing + greedy parity OK")
+
+        # 2 -- hedging: SIGSTOP rep-0, pin requests to it via session
+        # affinity — the stuck legs trip the hedge deadline and the
+        # hedge legs on rep-1 deliver correct results (deterministic
+        # tail: a warm tiny model finishes faster than any deadline)
+        import signal
+
+        hedges0 = _HEDGES.value
+        gw_hedge = Gateway(store, "smoke", GatewayConfig(
+            max_inflight=4, max_queue=16, hedge_after_s=0.1,
+            request_timeout_s=300.0, wait_slice_s=0.05, poll_period_s=0.1))
+        try:
+            assert gw_hedge.wait_for_replicas(2, 60)
+            sess = next(s for s in (f"s{i}" for i in range(1000))
+                        if gw_hedge._fleet.ring.get_node(s) == "rep-0")
+            os.kill(procs["rep-0"].pid, signal.SIGSTOP)
+            try:
+                futs = [gw_hedge.submit(p, 16, session=sess)
+                        for p in prompts[:4]]
+                for p, f in zip(prompts, futs):
+                    np.testing.assert_array_equal(f.result(timeout=300),
+                                                  want(p, 16))
+            finally:
+                os.kill(procs["rep-0"].pid, signal.SIGCONT)
+        finally:
+            gw_hedge.close()
+        assert _HEDGES.value > hedges0, "stuck replica never tripped a hedge"
+        print(f"smoke: hedging fired ({int(_HEDGES.value - hedges0)} legs), "
+              "results correct")
+        # rep-0's lease lapsed while stopped; wait for its re-register
+        assert gw.wait_for_replicas(2, 60), "rep-0 never re-advertised"
+
+        # 3 -- SIGKILL a replica under sustained load: zero lost requests
+        retries0 = _RETRIES.value
+        load = [rng.integers(1, VOCAB, (rng.integers(3, 10),)).astype(np.int32)
+                for _ in range(24)]
+        futs = [gw.submit(p, 16) for p in load]
+        deadline = time.monotonic() + 120
+        while gw.stats()["inflight"].get("rep-0", 0) < 1:
+            assert time.monotonic() < deadline, "no request ever hit rep-0"
+            time.sleep(0.02)
+        procs["rep-0"].kill()                      # SIGKILL, no grace
+        procs["rep-0"].wait(timeout=30)
+        outs = [f.result(timeout=300) for f in futs]
+        for p, o in zip(load, outs):
+            np.testing.assert_array_equal(o, want(p, 16))
+        assert _RETRIES.value > retries0, "kill under load must cause a retry"
+        print(f"smoke: SIGKILL under load -> all {len(load)} accepted "
+              f"requests completed on the survivor "
+              f"({int(_RETRIES.value - retries0)} retries)")
+
+        # 4 -- saturation rejects immediately (no hang)
+        gw_tiny = Gateway(store, "smoke", GatewayConfig(
+            max_inflight=1, max_queue=0, request_timeout_s=300.0,
+            wait_slice_s=0.1, poll_period_s=0.1))
+        try:
+            slow = gw_tiny.submit(load[0], 40)
+            rejects = 0
+            for _ in range(5):
+                t0 = time.monotonic()
+                try:
+                    gw_tiny.submit(load[1], 4)
+                except EdlOverloadedError as e:
+                    rejects += 1
+                    assert e.retry_after > 0
+                assert time.monotonic() - t0 < 1.0, "reject must not block"
+            assert rejects == 5, f"expected 5 rejects, got {rejects}"
+            slow.result(timeout=300)
+        finally:
+            gw_tiny.close()
+        print("smoke: saturated gateway rejects with retry_after, no hang")
+
+        # 5 -- observability: gateway metrics, replica engine gauges, spans
+        page = urllib.request.urlopen(
+            f"http://{srv_metrics.endpoint}/metrics", timeout=10
+        ).read().decode()
+        metrics = parse_exposition(page)
+        for name, labels in [("edl_gateway_requests_total",
+                              (("outcome", "ok"),)),
+                             ("edl_gateway_retries_total", ()),
+                             ("edl_gateway_hedges_total", ()),
+                             ("edl_gateway_rejects_total",
+                              (("reason", "queue_full"),))]:
+            assert metrics.get((name, labels), 0) > 0, (name, labels)
+        survivor_pid = procs["rep-1"].pid
+        addr_path = os.path.join(metrics_dir,
+                                 f"metrics-replica-{survivor_pid}.addr")
+        with open(addr_path) as f:
+            rep_page = urllib.request.urlopen(
+                f"http://{f.read().strip()}/metrics", timeout=10
+            ).read().decode()
+        rep_metrics = parse_exposition(rep_page)
+        for name in ("edl_serving_free_slots", "edl_serving_queue_depth",
+                     "edl_serving_prefill_stall_seconds",
+                     "edl_serving_tokens_per_s"):
+            assert (name, ()) in rep_metrics, name
+        assert rep_metrics[("edl_serving_tokens_per_s", ())] > 0
+        spans = set()
+        for fn in os.listdir(_TRACE_DIR):
+            with open(os.path.join(_TRACE_DIR, fn)) as f:
+                for line in f:
+                    spans.add(json.loads(line).get("name"))
+        for name in ("gateway/route", "gateway/hedge", "gateway/retry"):
+            assert name in spans, f"missing trace span {name} in {spans}"
+        print("smoke: edl_gateway_*/edl_serving_* metrics + "
+              "route/hedge/retry spans present")
+    finally:
+        gw.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        store.close()
+        coord.stop()
+    print("gateway smoke OK")
+
+
+if __name__ == "__main__":
+    main()

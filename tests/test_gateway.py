@@ -1,0 +1,470 @@
+"""Elastic serving gateway (edl_tpu/gateway) + ReplicaServer
+(edl_tpu/serving/replica.py).
+
+Failure paths use the REAL ReplicaServer wire + advert machinery around
+a fake engine with controllable latency (so a hedge race or a lease
+expiry is deterministic, not a scheduling accident); the zero-lost
+kill-under-load test runs real ContinuousBatcher engines and asserts
+greedy parity after failover.  The SIGKILL-a-process variant lives in
+scripts/gateway_smoke.py / tests/test_serving_failover_e2e.py.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.gateway import Gateway, GatewayConfig, GatewayServer, fleet
+from edl_tpu.gateway.gateway import (
+    _HEDGE_WINS, _HEDGES, _RETRIES, _TokenBucket,
+)
+from edl_tpu.serving.replica import ReplicaServer, publish_engine_stats
+from edl_tpu.utils.exceptions import EdlOverloadedError, EdlUnavailableError
+
+
+class _FakeEngine:
+    """ContinuousBatcher stand-in: resolves ``np.arange(max_new) +
+    prompt[0]`` after ``delay`` seconds.  Only the surface ReplicaServer
+    touches (submit/stats/drain/stop) is implemented."""
+
+    def __init__(self, delay: float = 0.0, slots: int = 4,
+                 free_slots: int | None = None, queue_depth: int = 0):
+        self.delay = delay
+        self.slots = slots
+        self._free = slots if free_slots is None else free_slots
+        self._queue_depth = queue_depth
+        self.served: list[list[int]] = []
+        self._pending: list[Future] = []
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def submit(self, ids, max_new: int) -> Future:
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("engine stopping")
+            fut: Future = Future()
+            self._pending.append(fut)
+        self.served.append([int(x) for x in ids])
+
+        def run():
+            time.sleep(self.delay)
+            if not fut.done():
+                fut.set_result(np.arange(max_new, dtype=np.int32)
+                               + int(ids[0]))
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def stats(self) -> dict:
+        return {"slots": self.slots,
+                "active_slots": self.slots - self._free,
+                "queue_depth": self._queue_depth, "prefill_stall_s": 0.0,
+                "tokens_per_s": 0.0, "max_prompt_len": 63,
+                "draining": False}
+
+    def kill(self) -> None:
+        """Hard death: every pending future fails the way a stopped
+        engine fails them."""
+        with self._lock:
+            self._stopped = True
+            pending, self._pending = self._pending, []
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("engine stopped mid-generation"))
+
+    def drain(self, timeout=None) -> bool:
+        deadline = time.monotonic() + (timeout or 60.0)
+        while any(not f.done() for f in self._pending):
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        self._stopped = True
+        return True
+
+    def stop(self) -> None:
+        self.kill()
+
+
+def _fake_replica(store, rid, *, delay=0.0, free_slots=None, queue_depth=0,
+                  ttl=5.0, advert_period=0.2):
+    eng = _FakeEngine(delay=delay, free_slots=free_slots,
+                      queue_depth=queue_depth)
+    srv = ReplicaServer(store, "job", eng, replica_id=rid, host="127.0.0.1",
+                        ttl=ttl, advert_period=advert_period)
+    return eng, srv
+
+
+def _gateway(store, **kw):
+    kw.setdefault("max_inflight", 8)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("request_timeout_s", 60.0)
+    kw.setdefault("wait_slice_s", 0.05)
+    kw.setdefault("poll_period_s", 0.05)
+    kw.setdefault("quarantine_s", 30.0)
+    return Gateway(store, "job", GatewayConfig(**kw))
+
+
+def _expected(prompt, max_new):
+    return np.arange(max_new, dtype=np.int32) + int(prompt[0])
+
+
+# -- fleet ------------------------------------------------------------------
+def test_fleet_advert_roundtrip_and_ttl_expiry(memkv):
+    reg = fleet.advertise(memkv, "job", "r0",
+                          {"endpoint": "1.2.3.4:5", "free_slots": 3},
+                          ttl=0.4)
+    try:
+        got = fleet.list_replicas(memkv, "job")
+        assert got["r0"]["endpoint"] == "1.2.3.4:5"
+        reg.stop_heartbeat_only()
+        deadline = time.monotonic() + 10
+        while "r0" in fleet.list_replicas(memkv, "job"):
+            assert time.monotonic() < deadline, "advert never expired"
+            time.sleep(0.05)
+    finally:
+        reg.stop()
+
+
+def test_fleet_view_tracks_membership(memkv):
+    view = fleet.FleetView(memkv, "job", period=0.05)
+    regs = [fleet.advertise(memkv, "job", f"r{i}",
+                            {"endpoint": f"h:{i}"}, ttl=5) for i in range(3)]
+    try:
+        assert view.wait_for(3, timeout=10)
+        assert view.ring.get_node("sess") in {"r0", "r1", "r2"}
+        regs[1].stop()
+        deadline = time.monotonic() + 10
+        while "r1" in view.replicas():
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert "r1" not in view.ring.nodes
+    finally:
+        view.stop()
+        for r in regs:
+            r.stop()
+
+
+# -- admission --------------------------------------------------------------
+def test_token_bucket_grants_then_backpressures():
+    tb = _TokenBucket(rate=10.0, burst=2)
+    assert tb.take() == 0.0
+    assert tb.take() == 0.0
+    ra = tb.take()
+    assert 0.0 < ra <= 0.11
+    time.sleep(ra + 0.01)
+    assert tb.take() == 0.0
+
+
+def test_admission_rejects_when_queue_full(memkv):
+    eng, srv = _fake_replica(memkv, "r0", delay=0.5)
+    gw = _gateway(memkv, max_inflight=1, max_queue=0)
+    try:
+        assert gw.wait_for_replicas(1, 10)
+        fut = gw.submit([7], 4)
+        with pytest.raises(EdlOverloadedError) as ei:
+            gw.submit([8], 4)
+        assert ei.value.retry_after > 0
+        np.testing.assert_array_equal(fut.result(timeout=30),
+                                      _expected([7], 4))
+        # capacity freed: admitted again
+        np.testing.assert_array_equal(
+            gw.submit([9], 4).result(timeout=30), _expected([9], 4))
+    finally:
+        gw.close()
+        srv.close()
+
+
+def test_admission_rate_limit_rejects_with_retry_after(memkv):
+    eng, srv = _fake_replica(memkv, "r0")
+    gw = _gateway(memkv, rate=1.0, burst=1.0)
+    try:
+        assert gw.wait_for_replicas(1, 10)
+        gw.submit([3], 2).result(timeout=30)
+        with pytest.raises(EdlOverloadedError) as ei:
+            gw.submit([4], 2)
+        assert 0.0 < ei.value.retry_after <= 1.1
+    finally:
+        gw.close()
+        srv.close()
+
+
+def test_no_replicas_request_fails_at_deadline(memkv):
+    gw = _gateway(memkv, request_timeout_s=0.4)
+    try:
+        fut = gw.submit([1], 2)     # admitted: fleet gaps don't reject
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=30)
+    finally:
+        gw.close()
+
+
+# -- routing ----------------------------------------------------------------
+def test_least_loaded_routing_prefers_free_replica(memkv):
+    eng_a, srv_a = _fake_replica(memkv, "ra", free_slots=0, queue_depth=6)
+    eng_b, srv_b = _fake_replica(memkv, "rb", free_slots=4)
+    gw = _gateway(memkv)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        picked = gw._pick(None, set())
+        assert picked is not None and picked[0] == "rb"
+        for i in range(4):
+            gw.submit([10 + i], 3).result(timeout=30)
+        assert len(eng_b.served) == 4 and not eng_a.served
+    finally:
+        gw.close()
+        srv_a.close()
+        srv_b.close()
+
+
+def test_session_affinity_sticks_to_ring_owner(memkv):
+    engines = {}
+    servers = []
+    for rid in ("ra", "rb", "rc"):
+        eng, srv = _fake_replica(memkv, rid)
+        engines[rid] = eng
+        servers.append(srv)
+    gw = _gateway(memkv)
+    try:
+        assert gw.wait_for_replicas(3, 10)
+        owner = gw._fleet.ring.get_node("user-42")
+        for i in range(5):
+            gw.submit([20 + i], 2, session="user-42").result(timeout=30)
+        assert len(engines[owner].served) == 5
+        assert sum(len(e.served) for e in engines.values()) == 5
+    finally:
+        gw.close()
+        for s in servers:
+            s.close()
+
+
+def test_draining_replica_excluded_from_routing(memkv):
+    eng_a, srv_a = _fake_replica(memkv, "ra", free_slots=4)
+    eng_b, srv_b = _fake_replica(memkv, "rb", free_slots=1)
+    gw = _gateway(memkv)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        srv_a.serve_drain()
+        deadline = time.monotonic() + 10
+        while not fleet.list_replicas(memkv, "job").get(
+                "ra", {"draining": True})["draining"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        gw._fleet.refresh()
+        gw.submit([5], 2).result(timeout=30)
+        assert len(eng_b.served) == 1 and not eng_a.served
+    finally:
+        gw.close()
+        srv_a.close()
+        srv_b.close()
+
+
+# -- failure paths ----------------------------------------------------------
+def test_failover_replica_death_mid_request(memkv):
+    """A replica dying with the request in flight: the gateway replays
+    it on the survivor and the caller never notices (the acceptance
+    contract — accepted work survives a kill)."""
+    eng_a, srv_a = _fake_replica(memkv, "ra", delay=30.0, free_slots=4)
+    eng_b, srv_b = _fake_replica(memkv, "rb", delay=0.05, free_slots=1)
+    gw = _gateway(memkv)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        retries0 = _RETRIES.value
+        fut = gw.submit([7], 5)      # lands on ra (freest), stuck 30s
+        deadline = time.monotonic() + 10
+        while not eng_a.served:
+            assert time.monotonic() < deadline, "request never reached ra"
+            time.sleep(0.01)
+        eng_a.kill()                  # in-flight future fails
+        np.testing.assert_array_equal(fut.result(timeout=30),
+                                      _expected([7], 5))
+        assert eng_b.served == [[7]]
+        assert _RETRIES.value == retries0 + 1
+        assert "ra" in gw.stats()["quarantined"]
+    finally:
+        gw.close()
+        srv_a.close()
+        srv_b.close()
+
+
+def test_lease_expiry_mid_assignment_completes_then_reroutes(memkv):
+    """An advert expiring under a live replica must not kill its
+    in-flight request (the replica is alive; only new routing skips
+    it)."""
+    eng_a, srv_a = _fake_replica(memkv, "ra", delay=1.0, free_slots=4,
+                                 ttl=0.5, advert_period=10.0)
+    eng_b, srv_b = _fake_replica(memkv, "rb", delay=0.0, free_slots=1)
+    gw = _gateway(memkv)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        fut = gw.submit([11], 3)     # ra wins on free slots
+        deadline = time.monotonic() + 10
+        while not eng_a.served:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        srv_a._register.stop_heartbeat_only()   # lease expires mid-flight
+        while "ra" in gw._fleet.replicas():
+            assert time.monotonic() < deadline, "advert never expired"
+            time.sleep(0.05)
+        np.testing.assert_array_equal(fut.result(timeout=30),
+                                      _expected([11], 3))
+        gw.submit([12], 3).result(timeout=30)   # new work: survivor only
+        assert eng_b.served == [[12]]
+    finally:
+        gw.close()
+        srv_a.close()
+        srv_b.close()
+
+
+def test_hedge_fires_and_loser_is_released(memkv):
+    """A request stuck past hedge_after_s is re-issued on a second
+    replica; the fast leg wins, the slow leg's buffer is released and
+    its tracking cleared."""
+    eng_a, srv_a = _fake_replica(memkv, "ra", delay=5.0, free_slots=4)
+    eng_b, srv_b = _fake_replica(memkv, "rb", delay=0.05, free_slots=1)
+    gw = _gateway(memkv, hedge_after_s=0.3)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        hedges0, wins0 = _HEDGES.value, _HEDGE_WINS.value
+        t0 = time.monotonic()
+        out = gw.submit([9], 4).result(timeout=30)
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(out, _expected([9], 4))
+        assert eng_a.served == [[9]] and eng_b.served == [[9]]
+        assert _HEDGES.value == hedges0 + 1
+        assert _HEDGE_WINS.value == wins0 + 1
+        assert dt < 4.0, f"hedge did not rescue the tail: {dt:.2f}s"
+        # loser cancelled: ra's tracking is cleared by serve_release
+        deadline = time.monotonic() + 10
+        while srv_a.serve_stats()["tracked_requests"]:
+            assert time.monotonic() < deadline, "loser never released"
+            time.sleep(0.05)
+    finally:
+        gw.close()
+        srv_a.close()
+        srv_b.close()
+
+
+def test_zero_lost_when_replica_killed_under_load(memkv):
+    """2 real engines, sustained load, one replica hard-killed: every
+    accepted request still completes, greedy-parity-correct (the fast
+    in-process version of the SIGKILL smoke)."""
+    from edl_tpu.models.generate import generate
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.serving import ContinuousBatcher
+
+    cfg = TransformerConfig(vocab_size=53, num_layers=1, embed_dim=32,
+                            num_heads=2, mlp_dim=64, max_len=64,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    servers = []
+    for rid in ("kill-me", "survivor"):
+        eng = ContinuousBatcher(cfg, params, slots=2, temperature=0.0,
+                                prefill_buckets=(8, 16), steps_per_sync=4)
+        servers.append(ReplicaServer(memkv, "job", eng, replica_id=rid,
+                                     host="127.0.0.1", ttl=5,
+                                     advert_period=0.2))
+    gw = _gateway(memkv, max_inflight=8, max_queue=16,
+                  request_timeout_s=120.0)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 53, (n,)).astype(np.int32)
+                   for n in (3, 7, 5, 9, 4, 6, 8, 3, 5, 7, 4, 6)]
+        futs = [gw.submit(p, 10) for p in prompts]
+        time.sleep(0.3)               # let some land on each replica
+        victim = servers[0]
+        victim._rpc.stop()            # wire dies
+        victim._engine.stop()         # in-flight futures fail
+        victim._register.stop()       # advert gone
+        outs = [f.result(timeout=120) for f in futs]
+        for p, o in zip(prompts, outs):
+            want = np.asarray(generate(cfg, params, jnp.asarray(p[None]),
+                                       10, temperature=0.0))[0]
+            np.testing.assert_array_equal(o, want)
+    finally:
+        gw.close()
+        for s in servers[1:]:
+            s.close()
+
+
+# -- replica server ---------------------------------------------------------
+def test_replica_drain_finishes_inflight_then_refuses(memkv):
+    eng, srv = _fake_replica(memkv, "r0", delay=0.3)
+    fut = eng.submit([1], 2)          # simulate in-flight work
+    assert "r0" in fleet.list_replicas(memkv, "job")
+    assert srv.drain(timeout=30)
+    fut.result(timeout=1)             # in-flight completed, not failed
+    with pytest.raises(EdlUnavailableError):
+        srv.serve_submit(request_id="x", prompt=[1], max_new=2)
+    assert "r0" not in fleet.list_replicas(memkv, "job")
+    srv.close()
+
+
+def test_replica_wire_chunked_fetch_roundtrip(memkv):
+    from edl_tpu.rpc import chunks
+    from edl_tpu.rpc.client import RpcClient
+
+    eng, srv = _fake_replica(memkv, "r0")
+    try:
+        with RpcClient(srv.endpoint) as client:
+            client.call("serve_submit", request_id="q1", prompt=[40],
+                        max_new=6)
+            # idempotent re-submit (gateway transport retry)
+            client.call("serve_submit", request_id="q1", prompt=[40],
+                        max_new=6)
+            deadline = time.monotonic() + 10
+            while True:
+                r = client.call("serve_wait", request_id="q1", timeout=0.1)
+                if r["done"]:
+                    break
+                assert time.monotonic() < deadline
+            import functools
+            data = chunks.fetch_bytes(
+                functools.partial(client.call, "serve_fetch",
+                                  request_id="q1"),
+                r["nbytes"], chunk_bytes=8)   # force multiple chunks
+            np.testing.assert_array_equal(np.frombuffer(data, np.int32),
+                                          _expected([40], 6))
+            client.call("serve_release", request_id="q1")
+            assert srv.serve_stats()["tracked_requests"] == 0
+        assert eng.served == [[40]]
+    finally:
+        srv.close()
+
+
+def test_publish_engine_stats_sets_gauges():
+    from edl_tpu.obs.metrics import REGISTRY
+
+    publish_engine_stats({"slots": 8, "active_slots": 3, "queue_depth": 5,
+                          "prefill_stall_s": 1.25, "tokens_per_s": 321.0})
+    assert REGISTRY.get("edl_serving_free_slots").value == 5.0
+    assert REGISTRY.get("edl_serving_queue_depth").value == 5.0
+    assert REGISTRY.get("edl_serving_prefill_stall_seconds").value == 1.25
+    assert REGISTRY.get("edl_serving_tokens_per_s").value == 321.0
+    assert REGISTRY.get("edl_serving_active_slots").value == 3.0
+
+
+def test_gateway_server_wire_roundtrip(memkv):
+    from edl_tpu.rpc.client import RpcClient
+
+    eng, srv = _fake_replica(memkv, "r0")
+    gws = GatewayServer(memkv, "job", GatewayConfig(
+        max_inflight=2, max_queue=0, wait_slice_s=0.05,
+        poll_period_s=0.05), host="127.0.0.1")
+    try:
+        assert gws.gateway.wait_for_replicas(1, 10)
+        with RpcClient(gws.endpoint) as client:
+            r = client.call("gate_generate", prompt=[30], max_new=4)
+            assert r["tokens"] == [int(x) for x in _expected([30], 4)]
+            stats = client.call("gate_stats")
+            assert "r0" in stats["replicas"]
+    finally:
+        gws.stop()
+        srv.close()

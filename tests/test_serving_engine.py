@@ -359,3 +359,40 @@ def test_stop_fails_pending(small):
     # all futures resolve one way or the other — none hang
     done = sum(1 for f in futs if f.done())
     assert done == 4
+
+
+def test_drain_completes_queued_and_inflight(small):
+    """drain() is the graceful replica-removal path: admission stops,
+    but every queued + in-flight request runs to completion — where
+    stop() (the hard path above) FAILS them."""
+    import threading
+
+    cfg, params = small
+    eng = _engine(cfg, params, slots=1)   # 1 slot: most requests queued
+    futs = [eng.submit(np.asarray([3, 4], np.int32), 8) for _ in range(5)]
+    drained = []
+    t = threading.Thread(target=lambda: drained.append(eng.drain()))
+    t.start()
+    # the draining flag is up before completion: new submits refuse
+    deadline = time.monotonic() + 120
+    while not eng.stats()["draining"]:
+        assert time.monotonic() < deadline, "drain flag never observed"
+        time.sleep(0.005)
+    with pytest.raises(RuntimeError, match="draining|stopping"):
+        eng.submit(np.asarray([5], np.int32), 4)
+    t.join(timeout=120)
+    assert drained == [True]
+    for f in futs:
+        out = f.result(timeout=1)         # resolved, with real tokens
+        assert len(out) == 8
+
+
+def test_drain_timeout_falls_back_to_hard_stop(small):
+    cfg, params = small
+    eng = _engine(cfg, params, slots=1)
+    futs = [eng.submit(np.asarray([3, 4], np.int32), 40) for _ in range(3)]
+    assert eng.drain(timeout=0.0) is False   # deadline already passed
+    # hard-stop fallback: every future resolves (with an error), none hang
+    for f in futs:
+        assert f.done()
+    assert sum(1 for f in futs if f.exception() is not None) >= 1

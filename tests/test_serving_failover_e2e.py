@@ -1,0 +1,33 @@
+"""Multi-process serving failover e2e (slow tier).
+
+Drives scripts/gateway_smoke.py — the canonical harness: two replica
+PROCESSES against a real coordination server, greedy parity through the
+gateway, a deterministic SIGSTOP-induced hedge, a SIGKILL under
+sustained load with zero lost accepted requests, saturation rejects,
+and the edl_gateway_*/edl_serving_* metrics + route/hedge/retry trace
+spans.  One harness for CI and the suite so the acceptance proof can't
+drift from what CI runs.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_gateway_sigkill_failover_e2e(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               EDL_TPU_METRICS_PORT="0",
+               EDL_TPU_TRACE_DIR=str(tmp_path / "trace"))
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gateway_smoke.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=580)
+    assert out.returncode == 0, out.stdout[-4000:]
+    assert "gateway smoke OK" in out.stdout
+    assert "SIGKILL under load" in out.stdout
