@@ -291,6 +291,16 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- tracing overhead: distributed tracing must stay invisible ------------
+    # tracing-on vs tracing-off step latency + the gateway p50/p99 under
+    # an active tracer, so trace-context cost shows in the perf trajectory
+    if os.environ.get("EDL_TPU_BENCH_TRACE", "1") != "0":
+        try:
+            out.update(_bench_trace())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -388,6 +398,64 @@ def _bench_memstate() -> dict:
             s.stop()
         store.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_trace() -> dict:
+    """Tracing overhead guard: the same jitted step timed with the
+    NullTracer vs a real JSONL tracer (span per step, ambient trace
+    context — the per-step worst case; production traces at phase
+    boundaries), plus the gateway burst re-run under an active tracer
+    so fleet-level p50/p99 with tracing on sits next to the tracing-off
+    numbers from the main gateway section."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.obs import context as obs_context
+    from edl_tpu.obs import trace as obs_trace
+
+    n = int(os.environ.get("EDL_TPU_BENCH_TRACE_STEPS", 200))
+    x = jnp.asarray(np.random.default_rng(0)
+                    .normal(size=(256, 256)).astype(np.float32))
+    step = jax.jit(lambda a: a @ a)
+    step(x).block_until_ready()
+
+    def run_steps() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs_trace.span("bench/step"):
+                step(x).block_until_ready()
+        return (time.perf_counter() - t0) / n
+
+    prev = obs_trace.install(obs_trace.NullTracer())
+    tmp = tempfile.mkdtemp(prefix="edl-bench-trace-")
+    out: dict = {}
+    try:
+        off_s = run_steps()
+        tracer = obs_trace.Tracer(os.path.join(tmp, "bench.jsonl"), "bench")
+        obs_trace.install(tracer)
+        with obs_context.use(obs_context.new_trace()):
+            on_s = run_steps()
+        out.update({
+            "trace_step_us_off": round(off_s * 1e6, 1),
+            "trace_step_us_on": round(on_s * 1e6, 1),
+            "trace_overhead_pct": round(100.0 * (on_s - off_s)
+                                        / max(off_s, 1e-12), 2),
+        })
+        if os.environ.get("EDL_TPU_BENCH_GATEWAY", "1") != "0":
+            g = _bench_gateway()
+            out.update({
+                "gateway_traced_p50_ms": g["gateway_p50_ms"],
+                "gateway_traced_p99_ms": g["gateway_p99_ms"],
+                "gateway_traced_tokens_s": g["gateway_tokens_s"],
+            })
+        tracer.close()
+    finally:
+        obs_trace.install(prev)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 def _bench_gateway() -> dict:

@@ -28,6 +28,8 @@ from edl_tpu.collective.leader import LeaderElector
 from edl_tpu.collective.pod_server import start_pod_server
 from edl_tpu.collective.watcher import ClusterWatcher
 from edl_tpu.data.data_server import DataService
+from edl_tpu.obs import advert as obs_advert
+from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
@@ -63,6 +65,12 @@ class Launcher:
         self._cache_service = None        # memstate peer checkpoint cache
         self._cache_register = None       # its TTL-leased advert
         self._resource_register = None
+        self._obs_register = None         # /metrics advert for edl-obs-agg
+        # one distributed trace per cluster generation: the initial
+        # launch roots one, every membership change roots a fresh one,
+        # and spawned trainers inherit it via EDL_TPU_TRACE_CONTEXT —
+        # so a resize's launcher AND trainer halves share one trace_id
+        self._stage_ctx = obs_context.new_trace()
         self._elector: LeaderElector | None = None
         self._generator: ClusterGenerator | None = None
         self._procs: list[train_process.TrainerProc] = []
@@ -134,6 +142,10 @@ class Launcher:
         job_id = self._job_env.job_id
         self._resource_register = resource.register_pod(self._store, job_id,
                                                         self._pod, ttl=self._ttl)
+        # if the env-gated /metrics endpoint is serving, advertise it in
+        # the coord store so edl-obs-agg discovers this launcher
+        self._obs_register = obs_advert.advertise_installed(
+            self._store, job_id, "launcher", ttl=self._ttl)
         if self._cache_service is not None:
             # TTL-leased cache advert next to the pod resource advert:
             # the advert dying with this launcher is exactly the
@@ -159,7 +171,8 @@ class Launcher:
             watcher.start()
             self._procs = train_process.start_trainers(
                 self._job_env, self._pod, cluster, self._script,
-                self._script_args, self._log_dir())
+                self._script_args, self._log_dir(),
+                extra_env=self._trainer_trace_env())
             if resize_times is not None:
                 resize_times["spawn"] = time.time()
                 # hang restarts reuse the stage; suffix the record key so
@@ -180,11 +193,16 @@ class Launcher:
             logger.info("membership changed; re-barrier + restart trainers")
             _RESIZES_TOTAL.inc()
             resize_times = {"detect": time.time()}
+            # a fresh distributed trace for this resize epoch: every
+            # phase event below, the recovery-record trace events, and
+            # the respawned trainers' spans all carry its trace_id
+            self._stage_ctx = obs_context.new_trace()
             # tagged from_stage: the change is detected in the OLD stage;
             # the per-phase events land under the post-barrier stage id
             # (the stage the recovery record is keyed by)
-            obs_trace.emit("resize/detect", at=resize_times["detect"],
-                           from_stage=cluster.stage)
+            with obs_context.use(self._stage_ctx):
+                obs_trace.emit("resize/detect", at=resize_times["detect"],
+                               from_stage=cluster.stage)
             if self._hang_incident is not None:
                 resize_times["_hang_suffix"] = \
                     f"+hang{int(self._hang_incident)}"
@@ -352,7 +370,8 @@ class Launcher:
                 self._clear_heartbeat()
                 self._procs = train_process.start_trainers(
                     self._job_env, self._pod, cluster, self._script,
-                    self._script_args, self._log_dir())
+                    self._script_args, self._log_dir(),
+                    extra_env=self._trainer_trace_env())
             time.sleep(self._period)
 
     def _count_hang(self, stage: str) -> bool:
@@ -440,15 +459,24 @@ class Launcher:
         import os
         return os.path.join(self._job_env.log_dir, self._pod.pod_id[:8])
 
+    def _trainer_trace_env(self) -> dict[str, str]:
+        """Env for spawned trainers: the current stage's trace context,
+        so the whole trainer process (restore spans, first-step record)
+        joins this resize epoch's trace."""
+        return {obs_context.ENV_VAR: self._stage_ctx.to_env()}
+
     def _write_recovery(self, stage: str, times: dict) -> None:
         """Launcher half of the resize timing record (the trainer adds
         restore/first-step under the same stage key — see
         ElasticTrainer._report_recovery).  One unified write drives the
         store record, the resize-phase histogram, and the trace events
-        (cluster/recovery.py).  Best-effort."""
+        (cluster/recovery.py) — all under this resize epoch's trace
+        context, so the phase events carry its trace_id.  Best-effort."""
         try:
-            recovery.write_launcher_half(self._store, self._job_env.job_id,
-                                         stage, self._pod.pod_id, times)
+            with obs_context.use(self._stage_ctx):
+                recovery.write_launcher_half(self._store,
+                                             self._job_env.job_id,
+                                             stage, self._pod.pod_id, times)
         except Exception:  # noqa: BLE001 — metrics must never fail a job
             logger.exception("recovery record write failed")
 
@@ -488,6 +516,8 @@ class Launcher:
         self._stop_generator()
         if self._cache_register:
             self._cache_register.stop()
+        if self._obs_register:
+            self._obs_register.stop()
         if self._resource_register:
             self._resource_register.stop()
         if self._server:

@@ -74,7 +74,12 @@ class TrainerProc:
 
 
 def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
-                   script_args: list[str], log_dir: str) -> list[TrainerProc]:
+                   script_args: list[str], log_dir: str,
+                   extra_env: dict[str, str] | None = None,
+                   ) -> list[TrainerProc]:
+    """``extra_env`` wins over the inherited environment — the launcher
+    uses it to hand each spawned trainer the current resize epoch's
+    trace context (EDL_TPU_TRACE_CONTEXT, obs/context.py)."""
     os.makedirs(log_dir, exist_ok=True)
     procs = []
     for trainer in pod.trainers:
@@ -82,6 +87,8 @@ def start_trainers(job_env: JobEnv, pod, cluster, training_script: str,
         for var in _PROXY_VARS:
             env.pop(var, None)
         env.update(trainer_env_vars(job_env, pod, trainer, cluster))
+        if extra_env:
+            env.update(extra_env)
         log_path = os.path.join(log_dir, f"workerlog.{trainer.rank_in_pod}")
         logf = open(log_path, "ab", buffering=0)
         offset = logf.tell()

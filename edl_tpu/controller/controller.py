@@ -28,6 +28,7 @@ from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
 from edl_tpu.controller.actuator import NullActuator
 from edl_tpu.controller.policy import JobView, compute_desired
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
@@ -237,9 +238,13 @@ class Controller:
             direction = "up" if want > v.current_nodes else "down"
             _DECISIONS_TOTAL.labels(job=v.job_id, direction=direction).inc()
             _DESIRED_NODES.labels(job=v.job_id).set(want)
-            obs_trace.emit("controller/scale", job=v.job_id,
-                           from_nodes=v.current_nodes, to_nodes=want,
-                           resize_cost_s=v.resize_cost_s)
+            # each scale decision roots its own distributed trace — the
+            # controller is the first cause of the resize epoch the
+            # launchers will measure, so its event is id-linkable
+            with obs_context.use(obs_context.new_trace(job=v.job_id)):
+                obs_trace.emit("controller/scale", job=v.job_id,
+                               from_nodes=v.current_nodes, to_nodes=want,
+                               resize_cost_s=v.resize_cost_s)
         return acted
 
     def _reap_finished(self, jobs: list[str]) -> None:

@@ -47,6 +47,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 import numpy as np
 
 from edl_tpu.gateway.fleet import FleetView
+from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import metrics as obs_metrics, trace
 from edl_tpu.rpc import chunks
 from edl_tpu.rpc.client import RpcClient
@@ -121,7 +122,7 @@ class GatewayConfig:
 
 
 class _GwRequest:
-    __slots__ = ("id", "prompt", "max_new", "session", "future")
+    __slots__ = ("id", "prompt", "max_new", "session", "future", "ctx")
 
     def __init__(self, prompt: list[int], max_new: int, session: str | None):
         self.id = uuid.uuid4().hex
@@ -129,6 +130,14 @@ class _GwRequest:
         self.max_new = max_new
         self.session = session
         self.future: Future = Future()
+        # one trace per request, stamped at admission: joins the
+        # caller's trace when one is ambient (e.g. a GatewayServer
+        # handler re-established the wire context), else roots a new
+        # one.  Every replica leg's RPCs carry it, so spans emitted by
+        # the replica PROCESS inherit this id (obs/context.py).
+        parent = obs_context.current()
+        self.ctx = (parent.child() if parent is not None
+                    else obs_context.new_trace())
 
 
 class Gateway:
@@ -261,6 +270,13 @@ class Gateway:
 
     # -- the request driver --------------------------------------------------
     def _run(self, req: _GwRequest) -> None:
+        # pool threads have no ambient context: re-establish the
+        # request's so driver-side events (hedge/retry) join its trace
+        with obs_context.use(req.ctx):
+            self._drive(req)
+
+    def _drive(self, req: _GwRequest) -> None:
+        t_wall = time.time()
         t0 = time.monotonic()
         deadline = t0 + self.cfg.request_timeout_s
         hedge_at = (t0 + self.cfg.hedge_after_s
@@ -352,17 +368,27 @@ class Gateway:
                 self._admitted -= 1
                 _QUEUE_DEPTH.set(self._admitted)
             _REQ_SECONDS.observe(time.monotonic() - t0)
-            _REQUESTS.labels(
-                outcome="ok" if req.future.exception() is None
-                else "error").inc()
+            outcome = ("ok" if req.future.exception() is None else "error")
+            _REQUESTS.labels(outcome=outcome).inc()
+            # the request's root span: one per trace, at the stamping
+            # process — the anchor `edl-obs-dump --merge` timelines
+            # start from
+            trace.emit("gateway/request", at=t_wall,
+                       dur=time.monotonic() - t0, request=req.id,
+                       outcome=outcome)
 
     def _launch(self, req: _GwRequest, rid: str, endpoint: str,
                 winner: threading.Event, results: queue_mod.Queue,
                 deadline: float, hedged: bool) -> None:
         with self._state_lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        # one child span per leg; the leg thread re-establishes it so
+        # serve_submit/serve_wait/serve_fetch RPCs carry the request's
+        # trace into the replica process
+        leg_ctx = req.ctx.child()
 
         def leg():
+            t_wall = time.time()
             t0 = time.monotonic()
             status = "ok"
             try:
@@ -386,11 +412,15 @@ class Gateway:
                         self._inflight.pop(rid, None)
                     else:
                         self._inflight[rid] = n
-                trace.emit("gateway/route", request=req.id, replica=rid,
-                           dur=time.monotonic() - t0, hedged=hedged,
-                           status=status)
+                trace.emit("gateway/route", at=t_wall, request=req.id,
+                           replica=rid, dur=time.monotonic() - t0,
+                           hedged=hedged, status=status)
 
-        threading.Thread(target=leg, daemon=True,
+        def leg_in_ctx():
+            with obs_context.use(leg_ctx):
+                leg()
+
+        threading.Thread(target=leg_in_ctx, daemon=True,
                          name=f"gw-leg:{rid[:8]}").start()
 
     def _attempt(self, req: _GwRequest, endpoint: str,
@@ -461,8 +491,9 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
     """``edl-gateway`` / ``python -m edl_tpu.gateway.gateway``."""
     import argparse
 
+    from edl_tpu import obs
     from edl_tpu.coord.client import connect
-    from edl_tpu.obs import exposition
+    from edl_tpu.obs import advert as obs_advert
     from edl_tpu.utils.logger import configure
 
     p = argparse.ArgumentParser("edl_tpu.gateway")
@@ -478,13 +509,15 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
     p.add_argument("--request_timeout", type=float, default=600.0)
     args = p.parse_args(argv)
     configure()
-    trace.configure_from_env("gateway")
-    exposition.serve_from_env("gateway")
+    obs.install_from_env("gateway")
     cfg = GatewayConfig(max_inflight=args.max_inflight,
                         max_queue=args.max_queue, rate=args.rate,
                         burst=args.burst, hedge_after_s=args.hedge_after,
                         request_timeout_s=args.request_timeout)
-    server = GatewayServer(connect(args.coord_endpoints), args.job_id,
+    store = connect(args.coord_endpoints)
+    # TTL-leased advert so edl-obs-agg can discover this /metrics page
+    obs_advert.advertise_installed(store, args.job_id, "gateway")
+    server = GatewayServer(store, args.job_id,
                            cfg, host=args.host, port=args.port)
     print(f"[edl-gateway] serving on {server.endpoint}", flush=True)
     try:

@@ -27,6 +27,7 @@ import zlib
 
 from edl_tpu.memstate import advert, placement
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
 from edl_tpu.utils.exceptions import EdlInternalError
 from edl_tpu.utils.logger import get_logger
@@ -161,6 +162,11 @@ class StateCacheService:
             self._account_locked()
         _SETS_COMMITTED.labels(
             role="own" if owner == self._pod_id else "replica").inc()
+        # under the RPC wire's re-established context: the commit event
+        # joins the pushing trainer's trace (one id from save to seal)
+        obs_trace.emit("memstate/commit", owner=owner, step=step,
+                       shards=len(staged),
+                       bytes=sum(len(d) for d in staged.values()))
         if owner == self._pod_id:
             # replicate own sets only (a replica replicating onward
             # would walk the whole ring); thread keeps commit non-blocking
@@ -174,9 +180,14 @@ class StateCacheService:
         """Every committed set held here:
         ``{owner: {"step", "shards": manifest, "has_meta"}}``."""
         with self._lock:
-            return {owner: {"step": s.step, "shards": s.manifest,
-                            "has_meta": s.meta is not None}
-                    for owner, s in self._sets.items()}
+            out = {owner: {"step": s.step, "shards": s.manifest,
+                           "has_meta": s.meta is not None}
+                   for owner, s in self._sets.items()}
+        # once per restore per holder — the event that ties a restoring
+        # trainer's trace to the peer pods that served it
+        obs_trace.emit("memstate/manifest", pod=self._pod_id,
+                       sets=len(out))
+        return out
 
     def cache_fetch(self, owner: str, key: str, offset: int,
                     length: int) -> bytes:

@@ -1,27 +1,38 @@
 """Unified observability: metrics registry + /metrics exposition +
-structured JSONL trace.
+structured JSONL trace + distributed trace context.
 
-Three opt-in surfaces over one instrumentation layer:
+Four opt-in surfaces over one instrumentation layer:
 
 - **Metrics** (:mod:`edl_tpu.obs.metrics`): dependency-free Counter /
   Gauge / Histogram with labels on a process-wide registry, exposed in
   Prometheus text format by :mod:`edl_tpu.obs.exposition`
   (``EDL_TPU_METRICS_PORT``).
 - **Trace** (:mod:`edl_tpu.obs.trace`): JSONL events with monotonic
-  span durations (``EDL_TPU_TRACE_DIR``) — the per-phase resize record
-  and the store's recovery records are written by the same code
+  span durations (``EDL_TPU_TRACE_DIR``, size-capped via
+  ``EDL_TPU_TRACE_MAX_MB``) — the per-phase resize record and the
+  store's recovery records are written by the same code
   (:mod:`edl_tpu.cluster.recovery`), so they agree by construction.
+- **Trace context** (:mod:`edl_tpu.obs.context`): Dapper-style
+  (trace_id, span_id, baggage) carried in every EDL1 RPC envelope and
+  attached to every emitted event, so one id links a request or resize
+  across processes (``EDL_TPU_TRACE_CONTEXT`` seeds spawned trainers).
 - **Store readers**: :mod:`edl_tpu.obs.dump` (``python -m
-  edl_tpu.obs.dump`` — per-resize phase timeline + job summary) and
-  :mod:`edl_tpu.obs.collector` (CSV time-series poller).
+  edl_tpu.obs.dump`` — per-resize phase timeline + job summary, and
+  ``--merge`` multi-process trace timelines with Perfetto export),
+  :mod:`edl_tpu.obs.collector` (CSV time-series poller), and
+  :mod:`edl_tpu.obs.agg` (``edl-obs-agg`` — job-level merged /metrics
+  + /healthz over coord-store-discovered endpoints,
+  :mod:`edl_tpu.obs.advert`).
 
 CLI entry points call :func:`install_from_env` right after
 ``utils.logger.configure`` — library code never starts servers or
-opens files at import time.  ``dump``/``collector`` are deliberately
-NOT imported here: they pull in the cluster layer, which itself uses
-the metrics/trace submodules.
+opens files at import time.  ``dump``/``collector``/``agg``/``advert``
+are deliberately NOT imported here: they pull in the cluster/coord
+layers, which themselves use the metrics/trace submodules.
 """
 
+from edl_tpu.obs import context  # noqa: F401
+from edl_tpu.obs.context import TraceContext, new_trace  # noqa: F401
 from edl_tpu.obs.exposition import (  # noqa: F401
     MetricsServer, installed_server, serve_from_env,
 )
@@ -37,7 +48,11 @@ from edl_tpu.obs.trace import configure_from_env as configure_tracer_from_env  #
 
 def install_from_env(component: str = "edl") -> None:
     """Enable the env-gated observability surfaces for this process:
-    the /metrics endpoint (``EDL_TPU_METRICS_PORT``) and the JSONL
-    tracer (``EDL_TPU_TRACE_DIR``).  Idempotent, never raises."""
+    the /metrics endpoint (``EDL_TPU_METRICS_PORT``), the JSONL
+    tracer (``EDL_TPU_TRACE_DIR``), and the inherited distributed
+    trace context (``EDL_TPU_TRACE_CONTEXT``, stamped by the launcher
+    so a trainer's whole process joins its resize epoch's trace).
+    Idempotent, never raises."""
     serve_from_env(component)
     configure_tracer_from_env(component)
+    context.install_from_env()

@@ -1,0 +1,85 @@
+"""Coordination-store adverts for per-process /metrics endpoints.
+
+PR 1's exposition layer gave every process a /metrics endpoint plus an
+addr *file* (``EDL_TPU_METRICS_DIR``) — discoverable on one host, not
+across a job.  This module lifts the same fact into the coordination
+store the job already shares: a TTL-leased advert under the ``obs``
+table (the memstate/serving advert pattern), so the job-level
+aggregator (:mod:`edl_tpu.obs.agg`) can discover every live process's
+endpoint with one prefix read, and a dead process's advert expires with
+its lease::
+
+    obs/metrics/<component>-<pid> -> JSON {
+        "endpoint": "ip:port",   # the process's MetricsServer
+        "component": "trainer",  # launcher|trainer|gateway|replica|...
+        "pid": 4242,
+        "ts": 1700000000.5,
+    }
+
+:func:`advertise_installed` is the one-liner integration point: it
+advertises the already-running env-gated endpoint and never raises —
+observability must never fail a job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.register import Register
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def _prefix(job_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_OBS, "metrics/")
+
+
+def advertise_metrics(store, job_id: str, component: str, endpoint: str,
+                      name: str | None = None,
+                      ttl: float = constants.ETCD_TTL) -> Register:
+    """TTL-leased /metrics advert; returns the Register to ``stop()``."""
+    name = name or f"{component}-{os.getpid()}"
+    payload = {"endpoint": endpoint, "component": component,
+               "pid": os.getpid(), "ts": time.time()}
+    return Register(store, paths.key(job_id, constants.ETCD_OBS,
+                                     f"metrics/{name}"),
+                    json.dumps(payload).encode(), ttl=ttl)
+
+
+def list_metrics_targets(store, job_id: str) -> dict[str, dict]:
+    """Live /metrics endpoints: ``{advert_name: payload}``."""
+    prefix = _prefix(job_id)
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, dict] = {}
+    for rec in recs:
+        try:
+            payload = json.loads(rec.value.decode())
+            payload["endpoint"]  # torn advert without an endpoint: skip
+        except (ValueError, KeyError, TypeError):
+            # TypeError: valid JSON that isn't an object (payload["..."]
+            # on a list/number) — as torn as any other malformed advert
+            continue  # the lease will expire it
+        out[rec.key[len(prefix):]] = payload
+    return out
+
+
+def advertise_installed(store, job_id: str, component: str,
+                        ttl: float = constants.ETCD_TTL) -> Register | None:
+    """Advertise this process's env-gated /metrics endpoint (if one is
+    serving) in the coord store.  Best-effort, never raises."""
+    from edl_tpu.obs import exposition
+
+    srv = exposition.installed_server()
+    if srv is None:
+        return None
+    try:
+        return advertise_metrics(store, job_id, component, srv.endpoint,
+                                 ttl=ttl)
+    except Exception:  # noqa: BLE001 — metrics must never fail a job
+        logger.exception("metrics advert failed for %s", component)
+        return None

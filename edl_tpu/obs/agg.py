@@ -1,0 +1,377 @@
+"""``edl-obs-agg`` (``python -m edl_tpu.obs.agg``): the job-level
+observability aggregator.
+
+An elastic job is a fleet of processes, each serving its own /metrics
+endpoint (PR 1) — scraping them by hand does not survive a resize.
+The aggregator closes the loop: it discovers every live process through
+the TTL-leased ``obs`` adverts (:mod:`edl_tpu.obs.advert`), scrapes
+each endpoint, and serves
+
+- ``/metrics`` — ONE merged, byte-parseable Prometheus page: every
+  sample gains ``component``/``instance`` labels identifying its source
+  process, and each metric family's ``# HELP``/``# TYPE`` header
+  appears exactly once even when several processes export the same
+  name with different label sets;
+- ``/healthz`` — a JSON job summary: live processes by component, last
+  resize duration (from the store's recovery records), and gateway
+  p50/p99 estimated from the merged request-latency histogram.
+
+Discovery is store-driven, so targets come and go with their leases —
+a killed replica vanishes from the merged page within one TTL, a
+resize's respawned trainers appear on their next advert.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from edl_tpu.obs import advert
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs.metrics import REGISTRY, parse_exposition
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+_TARGETS_G = obs_metrics.gauge(
+    "edl_obs_agg_targets",
+    "Live /metrics targets discovered via the coord store")
+_SCRAPES_TOTAL = obs_metrics.counter(
+    "edl_obs_agg_scrapes_total", "Target scrapes, by outcome", ("outcome",))
+_COLLECT_SECONDS = obs_metrics.histogram(
+    "edl_obs_agg_collect_seconds",
+    "Full discover+scrape+merge latency")
+
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family_of(sample_name: str, current: str | None,
+               families: dict) -> str:
+    """Attribute a sample line to its metric family.  Pages rendered by
+    our Registry always precede samples with # HELP/# TYPE, so the
+    current comment family wins; headerless pages fall back to suffix
+    stripping against already-seen families, else the sample name."""
+    if current is not None and (
+            sample_name == current
+            or any(sample_name == current + s for s in _FAMILY_SUFFIXES)):
+        return current
+    for s in _FAMILY_SUFFIXES:
+        if sample_name.endswith(s) and sample_name[:-len(s)] in families:
+            return sample_name[:-len(s)]
+    return sample_name
+
+
+def merge_expositions(pages) -> str:
+    """Merge ``(extra_labels: dict, exposition_text)`` pages into one
+    parseable Prometheus page.
+
+    Every sample line gains ``extra_labels`` (existing label names are
+    never overwritten), and ``# HELP``/``# TYPE`` are emitted exactly
+    once per family — first page wins — even when two processes export
+    the same metric name with different label sets.  Families come out
+    sorted by name, samples in page order, so output is deterministic.
+    """
+    families: dict[str, dict] = {}
+    for extra, text in pages:
+        extra_pairs = [(k, obs_metrics._escape_label(str(v)))
+                       for k, v in sorted(extra.items())]
+        current: str | None = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(" ", 3)
+                if len(parts) < 3:
+                    continue
+                name = parts[2]
+                fam = families.setdefault(
+                    name, {"help": None, "type": None, "samples": []})
+                slot = "help" if parts[1] == "HELP" else "type"
+                if fam[slot] is None:
+                    fam[slot] = line
+                current = name
+                continue
+            if line.startswith("#"):
+                continue
+            m = obs_metrics._SAMPLE_RE.match(line)
+            if m is None:
+                continue  # never let one bad source line poison the page
+            name, labelstr, value = m.groups()
+            pairs = (obs_metrics._LABEL_PAIR_RE.findall(labelstr)
+                     if labelstr else [])
+            have = {k for k, _ in pairs}
+            pairs += [(k, v) for k, v in extra_pairs if k not in have]
+            lab = ("{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+                   if pairs else "")
+            fam_name = _family_of(name, current, families)
+            fam = families.setdefault(
+                fam_name, {"help": None, "type": None, "samples": []})
+            fam["samples"].append(f"{name}{lab} {value}")
+    lines: list[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam["help"]:
+            lines.append(fam["help"])
+        if fam["type"]:
+            lines.append(fam["type"])
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def quantile_from_buckets(buckets: dict[float, float],
+                          q: float) -> float | None:
+    """Prometheus-style quantile estimate from cumulative ``le`` bucket
+    counts (linear interpolation within the winning bucket; the +Inf
+    bucket resolves to the previous bound, the classic histogram_quantile
+    behavior).  None when the histogram is empty."""
+    items = sorted(buckets.items())
+    if not items or items[-1][1] <= 0:
+        return None
+    total = items[-1][1]
+    target = q * total
+    prev_le, prev_c = 0.0, 0.0
+    for le, c in items:
+        if c >= target:
+            if le == math.inf:
+                return prev_le
+            span = c - prev_c
+            frac = 0.0 if span <= 0 else (target - prev_c) / span
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_c = le, c
+    return None
+
+
+def _histogram_buckets(parsed: dict, family: str) -> dict[float, float]:
+    """Sum a family's cumulative bucket counts across all targets."""
+    out: dict[float, float] = {}
+    for (name, labels), value in parsed.items():
+        if name != family + "_bucket":
+            continue
+        le = dict(labels).get("le")
+        if le is None:
+            continue
+        out[float(le)] = out.get(float(le), 0.0) + value
+    return out
+
+
+class Aggregator:
+    """Discover + scrape + merge; the HTTP surface sits on top.
+
+    ``collect()`` results are cached ``cache_s`` seconds so N scrapers
+    of the aggregator amplify into at most one fan-out per window."""
+
+    def __init__(self, store, job_id: str, scrape_timeout: float = 3.0,
+                 cache_s: float = 0.5, include_self: bool = True):
+        self.store = store
+        self.job_id = job_id
+        self.scrape_timeout = scrape_timeout
+        self.cache_s = cache_s
+        self.include_self = include_self
+        self._lock = threading.Lock()
+        self._cached: tuple[float, str, dict] | None = None
+
+    def collect(self) -> tuple[str, dict]:
+        """(merged exposition text, info dict) — info carries targets,
+        per-target errors, and scrape counts for /healthz."""
+        with self._lock:
+            if (self._cached is not None
+                    and time.monotonic() - self._cached[0] < self.cache_s):
+                return self._cached[1], self._cached[2]
+            t0 = time.perf_counter()
+            targets = advert.list_metrics_targets(self.store, self.job_id)
+            _TARGETS_G.set(len(targets))
+            pages: list[tuple[dict, str]] = []
+            scraped: dict[str, str] = {}
+            errors: dict[str, str] = {}
+
+            def scrape(name: str):
+                endpoint = targets[name]["endpoint"]
+                text = urllib.request.urlopen(
+                    f"http://{endpoint}/metrics",
+                    timeout=self.scrape_timeout).read().decode()
+                return endpoint, text
+
+            # concurrent scrapes: dead targets' adverts outlive them by
+            # up to one lease TTL, so with sequential fetches every
+            # dead process would add a full timeout to EVERY request —
+            # in parallel the whole fan-out costs at most one timeout
+            with ThreadPoolExecutor(
+                    max_workers=min(8, max(1, len(targets)))) as pool:
+                futures = {name: pool.submit(scrape, name)
+                           for name in sorted(targets)}
+                for name, fut in futures.items():
+                    component = str(targets[name].get("component",
+                                                      "unknown"))
+                    try:
+                        endpoint, text = fut.result()
+                        pages.append(({"component": component,
+                                       "instance": endpoint}, text))
+                        scraped[name] = endpoint
+                        _SCRAPES_TOTAL.labels(outcome="ok").inc()
+                    except Exception as e:  # noqa: BLE001 — a dead target must not kill the page
+                        errors[name] = f"{type(e).__name__}: {e}"
+                        _SCRAPES_TOTAL.labels(outcome="error").inc()
+            if self.include_self:
+                # the aggregator's own registry rides along, so its
+                # scrape/error counters are visible on the merged page
+                pages.append(({"component": "obs-agg", "instance": "self"},
+                              REGISTRY.render()))
+            merged = merge_expositions(pages)
+            info = {"targets": targets, "scraped": scraped, "errors": errors}
+            _COLLECT_SECONDS.observe(time.perf_counter() - t0)
+            self._cached = (time.monotonic(), merged, info)
+            return merged, info
+
+    def job_summary(self) -> dict:
+        """The /healthz body: live pods by component, resize + gateway
+        headline numbers — the one-request job overview."""
+        merged, info = self.collect()
+        components: dict[str, int] = {}
+        for t in info["targets"].values():
+            c = str(t.get("component", "unknown"))
+            components[c] = components.get(c, 0) + 1
+        summary: dict = {
+            "job_id": self.job_id,
+            "live_targets": len(info["targets"]),
+            "components": components,
+            "scrape_errors": info["errors"],
+        }
+        try:
+            # lazy: summarize_recovery pulls the cluster layer (same
+            # reason dump/collector stay out of obs/__init__)
+            from edl_tpu.cluster.recovery import summarize_recovery
+            resizes = summarize_recovery(self.store, self.job_id)
+            summary["resizes"] = len(resizes)
+            summary["last_resize"] = resizes[-1] if resizes else None
+        except Exception as e:  # noqa: BLE001 — store blip must not 500 healthz
+            summary["resizes_error"] = f"{type(e).__name__}: {e}"
+        try:
+            parsed = parse_exposition(merged)
+            buckets = _histogram_buckets(parsed, "edl_gateway_request_seconds")
+            if buckets:
+                p50 = quantile_from_buckets(buckets, 0.50)
+                p99 = quantile_from_buckets(buckets, 0.99)
+                summary["gateway"] = {
+                    "requests": buckets.get(math.inf, 0.0),
+                    "p50_s": None if p50 is None else round(p50, 4),
+                    "p99_s": None if p99 is None else round(p99, 4),
+                }
+        except ValueError as e:
+            summary["merge_error"] = str(e)
+        return summary
+
+
+class AggregatorServer:
+    """The aggregator behind HTTP: ``/metrics`` (merged page) and
+    ``/healthz`` (JSON job summary)."""
+
+    def __init__(self, store, job_id: str, host: str = "0.0.0.0",
+                 port: int = 0, scrape_timeout: float = 3.0,
+                 cache_s: float = 0.5, include_self: bool = True):
+        agg = Aggregator(store, job_id, scrape_timeout=scrape_timeout,
+                         cache_s=cache_s, include_self=include_self)
+        self.aggregator = agg
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/metrics", "/"):
+                        body = agg.collect()[0].encode("utf-8")
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    elif path == "/healthz":
+                        body = (json.dumps(agg.job_summary())
+                                .encode("utf-8"))
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception:  # noqa: BLE001 — one bad collect != dead server
+                    logger.exception("aggregator request failed")
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log lines
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host = self._httpd.server_address[0]
+        if host in ("0.0.0.0", ""):
+            host = local_ip()
+        return f"{host}:{self.port}"
+
+    def start(self) -> "AggregatorServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"obs-agg:{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl_tpu.obs.agg",
+        description="Job-level observability aggregator: discover every "
+                    "process's /metrics via the coord store, serve a merged "
+                    "page + a /healthz job summary")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = auto-picked free port (printed on start)")
+    p.add_argument("--scrape_timeout", type=float, default=3.0)
+    p.add_argument("--cache_s", type=float, default=0.5,
+                   help="merged-page cache window (bounds scrape fan-out)")
+    args = p.parse_args(argv)
+
+    from edl_tpu import obs
+    from edl_tpu.coord.client import connect
+    from edl_tpu.utils.logger import configure
+
+    configure()
+    obs.install_from_env("obs-agg")
+    store = connect(args.coord_endpoints)
+    server = AggregatorServer(store, args.job_id, host=args.host,
+                              port=args.port,
+                              scrape_timeout=args.scrape_timeout,
+                              cache_s=args.cache_s).start()
+    print(f"[edl-obs-agg] job {args.job_id}: serving merged /metrics + "
+          f"/healthz on {server.endpoint}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -5,10 +5,16 @@ One line per event::
     {"ts": 1700000000.123456, "name": "resize/kill_to_barrier",
      "component": "launcher", "dur": 0.512, ...}
 
-``ts`` is wall-clock (joinable across hosts via NTP-class skew);
-``dur`` is measured with the *monotonic* clock, so spans are immune to
-wall-clock steps.  MLPerf-style training logs and Chrome trace events
-use the same shape: flat JSON records keyed by a hierarchical name.
+``ts`` is wall-clock (joinable across hosts via NTP-class skew) and is
+the *begin* of the span for events that carry ``dur``; ``dur`` is
+measured with the *monotonic* clock, so spans are immune to wall-clock
+steps.  MLPerf-style training logs and Chrome trace events use the same
+shape: flat JSON records keyed by a hierarchical name.
+
+When a distributed :mod:`~edl_tpu.obs.context` is ambient, every event
+additionally carries ``trace_id`` / ``span_id`` (and ``parent_id``), so
+``edl-obs-dump --merge`` can join per-process files into one causal
+timeline; with no ambient context, events are exactly as before.
 
 Library code calls :func:`get_tracer` and emits unconditionally — the
 default is a :class:`NullTracer`, so a job that never opted in pays a
@@ -16,6 +22,12 @@ no-op call.  CLI entry points opt in via
 :func:`configure_from_env` (``EDL_TPU_TRACE_DIR``), the same pattern
 as ``utils.logger.configure``; the per-process file name carries the
 component and pid so every process of a job can share one directory.
+
+``EDL_TPU_TRACE_MAX_MB`` caps the file: on overflow the file rotates to
+``<path>.1`` (one rotated generation kept), so a long-running job can
+never fill the disk with trace events.  Rotations and any events
+dropped on write/rotation failure are counted in
+``edl_trace_rotations_total`` / ``edl_trace_dropped_events_total``.
 """
 
 from __future__ import annotations
@@ -25,6 +37,17 @@ import os
 import threading
 import time
 from contextlib import contextmanager
+
+from edl_tpu.obs import context as obs_context
+from edl_tpu.obs import metrics as obs_metrics
+
+_DROPPED_TOTAL = obs_metrics.counter(
+    "edl_trace_dropped_events_total",
+    "Trace events dropped, by reason (write failure, failed rotation)",
+    ("reason",))
+_ROTATIONS_TOTAL = obs_metrics.counter(
+    "edl_trace_rotations_total",
+    "Trace file rotations forced by EDL_TPU_TRACE_MAX_MB")
 
 
 class NullTracer:
@@ -44,6 +67,14 @@ class NullTracer:
         pass
 
 
+def _max_bytes_from_env() -> int:
+    try:
+        return int(float(os.environ.get("EDL_TPU_TRACE_MAX_MB", "0"))
+                   * (1 << 20))
+    except ValueError:
+        return 0
+
+
 class Tracer:
     """Append-only JSONL writer; thread-safe, flushed per event (events
     are rare — phase boundaries, not per-step — so durability beats
@@ -51,14 +82,22 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, path: str, component: str = ""):
+    def __init__(self, path: str, component: str = "",
+                 max_bytes: int | None = None):
         self.path = path
         self.component = component
+        # 0 = unlimited; None = read EDL_TPU_TRACE_MAX_MB
+        self.max_bytes = (_max_bytes_from_env() if max_bytes is None
+                          else int(max_bytes))
         self._lock = threading.Lock()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        try:
+            self._bytes = self._f.tell()
+        except OSError:
+            self._bytes = 0
 
     def emit(self, name: str, *, dur: float | None = None,
              at: float | None = None, **fields) -> None:
@@ -69,29 +108,82 @@ class Tracer:
         if dur is not None:
             rec["dur"] = round(float(dur), 6)
         rec.update(fields)
+        ctx = obs_context.current()
+        if ctx is not None:
+            # setdefault: an event may legitimately pin its own ids
+            # (e.g. re-emitting another process's record)
+            rec.setdefault("trace_id", ctx.trace_id)
+            rec.setdefault("span_id", ctx.span_id)
+            if ctx.parent_id is not None:
+                rec.setdefault("parent_id", ctx.parent_id)
         line = json.dumps(rec) + "\n"
-        try:
-            with self._lock:
+        with self._lock:
+            if self._f is None:
+                _DROPPED_TOTAL.labels(reason="rotate").inc()
+                return
+            if (self.max_bytes
+                    and self._bytes + len(line) > self.max_bytes
+                    and not self._rotate_locked()):
+                _DROPPED_TOTAL.labels(reason="rotate").inc()
+                return
+            try:
                 self._f.write(line)
                 self._f.flush()
-        except (OSError, ValueError):  # closed/full disk: tracing is best-effort
+                self._bytes += len(line)
+            except (OSError, ValueError):  # closed/full disk: best-effort
+                _DROPPED_TOTAL.labels(reason="write").inc()
+
+    def _rotate_locked(self) -> bool:
+        """Roll the file to ``<path>.1`` (previous generation replaced)
+        and start fresh; on failure fall back to the existing file so
+        one bad rename doesn't end tracing for the process."""
+        try:
+            self._f.close()
+        except OSError:
             pass
+        try:
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._bytes = 0
+            _ROTATIONS_TOTAL.inc()
+            return True
+        except OSError:
+            try:
+                self._f = open(self.path, "a", encoding="utf-8")
+                self._bytes = self._f.tell()
+            except OSError:
+                self._f = None  # give up; emit() counts the drops
+            return False
 
     @contextmanager
     def span(self, name: str, **fields):
         """Emit ``name`` with its monotonic duration when the block exits
         (exceptions included — the span's end is the interesting part of
-        a failing phase)."""
+        a failing phase).  ``ts`` is the span's BEGIN wall-clock time,
+        matching the recovery-derived phase events, so merged timelines
+        order by start.  Inside the block, a child trace context is
+        ambient (when any context is), so nested spans and outbound RPCs
+        link to this span as their parent."""
+        parent = obs_context.current()
+        child = parent.child() if parent is not None else None
+        token = obs_context.attach(child) if child is not None else None
+        t_wall = time.time()
         t0 = time.monotonic()
         try:
             yield
         finally:
-            self.emit(name, dur=time.monotonic() - t0, **fields)
+            dur = time.monotonic() - t0
+            try:
+                self.emit(name, dur=dur, at=t_wall, **fields)
+            finally:
+                if token is not None:
+                    obs_context.detach(token)
 
     def close(self) -> None:
         with self._lock:
             try:
-                self._f.close()
+                if self._f is not None:
+                    self._f.close()
             except OSError:
                 pass
 
@@ -102,6 +194,17 @@ _tracer: NullTracer | Tracer = NullTracer()
 
 def get_tracer() -> NullTracer | Tracer:
     return _tracer
+
+
+def install(tracer: NullTracer | Tracer) -> NullTracer | Tracer:
+    """Swap the process-wide tracer, returning the previous one (the
+    bench's tracing-on/off comparison and tests save/restore with this
+    instead of poking the module global)."""
+    global _tracer
+    with _lock:
+        prev = _tracer
+        _tracer = tracer
+        return prev
 
 
 def configure(path: str, component: str = "") -> Tracer:

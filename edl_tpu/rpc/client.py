@@ -11,6 +11,7 @@ from __future__ import annotations
 import socket
 import threading
 
+from edl_tpu.obs import context as obs_context
 from edl_tpu.rpc import framing
 from edl_tpu.utils import exceptions
 from edl_tpu.utils.network import split_endpoint
@@ -34,14 +35,22 @@ class RpcClient:
 
         Retries the transport once on a broken pooled connection, then
         raises EdlCoordError for callers' retry loops.
+
+        The ambient trace context (obs/context.py) rides the envelope
+        under ``"tc"`` — the server re-establishes it around its
+        handler, so spans emitted remotely join this caller's trace.
         """
+        req = {"m": method, "a": kwargs}
+        ctx = obs_context.current()
+        if ctx is not None:
+            req["tc"] = ctx.to_wire()
         with self._lock:
             for attempt in (0, 1):
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
                     self._sock.settimeout(_timeout if _timeout is not None else self._timeout)
-                    framing.send_frame(self._sock, {"m": method, "a": kwargs})
+                    framing.send_frame(self._sock, req)
                     resp = framing.recv_frame(self._sock)
                     break
                 except (OSError, framing.FramingError) as e:

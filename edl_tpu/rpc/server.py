@@ -17,6 +17,7 @@ import socketserver
 import threading
 import time
 
+from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import framing
 from edl_tpu.utils import exceptions
@@ -51,6 +52,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     "r": None})
                 continue
             t0 = time.perf_counter()
+            # re-establish the caller's trace context for the handler:
+            # spans it emits (and RPCs it makes) join the caller's
+            # trace.  attach/detach is per-thread, and this thread
+            # serves one request at a time, so contexts can never leak
+            # between concurrent handlers or linger past the request.
+            caller = obs_context.TraceContext.from_wire(msg.get("tc"))
+            token = (obs_context.attach(caller.child())
+                     if caller is not None else None)
             try:
                 result = fn(**(msg.get("a") or {}))
                 resp = {"s": None, "r": result}
@@ -61,6 +70,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 if not isinstance(e, exceptions.EdlStopIteration):
                     # StopIteration is end-of-data protocol, not a fault
                     _ERRORS_TOTAL.labels(method=method).inc()
+            finally:
+                if token is not None:
+                    obs_context.detach(token)
             _REQUEST_SECONDS.labels(method=method).observe(
                 time.perf_counter() - t0)
             try:

@@ -42,6 +42,7 @@ import numpy as np
 
 from edl_tpu.gateway import fleet
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.serving.engine import ContinuousBatcher
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import constants
@@ -131,6 +132,11 @@ class ReplicaServer:
         with self._lock:
             self._futures[request_id] = fut
         _REPLICA_REQS.inc()
+        # runs under the RPC wire's re-established context, so this
+        # span carries the GATEWAY's trace_id — the cross-process link
+        # `edl-obs-dump --merge` joins on
+        obs_trace.emit("serving/submit", request=request_id,
+                       replica=self.replica_id)
         return {"ok": True}
 
     def serve_wait(self, request_id: str, timeout: float = 0.2) -> dict:
@@ -160,6 +166,8 @@ class ReplicaServer:
         with self._lock:
             self._futures.pop(request_id, None)
             self._results[request_id] = (data, time.monotonic())
+        obs_trace.emit("serving/complete", request=request_id,
+                       replica=self.replica_id, nbytes=len(data))
         return {"done": True, "nbytes": len(data)}
 
     def serve_fetch(self, request_id: str, offset: int, length: int) -> bytes:
@@ -275,9 +283,10 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
     import jax
     import jax.numpy as jnp
 
+    from edl_tpu import obs
     from edl_tpu.coord.client import connect
     from edl_tpu.models.transformer import TransformerConfig, TransformerLM
-    from edl_tpu.obs import exposition, trace
+    from edl_tpu.obs import advert as obs_advert
     from edl_tpu.utils.logger import configure
 
     p = argparse.ArgumentParser("edl_tpu.serving.replica")
@@ -301,8 +310,7 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
     p.add_argument("--ttl", type=float, default=constants.ETCD_TTL)
     args = p.parse_args(argv)
     configure()
-    trace.configure_from_env("replica")
-    exposition.serve_from_env("replica")
+    obs.install_from_env("replica")
 
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,
@@ -334,6 +342,8 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
                                top_k=args.top_k,
                                steps_per_sync=args.steps_per_sync)
     store = connect(args.coord_endpoints)
+    # TTL-leased advert so edl-obs-agg can discover this /metrics page
+    obs_advert.advertise_installed(store, args.job_id, "replica")
     server = ReplicaServer(store, args.job_id, engine,
                            replica_id=args.replica_id, host=args.host,
                            port=args.port, ttl=args.ttl)

@@ -113,6 +113,13 @@ class ElasticTrainer:
             _logger_mod.configure()
         self.tenv = tenv
         self.store = store
+        # under the launcher (store + pod identity known): advertise
+        # this trainer's /metrics endpoint so edl-obs-agg discovers it
+        self._obs_register = None
+        if store is not None and tenv is not None and tenv.pod_id:
+            from edl_tpu.obs import advert as obs_advert
+            self._obs_register = obs_advert.advertise_installed(
+                store, tenv.job_id, "trainer")
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
         self.rules = self.cfg.rules
         self.adjust = AdjustRegistry()

@@ -20,6 +20,7 @@ ETCD_HEARTBEAT = "heartbeat"        # per-pod trainer liveness beats
 ETCD_SCALE = "scale"                # controller desired-size + nodes_range
 ETCD_MEMSTATE = "memstate"          # peer checkpoint-cache adverts + commit record
 ETCD_SERVING = "serving"            # leased LM replica adverts (gateway fleet)
+ETCD_OBS = "obs"                    # leased /metrics endpoint adverts (obs agg)
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -36,6 +37,7 @@ ALL_TABLES = [
     ETCD_SCALE,
     ETCD_MEMSTATE,
     ETCD_SERVING,
+    ETCD_OBS,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)

@@ -35,8 +35,16 @@ JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
 # SIGKILL one under sustained load and every accepted request must still
 # complete on the survivor; a saturated gateway must reject (not hang);
 # edl_gateway_*/edl_serving_* metrics and route/hedge/retry trace spans
-# must be served
+# must be served; a gateway-stamped trace_id must reach a REPLICA
+# process's spans and merge into one ordered Perfetto-exportable timeline
 JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
+
+# obs-agg smoke: 2 child processes + parent — one trace_id propagated
+# over the EDL1 wire into both children's trace files, the aggregator
+# discovers all three via coord-store adverts and serves a merged
+# Prometheus-parseable /metrics + /healthz, and edl-obs-dump --merge
+# renders one cross-process timeline with valid Perfetto JSON
+JAX_PLATFORMS=cpu python scripts/obs_agg_smoke.py
 
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
@@ -58,12 +66,13 @@ edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
 edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
 edl-obs-dump --help >/dev/null 2>&1 || { echo "edl-obs-dump missing"; exit 1; }
+edl-obs-agg --help >/dev/null 2>&1 || { echo "edl-obs-agg missing"; exit 1; }
 edl-gateway --help >/dev/null 2>&1 || { echo "edl-gateway missing"; exit 1; }
 edl-replica --help >/dev/null 2>&1 || { echo "edl-replica missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
 for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
-           edl-obs-dump edl-gateway edl-replica; do
+           edl-obs-dump edl-obs-agg edl-gateway edl-replica; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

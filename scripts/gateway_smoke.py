@@ -227,6 +227,48 @@ def main() -> None:
             assert name in spans, f"missing trace span {name} in {spans}"
         print("smoke: edl_gateway_*/edl_serving_* metrics + "
               "route/hedge/retry spans present")
+
+        # 6 -- end-to-end distributed tracing: a trace_id stamped by the
+        # GATEWAY must appear in spans emitted by a REPLICA process, and
+        # `edl-obs-dump --merge` must render them as one ordered
+        # timeline with a valid Perfetto export
+        from edl_tpu.obs import dump as obs_dump
+
+        events, _skipped = obs_dump.read_trace_dir(_TRACE_DIR)
+        gw_traces = [e["trace_id"] for e in events
+                     if e.get("name") == "gateway/request"
+                     and "trace_id" in e]
+        assert gw_traces, "gateway requests must stamp trace ids"
+        replica_tids = {e.get("trace_id") for e in events
+                        if e.get("component") == "replica"}
+        tid = next((t for t in gw_traces if t in replica_tids), None)
+        assert tid is not None, \
+            "no gateway trace_id reached a replica process's spans"
+        tl = obs_dump.merge_timeline(events, tid)
+        comps = {e.get("component") for e in tl}
+        assert {"gateway", "replica"} <= comps, comps
+        assert len({e["file"] for e in tl}) >= 2, "must span processes"
+        # semantic causal order (merge_timeline sorts by ts, so assert
+        # the STAMPED begin timestamps, not the sort): the gateway's
+        # request root begins before any replica accepted it, and some
+        # replica finished it afterwards (hedged traces may carry a
+        # submit per leg, hence min/max)
+        req_ts = min(e["ts"] for e in tl if e["name"] == "gateway/request")
+        submits = [e["ts"] for e in tl if e["name"] == "serving/submit"]
+        completes = [e["ts"] for e in tl if e["name"] == "serving/complete"]
+        assert submits and req_ts <= min(submits), tl
+        assert completes and min(submits) <= max(completes), tl
+        out_json = os.path.join(_TRACE_DIR, "request.perfetto.json")
+        rc = obs_dump.main(["--merge", "--trace_dir", _TRACE_DIR,
+                            "--trace", tid, "--perfetto", out_json])
+        assert rc == 0
+        with open(out_json) as f:
+            pf = json.load(f)
+        assert pf["traceEvents"], "empty Perfetto export"
+        assert any(e.get("name") == "serving/submit"
+                   for e in pf["traceEvents"])
+        print(f"smoke: gateway trace {tid[:8]} spans {len(tl)} events "
+              f"across {sorted(comps)}; merged timeline + Perfetto OK")
     finally:
         gw.close()
         for proc in procs.values():

@@ -1,0 +1,192 @@
+"""CI smoke: distributed tracing + the job-level obs aggregator, end to
+end across REAL processes.
+
+Two child worker processes (each: a /metrics endpoint + a TTL-leased
+coord-store advert + an EDL1 RPC server whose handler emits a span)
+plus this parent, against an in-process coordination server:
+
+1. the parent establishes ONE trace context and calls each child's
+   handler over the wire — the spans the children emit (in their own
+   processes, into their own trace files) must carry the parent's
+   trace_id, and so must the handlers' ambient contexts;
+2. ``edl-obs-agg`` (in-process AggregatorServer) discovers all three
+   processes via the coord store and serves a merged, Prometheus-
+   parseable job /metrics — same-name metrics from different processes
+   disambiguated by ``component``/``instance`` labels, HELP/TYPE once
+   per family — plus a /healthz job summary;
+3. ``edl-obs-dump --merge`` joins the shared trace directory into one
+   causally-ordered timeline for that trace_id spanning all three
+   processes, and exports valid Perfetto JSON.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/obs_agg_smoke.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+_TRACE_DIR = os.environ.setdefault("EDL_TPU_TRACE_DIR",
+                                   tempfile.mkdtemp(prefix="edl-agg-trace-"))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_CHILD = r"""
+import sys, threading
+sys.path.insert(0, {repo!r})
+from edl_tpu import obs
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.obs import advert, context as obs_context, metrics, trace
+from edl_tpu.rpc.server import RpcServer
+
+coord_ep, job = sys.argv[1], sys.argv[2]
+obs.install_from_env("worker")
+store = CoordClient(coord_ep)
+reg = advert.advertise_installed(store, job, "worker")
+assert reg is not None, "child metrics endpoint/advert missing"
+work_total = metrics.counter("edl_smoke_child_total",
+                             "work() calls handled by a child")
+# same NAME as the parent's metric but a DIFFERENT label set: the
+# aggregator's merged page must survive this (satellite: HELP/TYPE
+# dedupe across conflicting label sets)
+metrics.gauge("edl_smoke_shared", "child flavor").set(1)
+
+def work(n=1):
+    work_total.inc(n)
+    trace.emit("child/work", n=n)
+    cur = obs_context.current()
+    return {{"trace": cur.trace_id if cur else None}}
+
+srv = RpcServer("127.0.0.1", 0)
+srv.register("work", work)
+srv.start()
+print("child rpc on", srv.endpoint, flush=True)
+threading.Event().wait()
+"""
+
+
+def _spawn_child(coord_ep: str, job: str) -> tuple[subprocess.Popen, str]:
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD.format(repo=_REPO),
+         coord_ep, job],
+        env=dict(os.environ), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "child rpc on" in line:
+            return proc, line.rsplit(" ", 1)[-1].strip()
+        if not line and proc.poll() is not None:
+            raise AssertionError("child died before announcing")
+    raise AssertionError("child never announced")
+
+
+def main() -> None:
+    from edl_tpu import obs
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.obs import context as obs_context
+    from edl_tpu.obs import dump as obs_dump
+    from edl_tpu.obs import metrics as obs_metrics, trace as obs_trace
+    from edl_tpu.obs.advert import advertise_installed
+    from edl_tpu.obs.agg import AggregatorServer
+    from edl_tpu.rpc.client import RpcClient
+
+    obs.install_from_env("parent")
+    obs_metrics.gauge("edl_smoke_shared", "parent flavor",
+                      ("role",)).labels(role="parent").set(2)
+
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = CoordClient(coord_ep)
+    job = "aggsmoke"
+    parent_reg = advertise_installed(store, job, "parent")
+    assert parent_reg is not None, "parent metrics endpoint must be up"
+    children = [_spawn_child(coord_ep, job) for _ in range(2)]
+    agg_srv = None
+    try:
+        # 1 -- one trace context spans parent + both child PROCESSES
+        ctx = obs_context.new_trace(job=job)
+        with obs_context.use(ctx):
+            obs_trace.emit("parent/fanout", children=len(children))
+            for _proc, ep in children:
+                with RpcClient(ep) as c:
+                    r = c.call("work", n=1)
+                assert r["trace"] == ctx.trace_id, \
+                    "handler did not inherit the caller's trace"
+        print("smoke: one trace_id propagated over the wire into "
+              f"{len(children)} child processes")
+
+        # 2 -- the aggregator: coord-store discovery + merged /metrics
+        agg_srv = AggregatorServer(store, job, host="127.0.0.1",
+                                   cache_s=0.0).start()
+        deadline = time.time() + 60
+        while True:
+            page = urllib.request.urlopen(
+                f"http://{agg_srv.endpoint}/metrics", timeout=10
+            ).read().decode()
+            parsed = obs_metrics.parse_exposition(page)  # byte-parseable
+            child_samples = [
+                (name, labels) for name, labels in parsed
+                if name == "edl_smoke_child_total"
+                and dict(labels).get("component") == "worker"]
+            if len(child_samples) == 2:
+                break
+            assert time.time() < deadline, \
+                f"aggregator never saw both children: {child_samples}"
+            time.sleep(0.2)
+        instances = {dict(labels)["instance"] for _, labels in child_samples}
+        assert len(instances) == 2, "children must be distinct instances"
+        # conflicting label sets for edl_smoke_shared: headers once
+        assert page.count("# TYPE edl_smoke_shared gauge") == 1
+        assert page.count("# HELP edl_smoke_shared") == 1
+        health = json.loads(urllib.request.urlopen(
+            f"http://{agg_srv.endpoint}/healthz", timeout=10
+        ).read().decode())
+        assert health["live_targets"] >= 3, health
+        assert health["components"].get("worker") == 2, health
+        assert health["components"].get("parent") == 1, health
+        print(f"smoke: edl-obs-agg discovered {health['live_targets']} "
+              "processes via the coord store; merged /metrics parseable, "
+              "HELP/TYPE deduped, /healthz live")
+
+        # 3 -- merged timeline + Perfetto export for that one trace
+        events, _skipped = obs_dump.read_trace_dir(_TRACE_DIR)
+        tl = obs_dump.merge_timeline(events, ctx.trace_id)
+        files = {e["file"] for e in tl}
+        assert len(files) >= 3, \
+            f"trace {ctx.trace_id[:8]} must span parent+children: {files}"
+        # semantic causal order on the STAMPED begin timestamps: the
+        # parent's fan-out event precedes every child's handler span
+        fanout_ts = next(e["ts"] for e in tl if e["name"] == "parent/fanout")
+        child_ts = [e["ts"] for e in tl if e["name"] == "child/work"]
+        assert len(child_ts) == 2 and all(fanout_ts <= t for t in child_ts)
+        out_json = os.path.join(_TRACE_DIR, "smoke.perfetto.json")
+        rc = obs_dump.main(["--merge", "--trace_dir", _TRACE_DIR,
+                            "--trace", ctx.trace_id,
+                            "--perfetto", out_json])
+        assert rc == 0
+        with open(out_json) as f:
+            pf = json.load(f)
+        assert any(e.get("name") == "child/work"
+                   for e in pf["traceEvents"]), pf["traceEvents"][:5]
+        print(f"smoke: edl-obs-dump --merge ordered {len(tl)} events from "
+              f"{len(files)} processes; Perfetto JSON valid")
+    finally:
+        if agg_srv is not None:
+            agg_srv.stop()
+        for proc, _ in children:
+            proc.kill()
+        parent_reg.stop()
+        store.close()
+        coord.stop()
+    print("obs-agg smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Job-level aggregator: coord-store discovery of /metrics endpoints,
+merged exposition that stays byte-parseable when processes export the
+same metric with different label sets, and the /healthz job summary."""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from edl_tpu.obs import advert
+from edl_tpu.obs.agg import (
+    Aggregator, AggregatorServer, merge_expositions, quantile_from_buckets,
+)
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.metrics import Registry, parse_exposition
+
+
+def _page(build):
+    reg = Registry()
+    build(reg)
+    return reg.render()
+
+
+# -- merge_expositions -------------------------------------------------------
+
+def test_merge_adds_labels_and_stays_parseable():
+    a = _page(lambda r: r.counter("edl_x_total", "x", ("op",))
+              .labels(op="get").inc(3))
+    b = _page(lambda r: r.counter("edl_x_total", "x", ("op",))
+              .labels(op="put").inc(5))
+    merged = merge_expositions([({"component": "c1", "instance": "h:1"}, a),
+                                ({"component": "c2", "instance": "h:2"}, b)])
+    parsed = parse_exposition(merged)   # raises on any malformed line
+    assert parsed[("edl_x_total", (("component", "c1"), ("instance", "h:1"),
+                                   ("op", "get")))] == 3.0
+    assert parsed[("edl_x_total", (("component", "c2"), ("instance", "h:2"),
+                                   ("op", "put")))] == 5.0
+
+
+def test_merge_dedupes_help_type_across_conflicting_label_sets():
+    # the satellite case: same metric NAME, different label sets — the
+    # merged page must carry exactly one HELP and one TYPE per family
+    a = _page(lambda r: r.gauge("edl_shared", "from a", ("role",))
+              .labels(role="x").set(1))
+    b = _page(lambda r: r.gauge("edl_shared", "from b").set(2))
+    merged = merge_expositions([({"component": "a", "instance": "h:1"}, a),
+                                ({"component": "b", "instance": "h:2"}, b)])
+    assert merged.count("# TYPE edl_shared gauge") == 1
+    assert merged.count("# HELP edl_shared") == 1
+    parsed = parse_exposition(merged)
+    keys = [k for k in parsed if k[0] == "edl_shared"]
+    assert len(keys) == 2   # both processes' samples survive, disambiguated
+
+
+def test_merge_histograms_group_under_one_family():
+    def build(r):
+        r.histogram("edl_h_seconds", "h", buckets=(0.1, 1.0)).observe(0.05)
+
+    merged = merge_expositions(
+        [({"component": "c", "instance": f"h:{i}"}, _page(build))
+         for i in (1, 2)])
+    # family header once, then both instances' bucket/sum/count samples
+    assert merged.count("# TYPE edl_h_seconds histogram") == 1
+    parsed = parse_exposition(merged)
+    buckets = [k for k in parsed if k[0] == "edl_h_seconds_bucket"]
+    assert len(buckets) == 6    # 3 le-buckets x 2 instances
+    # an existing label (le) is never clobbered by the injected ones
+    assert all(dict(labels).get("le") for _, labels in buckets)
+
+
+def test_merge_empty_and_label_escaping():
+    assert merge_expositions([]) == ""
+    a = _page(lambda r: r.counter("edl_e_total", "e", ("p",))
+              .labels(p='we"ird\\').inc())
+    merged = merge_expositions([({"component": "c", "instance": "h:1"}, a)])
+    parsed = parse_exposition(merged)
+    ((_, labels),) = [k for k in parsed if k[0] == "edl_e_total"]
+    assert dict(labels)["p"] == 'we"ird\\'
+
+
+# -- quantiles from merged histograms ----------------------------------------
+
+def test_quantile_from_buckets():
+    buckets = {0.1: 50.0, 1.0: 90.0, math.inf: 100.0}
+    assert quantile_from_buckets(buckets, 0.5) == pytest.approx(0.1)
+    # p90 sits exactly at the 1.0 bound
+    assert quantile_from_buckets(buckets, 0.9) == pytest.approx(1.0)
+    # tail beyond the last finite bound resolves to that bound
+    assert quantile_from_buckets(buckets, 0.99) == pytest.approx(1.0)
+    assert quantile_from_buckets({}, 0.5) is None
+    assert quantile_from_buckets({math.inf: 0.0}, 0.5) is None
+
+
+# -- end to end over real HTTP + a real store --------------------------------
+
+@pytest.fixture
+def fleet(memkv):
+    servers, regs = [], []
+
+    def spawn(component: str, build) -> MetricsServer:
+        reg = Registry()
+        build(reg)
+        srv = MetricsServer(reg, host="127.0.0.1").start()
+        servers.append(srv)
+        regs.append(advert.advertise_metrics(
+            memkv, "job", component, srv.endpoint,
+            name=f"{component}-{srv.port}", ttl=30))
+        return srv
+
+    yield spawn
+    for r in regs:
+        r.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_aggregator_merges_live_targets(memkv, fleet):
+    fleet("trainer", lambda r: r.counter("edl_t_total", "t").inc(7))
+    fleet("replica", lambda r: r.gauge("edl_r", "r").set(3))
+    agg = Aggregator(memkv, "job", cache_s=0.0)
+    merged, info = agg.collect()
+    assert len(info["targets"]) == 2 and not info["errors"]
+    parsed = parse_exposition(merged)
+    by_component = {dict(labels).get("component")
+                    for (name, labels) in parsed
+                    if name in ("edl_t_total", "edl_r")}
+    assert by_component == {"trainer", "replica"}
+    # the aggregator's own registry rides along
+    assert any(name == "edl_obs_agg_targets" for name, _ in parsed)
+
+
+def test_aggregator_tolerates_dead_target(memkv, fleet):
+    fleet("trainer", lambda r: r.counter("edl_t_total", "t").inc())
+    reg = advert.advertise_metrics(memkv, "job", "ghost",
+                                   "127.0.0.1:1", name="ghost-1", ttl=30)
+    try:
+        agg = Aggregator(memkv, "job", scrape_timeout=0.5, cache_s=0.0)
+        merged, info = agg.collect()
+        assert "ghost-1" in info["errors"]
+        parsed = parse_exposition(merged)   # live page still parseable
+        assert any(name == "edl_t_total" for name, _ in parsed)
+    finally:
+        reg.stop()
+
+
+def test_aggregator_server_metrics_and_healthz(memkv, fleet):
+    from edl_tpu.cluster import recovery
+
+    fleet("trainer", lambda r: r.counter("edl_t_total", "t").inc())
+    fleet("gateway", lambda r: r.histogram(
+        "edl_gateway_request_seconds", "lat",
+        buckets=(0.1, 1.0)).observe(0.05))
+    recovery.write_launcher_half(
+        memkv, "job", "s1", "podA",
+        {"detect": 100.0, "killed": 101.0, "barrier": 101.5, "spawn": 102.0})
+    # include_self=False: under the full suite this process's registry
+    # already holds gateway histograms from other tests — the healthz
+    # numbers here must come from the fleet pages alone
+    srv = AggregatorServer(memkv, "job", host="127.0.0.1",
+                           cache_s=0.0, include_self=False).start()
+    try:
+        page = urllib.request.urlopen(
+            f"http://{srv.endpoint}/metrics", timeout=10).read().decode()
+        parse_exposition(page)
+        assert 'component="gateway"' in page
+        health = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/healthz", timeout=10).read().decode())
+        assert health["live_targets"] == 2
+        assert health["components"] == {"trainer": 1, "gateway": 1}
+        assert health["resizes"] == 1
+        assert health["last_resize"]["stage"] == "s1"
+        assert health["gateway"]["requests"] == 1.0
+        assert health["gateway"]["p99_s"] is not None
+    finally:
+        srv.stop()

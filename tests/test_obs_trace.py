@@ -132,3 +132,138 @@ def test_dump_empty_job(memkv):
     assert report["resizes"] == []
     text = render_report(report)
     assert "ghost" in text and "no resize records" in text
+
+
+# -- trace-file growth cap (EDL_TPU_TRACE_MAX_MB) ----------------------------
+
+def test_tracer_rotates_at_cap(tmp_path):
+    from edl_tpu.obs.trace import _ROTATIONS_TOTAL
+
+    path = tmp_path / "t.jsonl"
+    tr = obs_trace.Tracer(str(path), "unit", max_bytes=2048)
+    rotations0 = _ROTATIONS_TOTAL.value
+    for i in range(200):
+        tr.emit("spin", at=float(i), i=i)
+    tr.close()
+    assert _ROTATIONS_TOTAL.value > rotations0, "cap never triggered"
+    rotated = tmp_path / "t.jsonl.1"
+    assert rotated.exists(), "rotation must keep one previous generation"
+    # on-disk footprint stays bounded: live file + one rotated generation
+    assert path.stat().st_size <= 2048
+    assert rotated.stat().st_size <= 2048
+    # both generations remain valid JSONL, newest events in the live file
+    live = _read_events(path)
+    old = _read_events(rotated)
+    assert live and old
+    assert live[-1]["i"] == 199
+    assert old[-1]["i"] == live[0]["i"] - 1  # no event lost at the seam
+
+
+def test_tracer_counts_dropped_events_on_write_failure(tmp_path):
+    from edl_tpu.obs.trace import _DROPPED_TOTAL
+
+    tr = obs_trace.Tracer(str(tmp_path / "t.jsonl"), "unit")
+    dropped0 = _DROPPED_TOTAL.labels(reason="write").value
+    tr._f.close()  # simulate the fd dying under the tracer (full disk)
+    tr.emit("lost", at=1.0)
+    assert _DROPPED_TOTAL.labels(reason="write").value == dropped0 + 1
+
+
+# -- merged timelines + Perfetto export (edl-obs-dump --merge) ---------------
+
+def _write_trace(path, events, truncate_last=False):
+    lines = [json.dumps(e) for e in events]
+    text = "\n".join(lines) + "\n"
+    if truncate_last:
+        text = text[:-len(lines[-1]) // 2]  # concurrent writer mid-append
+    path.write_text(text)
+
+
+def test_read_trace_dir_skips_and_counts_truncated_lines(tmp_path):
+    from edl_tpu.obs.dump import read_trace_dir
+
+    _write_trace(tmp_path / "trace-a-1.jsonl",
+                 [{"ts": 1.0, "name": "x", "component": "a"},
+                  {"ts": 2.0, "name": "y", "component": "a"}],
+                 truncate_last=True)
+    _write_trace(tmp_path / "trace-b-2.jsonl",
+                 [{"ts": 1.5, "name": "z", "component": "b"}])
+    events, skipped = read_trace_dir(str(tmp_path))
+    assert skipped == 1, "the torn final line must be counted, not fatal"
+    assert {e["name"] for e in events} == {"x", "z"}
+    assert all("file" in e for e in events)
+
+
+def test_read_trace_dir_folds_rotated_generation(tmp_path):
+    from edl_tpu.obs.dump import read_trace_dir
+
+    _write_trace(tmp_path / "trace-a-1.jsonl",
+                 [{"ts": 2.0, "name": "new", "component": "a"}])
+    _write_trace(tmp_path / "trace-a-1.jsonl.1",
+                 [{"ts": 1.0, "name": "old", "component": "a"}])
+    events, skipped = read_trace_dir(str(tmp_path))
+    assert skipped == 0 and len(events) == 2
+    # one process, not two: the rotated generation folds into its live file
+    assert {e["file"] for e in events} == {"trace-a-1.jsonl"}
+
+
+def test_merge_timeline_filters_and_orders(tmp_path):
+    from edl_tpu.obs.dump import merge_timeline, read_trace_dir
+
+    _write_trace(tmp_path / "trace-gw-1.jsonl",
+                 [{"ts": 10.0, "name": "gateway/request", "trace_id": "T1",
+                   "component": "gateway", "dur": 0.5}])
+    _write_trace(tmp_path / "trace-rep-2.jsonl",
+                 [{"ts": 10.2, "name": "serving/submit", "trace_id": "T1",
+                   "component": "replica"},
+                  {"ts": 9.0, "name": "other", "trace_id": "T2",
+                   "component": "replica"}])
+    events, _ = read_trace_dir(str(tmp_path))
+    tl = merge_timeline(events, "T1")
+    assert [e["name"] for e in tl] == ["gateway/request", "serving/submit"]
+    assert {e["component"] for e in tl} == {"gateway", "replica"}
+    assert merge_timeline(events)[0]["trace_id"] == "T2"  # global sort by ts
+
+
+def test_perfetto_export_shape(tmp_path):
+    from edl_tpu.obs.dump import to_perfetto
+
+    events = [
+        {"ts": 5.0, "name": "resize/detect", "component": "launcher",
+         "trace_id": "T", "file": "trace-launcher-1.jsonl"},
+        {"ts": 5.1, "name": "train/restore", "component": "trainer",
+         "dur": 0.25, "trace_id": "T", "step": 7,
+         "file": "trace-trainer-2.jsonl"},
+    ]
+    pf = to_perfetto(events)
+    # valid JSON end to end (what Perfetto actually loads)
+    pf = json.loads(json.dumps(pf))
+    evs = pf["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(metas) == 2, "one process row per source file"
+    spans = [e for e in evs if e["ph"] == "X"]
+    (span,) = spans
+    assert span["ts"] == 5.1e6 and span["dur"] == 0.25e6  # microseconds
+    assert span["args"]["step"] == 7
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert instants and instants[0]["name"] == "resize/detect"
+
+
+def test_dump_merge_cli(tmp_path, capsys):
+    from edl_tpu.obs import dump as obs_dump
+
+    _write_trace(tmp_path / "trace-a-1.jsonl",
+                 [{"ts": 1.0, "name": "a/one", "trace_id": "T",
+                   "component": "a", "dur": 0.1},
+                  {"ts": 2.0, "name": "bad"}])
+    (tmp_path / "trace-a-1.jsonl").write_text(
+        (tmp_path / "trace-a-1.jsonl").read_text() + '{"torn')
+    out_json = tmp_path / "out.json"
+    rc = obs_dump.main(["--merge", "--trace_dir", str(tmp_path),
+                        "--trace", "T", "--perfetto", str(out_json)])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "a/one" in captured.out
+    assert "skipped 1 malformed" in captured.err
+    pf = json.loads(out_json.read_text())
+    assert any(e.get("name") == "a/one" for e in pf["traceEvents"])
