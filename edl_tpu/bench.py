@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -111,7 +112,17 @@ def _main_impl(out: dict) -> None:
     n_steps = int(os.environ.get("EDL_TPU_BENCH_STEPS", 30))
     width = int(os.environ.get("EDL_TPU_BENCH_WIDTH", 64))
 
-    n_dev = len(jax.devices())
+    # transfer microbench first: pure loopback RPC, no accelerator in
+    # the loop — it must land in the artifact even when the backend is
+    # broken enough that nothing below does
+    if os.environ.get("EDL_TPU_BENCH_TRANSFER", "1") != "0":
+        try:
+            out.update(_bench_transfer())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
+    n_dev = len(_devices_or_cpu())
     bs = per_dev_bs * n_dev
     model = ResNet50(num_classes=1000, width=width)
 
@@ -319,6 +330,157 @@ def _main_impl(out: dict) -> None:
         out["mfu"] = round(mfu, 3)
     out.update(lm_metrics)
     out.update(distill_metrics)
+
+
+def _devices_or_cpu():
+    """The bench's FIRST in-process backend touch.  The subprocess
+    probe (utils/backend.ensure_live_backend) catches hangs, but a
+    backend can probe alive in a fresh child and still fail to
+    *initialize* in this process (BENCH_r05: ``RuntimeError: Unable to
+    initialize backend`` at exactly this call, rc=1, no artifact) —
+    catch the init error, pin the CPU platform, and continue so the
+    single JSON line always ships."""
+    import jax
+    try:
+        return jax.devices()
+    except RuntimeError as e:  # jax.errors.JaxRuntimeError subclasses this
+        print(f"backend init failed ({type(e).__name__}: {e}); "
+              f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr,
+              flush=True)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
+_TRANSFER_HOLDER_SRC = """
+import sys, zlib
+import numpy as np
+from edl_tpu.memstate.service import StateCacheService
+from edl_tpu.rpc.server import RpcServer
+mb = int(sys.argv[1])
+data = np.random.default_rng(0).bytes(mb << 20)
+svc = StateCacheService(None, "xfer", sys.argv[2])
+svc.cache_put_chunk("owner", 1, "blob", 0, data, True)
+svc.cache_commit("owner", 1, manifest={
+    "blob": {"crc": zlib.crc32(data), "nbytes": len(data),
+             "dtype": "uint8", "shape": [len(data)],
+             "index": [[0, len(data)]], "gshape": [len(data)],
+             "leaf": "blob"}})
+srv = RpcServer("127.0.0.1", 0)
+srv.register_instance(svc)
+srv.start()
+print(srv.port, flush=True)
+sys.stdin.read()  # serve until the parent closes our stdin
+"""
+
+
+def _bench_transfer() -> dict:
+    """Peer-transfer data-plane microbench: the same blob fetched from
+    loopback StateCacheService holders three ways — serial (one chunk
+    per round trip on one connection, the pre-streaming baseline),
+    pipelined (a window of chunk requests in flight on one
+    connection), and striped (byte ranges split across TWO holders,
+    server-push streaming, CRC overlapped with the fetch) — reported
+    as MiB/s.  The holders run as SUBPROCESSES, like the real thing
+    (peer launchers): an in-process server would share the client's
+    GIL and understate every parallel path.  Loopback understates LAN
+    RTT, so the pipelining win here is a lower bound on the real one.
+    Every byte is CRC-verified against the manifest so a
+    wrong-but-fast path can't win."""
+    import subprocess
+    import zlib
+
+    from edl_tpu.rpc import chunks, transfer
+    from edl_tpu.rpc.client import RpcChannelPool, RpcClient
+    from edl_tpu.utils import constants
+
+    mb = int(os.environ.get("EDL_TPU_BENCH_TRANSFER_MB", 64))
+    chunk = int(os.environ.get("EDL_TPU_BENCH_TRANSFER_CHUNK",
+                               constants.MEMSTATE_CHUNK_BYTES))
+    window = int(os.environ.get("EDL_TPU_BENCH_TRANSFER_WINDOW",
+                                constants.TRANSFER_WINDOW))
+    data = np.random.default_rng(0).bytes(mb << 20)
+    crc = zlib.crc32(data)
+
+    procs, pools = [], []
+    try:
+        for pid in ("xfer-a", "xfer-b"):
+            p = subprocess.Popen(
+                [sys.executable, "-c", _TRANSFER_HOLDER_SRC, str(mb), pid],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            procs.append(p)
+        ports = [int(p.stdout.readline()) for p in procs]
+        pools = [RpcChannelPool(f"127.0.0.1:{port}") for port in ports]
+
+        def mib_s(seconds: float) -> float:
+            return round(len(data) / (1 << 20) / max(seconds, 1e-9), 1)
+
+        reps = int(os.environ.get("EDL_TPU_BENCH_TRANSFER_REPS", 3))
+
+        def time_best(fn) -> float:
+            """Warmup (connections, page cache) + best-of-N: one run is
+            a single sub-second transfer, so scheduler noise on a busy
+            host is material; min is the honest protocol-cost
+            estimator, same rationale as the decode bench."""
+            best = float("inf")
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        import functools
+        with RpcClient(f"127.0.0.1:{ports[0]}") as legacy:
+            def run_serial():
+                got = chunks.fetch_bytes(
+                    functools.partial(legacy.call, "cache_fetch",
+                                      owner="owner", key="blob"),
+                    len(data), chunk_bytes=chunk)
+                assert zlib.crc32(got) == crc
+            serial_s = time_best(run_serial)
+
+        def run_pipelined():
+            got = chunks.fetch_bytes_pipelined(
+                pools[0], "cache_fetch", len(data), chunk_bytes=chunk,
+                window=window, owner="owner", key="blob")
+            assert zlib.crc32(got) == crc
+        pipelined_s = time_best(run_pipelined)
+
+        holders = {"xfer-a": pools[0], "xfer-b": pools[1]}
+
+        def run_striped():
+            buf, got_crc = transfer.fetch_striped(
+                len(data), list(holders),
+                lambda h, off, ln: chunks.iter_fetch_streaming(
+                    holders[h], "cache_fetch_stream", ln, chunk_bytes=chunk,
+                    offset=off, owner="owner", key="blob"),
+                chunk_bytes=chunk)
+            assert got_crc == crc
+        striped_s = time_best(run_striped)
+
+        return {
+            "transfer_payload_mb": mb,
+            "transfer_chunk_mb": round(chunk / (1 << 20), 2),
+            "transfer_window": window,
+            "transfer_serial_mib_s": mib_s(serial_s),
+            "transfer_pipelined_mib_s": mib_s(pipelined_s),
+            "transfer_striped_mib_s": mib_s(striped_s),
+            "transfer_pipelined_speedup": round(serial_s
+                                                / max(pipelined_s, 1e-9), 2),
+            "transfer_striped_speedup": round(serial_s
+                                              / max(striped_s, 1e-9), 2),
+        }
+    finally:
+        for p in pools:
+            p.close()
+        for p in procs:
+            try:
+                p.stdin.close()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — reap hard if need be
+                p.kill()
+                p.wait()
 
 
 def _bench_memstate() -> dict:

@@ -17,15 +17,25 @@ Resharding to the NEW mesh falls out of assembly: shards are placed
 into the full global array by their manifest index boxes, then cut to
 the restore target's sharding via ``jax.make_array_from_callback`` —
 the old and new meshes never need to agree.
+
+The transfer itself rides the streaming data plane (rpc/transfer.py):
+distinct shards fetch concurrently on a bounded worker pool, a single
+large shard STRIPES its byte ranges across every live holder (owner +
+ring replica; ``EDL_TPU_STRIPE_MIN_BYTES``), a holder dying mid-stripe
+demotes to the survivors, and CRC verification overlaps the network
+(incremental per range, folded with ``crc32_combine``).  Per holder the
+wire is server-push streaming (``cache_fetch_stream``) with a windowed
+pipelined ``cache_fetch`` fallback for old peers.
 """
 
 from __future__ import annotations
 
 import time
-import zlib
 
 from edl_tpu.memstate import advert, shards
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlInternalError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -76,8 +86,8 @@ def try_restore(store, job_id: str, abstract_state,
         _miss("no_adverts")
         return None
 
-    from edl_tpu.rpc.client import RpcClient
-    clients: dict[str, RpcClient] = {}
+    from edl_tpu.rpc.client import RpcChannelPool
+    pools: dict[str, RpcChannelPool] = {}
     try:
         # where is each shard of the committed step? several pods may
         # hold a copy (owner + its ring replica): keep them ALL as
@@ -86,8 +96,8 @@ def try_restore(store, job_id: str, abstract_state,
         meta_holders: list[tuple[str, str]] = []  # (pod, owner)
         for pod, ep in endpoints.items():
             try:
-                clients[pod] = RpcClient(ep)
-                listing = clients[pod].call("cache_manifest")
+                pools[pod] = RpcChannelPool(ep)
+                listing = pools[pod].call("cache_manifest")
             except Exception:  # noqa: BLE001 — a dead peer is not fatal
                 logger.warning("memstate: peer %s unreachable", pod[:8])
                 continue
@@ -106,20 +116,67 @@ def try_restore(store, job_id: str, abstract_state,
                 "peers": sorted({p for hs in holders.values()
                                  for p, _, _ in hs})}
         leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
-        out_leaves = []
+
+        # pass 1 — PLAN: which manifest shards does this process's
+        # share of the new mesh actually need?  (Only those fetch: the
+        # restore's network and host-RAM cost scale with this process's
+        # share of the model, not the whole checkpoint.)
+        plan = []
+        jobs: dict[str, tuple[dict, list]] = {}  # key -> (ent, candidates)
         for path, leaf in leaves:
             if not hasattr(leaf, "sharding") or leaf.sharding is None:
                 _miss("unsupported_leaf")
                 return None
             leaf_name = jax.tree_util.keystr(path)
-            local = _assemble_leaf(leaf_name, leaf, holders, clients, info)
-            if local is None:
-                return None  # _assemble_leaf counted the reason
-            gshape = tuple(int(d) for d in leaf.shape)
-            out_leaves.append(jax.make_array_from_callback(
-                leaf.shape, leaf.sharding,
-                lambda idx, a=local, g=gshape: a[_norm_box(idx, g)]))
-        meta_json = _fetch_meta(meta_holders, clients)
+            planned = _plan_leaf(leaf_name, leaf, holders, jobs)
+            if planned is None:
+                return None  # _plan_leaf counted the reason
+            plan.append(planned)
+
+        # pass 2 — FETCH + ASSEMBLE, leaf batches bounded by the byte
+        # budget: shards fetch concurrently (striped across holders
+        # when large; CRC overlapped with the wire), but fetched bytes
+        # never accumulate past ~one batch before their leaves are
+        # assembled and released — a share-sized restore must not
+        # transiently double its host RAM
+        budget = constants.TRANSFER_BUDGET_BYTES or float("inf")
+        out_leaves = []
+        batch: list = []
+        batch_bytes = 0
+
+        def flush() -> bool:
+            nonlocal batch, batch_bytes
+            sub = {key: jobs[key] for _ln, _lf, _nd, overl in batch
+                   for key in overl}
+            fetched = _fetch_all(sub, pools)
+            if fetched is None:
+                _miss("shard_unavailable")
+                return False
+            for data in fetched.values():
+                info["shards"] += 1
+                info["bytes"] += len(data)
+                _FETCHED.inc(len(data))
+            for leaf_name, leaf, needed, overl in batch:
+                local = _assemble_leaf(leaf_name, leaf, needed, overl,
+                                       jobs, fetched)
+                if local is None:
+                    return False  # _assemble_leaf counted the reason
+                gshape = tuple(int(d) for d in leaf.shape)
+                out_leaves.append(jax.make_array_from_callback(
+                    leaf.shape, leaf.sharding,
+                    lambda idx, a=local, g=gshape: a[_norm_box(idx, g)]))
+            batch, batch_bytes = [], 0
+            return True
+
+        for planned in plan:
+            batch.append(planned)
+            batch_bytes += sum(int(jobs[k][0]["nbytes"])
+                               for k in planned[3])
+            if batch_bytes >= budget and not flush():
+                return None
+        if batch and not flush():
+            return None
+        meta_json = _fetch_meta(meta_holders, pools)
         if meta_json is None:
             _miss("no_meta")
             return None
@@ -132,8 +189,8 @@ def try_restore(store, job_id: str, abstract_state,
                     info["bytes"] / 1e6, info["seconds"])
         return state, meta_json, info
     finally:
-        for c in clients.values():
-            c.close()
+        for p in pools.values():
+            p.close()
 
 
 def _np_dtype(name: str):
@@ -151,18 +208,10 @@ def _np_dtype(name: str):
 _norm_box = shards.norm_box
 
 
-def _assemble_leaf(leaf_name, leaf, holders, clients, info):
-    """The boxes THIS process's addressable target shards need, as
-    ``{box: np array}``, or None (miss counted).
-
-    Only manifest shards intersecting a locally-needed box are fetched
-    — the restore's network and host-RAM cost scale with this
-    process's share of the model, not the whole checkpoint (a
-    full-model materialization would OOM exactly the sharded models
-    the cache exists for, and silently demote every restore to
-    storage).  Each fetched shard is verified then scattered into the
-    needed boxes it overlaps; exact per-box coverage masks (bounded by
-    local shard size) replace a global coverage array."""
+def _plan_leaf(leaf_name, leaf, holders, jobs):
+    """Validate ``leaf``'s manifest entries and register the shards its
+    locally-addressable target boxes overlap into ``jobs``.  Returns
+    ``(leaf_name, leaf, needed, overl)`` or None (miss counted)."""
     import numpy as np
 
     gshape = tuple(int(d) for d in leaf.shape)
@@ -181,12 +230,7 @@ def _assemble_leaf(leaf_name, leaf, holders, clients, info):
     needed = {_norm_box(idx, gshape)
               for idx in leaf.sharding.addressable_devices_indices_map(
                   gshape).values()}
-    out: dict[tuple, np.ndarray] = {}
-    cov: dict[tuple, np.ndarray] = {}
-    for box in needed:
-        shape = tuple(b - a for a, b in box)
-        out[box] = np.empty(shape, dtype=leaf.dtype)
-        cov[box] = np.zeros(shape, dtype=bool)
+    overl: dict[str, tuple] = {}
     for key, candidates in boxes.items():
         ent = candidates[0][1]
         src = tuple((int(a), int(b)) for a, b in ent["index"])
@@ -195,11 +239,30 @@ def _assemble_leaf(leaf_name, leaf, holders, clients, info):
         overlaps = [b for b in needed if _intersect(src, b) is not None]
         if not overlaps:
             continue  # another process's share
-        data = _fetch_verified(key, candidates, clients)
-        if data is None:
-            # every advertised holder failed (unreachable or CRC-bad)
-            _miss("shard_unavailable")
-            return None
+        overl[key] = (src, overlaps)
+        jobs[key] = (ent, candidates)
+    return leaf_name, leaf, needed, overl
+
+
+def _assemble_leaf(leaf_name, leaf, needed, overl, jobs, fetched):
+    """Scatter the fetched shards into the boxes THIS process's
+    addressable target shards need, as ``{box: np array}``, or None
+    (miss counted).  Exact per-box coverage masks (bounded by local
+    shard size) replace a global coverage array."""
+    import numpy as np
+
+    out: dict[tuple, np.ndarray] = {}
+    cov: dict[tuple, np.ndarray] = {}
+    for box in needed:
+        shape = tuple(b - a for a, b in box)
+        out[box] = np.empty(shape, dtype=leaf.dtype)
+        cov[box] = np.zeros(shape, dtype=bool)
+    for key, (src, overlaps) in overl.items():
+        ent = jobs[key][0]
+        # pop: keys are unique per leaf, and releasing each shard's
+        # bytes right after its scatter keeps peak host RAM at ~one
+        # working set, not fetched-bytes + assembled-arrays combined
+        data = fetched.pop(key)
         piece = np.frombuffer(data, dtype=_np_dtype(ent["dtype"])) \
             .reshape(ent["shape"])
         for box in overlaps:
@@ -210,9 +273,6 @@ def _assemble_leaf(leaf_name, leaf, holders, clients, info):
                          for (a, b), t in zip(isect, box))
             out[box][osel] = piece[psel]
             cov[box][osel] = True
-        info["shards"] += 1
-        info["bytes"] += len(data)
-        _FETCHED.inc(len(data))
     if not all(c.all() for c in cov.values()):
         _miss("incomplete_coverage")
         return None
@@ -231,38 +291,148 @@ def _intersect(a: tuple, b: tuple):
     return tuple(out)
 
 
-def _fetch_verified(key, candidates, clients) -> bytes | None:
-    """Fetch one shard from any holder whose bytes match the manifest
-    CRC; every candidate exhausted -> None."""
-    import functools
+def _fetch_all(jobs, pools) -> dict | None:
+    """Every planned shard, fetched concurrently on a bounded worker
+    pool: ``{key: bytes-like}`` (each CRC-verified) or None when any
+    shard could not be served by any holder.  The first unservable
+    shard makes the whole restore a miss, so it ABORTS the rest:
+    queued fetches short-circuit and in-flight ones stop between
+    holder attempts — a partial holder outage must not delay the
+    storage fallback by a full restore's worth of doomed transfers
+    (resize MTTR is the metric this subsystem exists for)."""
+    if not jobs:
+        return {}
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
 
-    from edl_tpu.rpc import chunks
-    for pod, ent, owner in candidates:
-        client = clients.get(pod)
-        if client is None:
-            continue
+    items = sorted(jobs.items(),
+                   key=lambda kv: -int(kv[1][0]["nbytes"]))  # largest first
+    workers = min(len(items), max(1, constants.TRANSFER_WORKERS))
+    abort = threading.Event()
+
+    def fetch_one(kv):
+        key, (ent, cands) = kv
+        data = None if abort.is_set() \
+            else _fetch_shard(key, ent, cands, pools, abort)
+        if data is None:
+            abort.set()
+        return data
+
+    if workers == 1:
+        fetched = [fetch_one(kv) for kv in items]
+    else:
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="memstate-fetch") as ex:
+            fetched = list(ex.map(fetch_one, items))
+    results: dict = {}
+    for (key, _job), data in zip(items, fetched):
+        if data is None:
+            return None
+        results[key] = data
+    return results
+
+
+def _fetch_shard(key, ent, candidates, pools, abort=None):
+    """One shard's bytes, CRC-verified against the manifest, or None
+    when every holder path is exhausted (or ``abort`` was set by a
+    sibling shard's failure).  Large shards stripe across all live
+    holders; any striped failure (including a whole-blob CRC mismatch)
+    falls back to trying each holder alone."""
+    from edl_tpu.rpc import transfer
+
+    nbytes = int(ent["nbytes"])
+    want_crc = int(ent["crc"])
+    live: list[tuple[str, str]] = []  # (pod, owner), deduped
+    for pod, _e, owner in candidates:
+        if pod in pools and all(pod != p for p, _ in live):
+            live.append((pod, owner))
+    if not live:
+        return None
+    owner_of = dict(live)
+    t0 = time.perf_counter()
+    if nbytes >= constants.STRIPE_MIN_BYTES and len(live) >= 2:
         try:
-            data = chunks.fetch_bytes(
-                functools.partial(client.call, "cache_fetch",
-                                  owner=owner, key=key),
-                int(ent["nbytes"]))
+            buf, crc = transfer.fetch_striped(
+                nbytes, [pod for pod, _ in live],
+                lambda holder, off, ln: _abortable(_holder_iter(
+                    pools[holder], owner_of[holder], key, off, ln), abort),
+                chunk_bytes=constants.MEMSTATE_CHUNK_BYTES,
+                span_name="memstate/stripe", key=key)
+            if crc == want_crc:
+                transfer.record("fetch", nbytes, time.perf_counter() - t0)
+                return buf  # no bytes() copy: consumers only read it
+            logger.warning("memstate: striped CRC mismatch for %s; "
+                           "retrying holders one by one", key)
+        except Exception as e:  # noqa: BLE001 — single-holder fallback
+            logger.warning("memstate: striped fetch of %s failed (%s); "
+                           "retrying holders one by one", key, e)
+    for pod, owner in live:
+        if abort is not None and abort.is_set():
+            return None  # a sibling shard already made this a miss
+        t0 = time.perf_counter()
+        try:
+            buf, crc = transfer.fetch_sequential(
+                nbytes,
+                _abortable(_holder_iter(pools[pod], owner, key, 0, nbytes),
+                           abort),
+                label=f"{key} from {pod[:8]}")
         except Exception:  # noqa: BLE001 — try the next holder
             logger.warning("memstate: fetch of %s from %s failed",
                            key, pod[:8])
             continue
-        if zlib.crc32(data) == int(ent["crc"]):
-            return data
+        if crc == want_crc:
+            transfer.record("fetch", nbytes, time.perf_counter() - t0)
+            return buf
         logger.warning("memstate: CRC mismatch for %s from %s", key, pod[:8])
     return None
 
 
-def _fetch_meta(meta_holders, clients) -> str | None:
+def _abortable(it, abort):
+    """Bound a chunk stream by the restore-wide abort event: when a
+    sibling shard already made the restore a miss, every in-flight
+    striped transfer stops within one chunk instead of finishing a
+    doomed multi-GB fetch (the abort contract in :func:`_fetch_all`)."""
+    for chunk in it:
+        if abort is not None and abort.is_set():
+            raise ConnectionError("restore aborted: a sibling shard missed")
+        yield chunk
+
+
+def _holder_iter(pool, owner, key, offset, length):
+    """Ordered chunk iterator for one holder's byte range: server-push
+    streaming (``cache_fetch_stream``) when the peer has it, windowed
+    pipelined ``cache_fetch`` calls as the old-peer fallback.  The
+    probe result is cached per pool so an old peer is asked once."""
+    from edl_tpu.rpc import chunks
+
+    label = f"{key}@{owner[:8]}"
+    if not getattr(pool, "_no_stream", False):
+        it = chunks.iter_fetch_streaming(
+            pool, "cache_fetch_stream", length, offset=offset,
+            owner=owner, key=key, label=label)
+        try:
+            first = next(it, None)
+        except EdlInternalError as e:
+            if "no such method" not in str(e):
+                raise
+            pool._no_stream = True  # old peer: demote for this pool's life
+        else:
+            if first is not None:
+                yield first
+            yield from it
+            return
+    yield from chunks.iter_fetch_pipelined(
+        pool, "cache_fetch", length, offset=offset,
+        owner=owner, key=key, label=label)
+
+
+def _fetch_meta(meta_holders, pools) -> str | None:
     for pod, owner in meta_holders:
-        client = clients.get(pod)
-        if client is None:
+        pool = pools.get(pod)
+        if pool is None:
             continue
         try:
-            raw = client.call("cache_meta", owner=owner)
+            raw = pool.call("cache_meta", owner=owner)
         except Exception:  # noqa: BLE001
             continue
         if raw:

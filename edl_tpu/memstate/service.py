@@ -47,6 +47,39 @@ _SETS_COMMITTED = obs_metrics.counter(
     "Shard-sets sealed in the cache, by role", ("role",))
 
 
+def push_shards_parallel(pool, blobs: dict[str, bytes], owner: str,
+                         step: int, window: int | None = None) -> None:
+    """Push a shard-set's blobs over ``pool`` with distinct shards on
+    distinct channels (bounded by pool size and
+    ``EDL_TPU_TRANSFER_WORKERS``) and each shard's chunks windowed on
+    its channel.  One key's chunks never split across channels, so the
+    receiver's strict per-key seq validation holds.  Largest shards
+    start first (longest-processing-time order packs the channels);
+    the first failure propagates — a partial push stays staged and is
+    superseded by the next stream, exactly like a killed pusher."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from edl_tpu.rpc import chunks
+
+    keys = sorted(blobs, key=lambda k: -len(blobs[k]))
+    if not keys:
+        return
+
+    def push_one(key: str) -> None:
+        chunks.push_bytes_pipelined(pool, "cache_put_chunk", blobs[key],
+                                    window=window or 0, owner=owner,
+                                    step=int(step), key=key)
+
+    workers = min(len(pool), len(keys), constants.TRANSFER_WORKERS)
+    if workers <= 1:
+        for key in keys:
+            push_one(key)
+        return
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="memstate-push") as ex:
+        list(ex.map(push_one, keys))
+
+
 class _Set:
     """One committed shard-set: ``{key: bytes}`` + manifest + sidecar."""
 
@@ -199,6 +232,34 @@ class StateCacheService:
         _BYTES_SERVED.inc(len(data))
         return data
 
+    def cache_fetch_stream(self, owner: str, key: str, offset: int = 0,
+                           length: int = -1, chunk_bytes: int = 0):
+        """Streaming fetch: one request, the whole range as ordered
+        response frames (rpc/server.Streaming) — no round trip per
+        chunk.  ``length=-1`` means to the end of the shard.  Old
+        callers keep :meth:`cache_fetch`; old *servers* without this
+        method surface as a typed no-such-method error the restore
+        demotes on."""
+        with self._lock:
+            s = self._sets.get(owner)
+            if s is None or key not in s.shards:
+                raise EdlInternalError(f"no cached shard {owner}/{key}")
+            # bytes are immutable: hold the ref, stream outside the lock
+            # (eviction replaces the dict entry, never mutates the blob)
+            data = s.shards[key]
+        offset = max(0, int(offset))
+        end = len(data) if int(length) < 0 else min(len(data),
+                                                    offset + int(length))
+        cb = int(chunk_bytes) or constants.MEMSTATE_CHUNK_BYTES
+        from edl_tpu.rpc.server import Streaming
+
+        def gen(mv=memoryview(data)):
+            for pos in range(offset, end, cb):
+                part = mv[pos:min(end, pos + cb)]
+                _BYTES_SERVED.inc(len(part))
+                yield part
+        return Streaming(gen())
+
     def cache_meta(self, owner: str) -> bytes | None:
         with self._lock:
             s = self._sets.get(owner)
@@ -255,18 +316,16 @@ class StateCacheService:
                 shards = dict(s.shards)
                 manifest = {k: dict(v) for k, v in s.manifest.items()}
                 meta = s.meta
-            import functools
-
-            from edl_tpu.rpc import chunks
-            from edl_tpu.rpc.client import RpcClient
-            with RpcClient(endpoint) as client:
+            from edl_tpu.rpc import chunks, transfer
+            from edl_tpu.rpc.client import RpcChannelPool
+            with RpcChannelPool(endpoint) as pool:
                 # delta replication: skip shards the target already
                 # holds at this step with the same CRC — a sidecar-only
                 # patch (save_meta -> update_meta -> re-commit) must
                 # not re-ship the whole multi-GB set per epoch
                 theirs = {}
                 try:
-                    listing = client.call("cache_manifest").get(owner)
+                    listing = pool.call("cache_manifest").get(owner)
                     if listing and listing["step"] == step:
                         theirs = listing["shards"]
                 except Exception:  # noqa: BLE001 — treat as cold target
@@ -274,14 +333,15 @@ class StateCacheService:
                 todo = {k: v for k, v in shards.items()
                         if k not in theirs
                         or theirs[k].get("crc") != manifest[k]["crc"]}
-                for key, data in todo.items():
-                    chunks.push_bytes(
-                        functools.partial(client.call, "cache_put_chunk",
-                                          owner=owner, step=step, key=key),
-                        data)
-                client.call("cache_commit", owner=owner, step=step,
-                            manifest={k: manifest[k] for k in todo},
-                            meta=meta)
+                t0 = time.monotonic()
+                push_shards_parallel(pool, todo, owner=owner, step=step)
+                if todo:
+                    transfer.record("push",
+                                    sum(len(d) for d in todo.values()),
+                                    time.monotonic() - t0)
+                pool.call("cache_commit", owner=owner, step=step,
+                          manifest={k: manifest[k] for k in todo},
+                          meta=meta)
             logger.info("replicated step %d (%d/%d shards) to %s", step,
                         len(todo), len(shards), target[:8])
         except Exception:  # noqa: BLE001 — redundancy is best-effort

@@ -134,27 +134,38 @@ class StateCacheTee:
             except Exception:  # noqa: BLE001 — the cache is best-effort
                 logger.exception("memstate tee op %s failed; the next "
                                  "restore will fall back to storage", op[0])
+                if self._client is not None:
+                    self._client.close()
                 self._client = None  # reconnect on next op
 
     def _push(self, step: int, shard_list, manifest) -> None:
-        from edl_tpu.rpc import chunks
-        blobs = shards.finish_manifest(shard_list, manifest)
-        for key, data in blobs.items():
-            chunks.push_bytes(
-                functools.partial(self._call, "cache_put_chunk",
-                                  owner=self._pod_id, step=step, key=key),
-                data)
-        logger.info("memstate: staged step %d (%d shards, %d bytes) to "
-                    "local cache", step, len(blobs),
-                    sum(len(b) for b in blobs.values()))
+        import time as _time
 
-    def _call(self, method: str, **kw):
+        from edl_tpu.memstate.service import push_shards_parallel
+        from edl_tpu.rpc import transfer
+        blobs = shards.finish_manifest(shard_list, manifest)
+        total = sum(len(b) for b in blobs.values())
+        t0 = _time.monotonic()
+        push_shards_parallel(self._pool(), blobs, owner=self._pod_id,
+                             step=step)
+        dt = _time.monotonic() - t0
+        transfer.record("push", total, dt)
+        logger.info("memstate: staged step %d (%d shards, %d bytes, "
+                    "%.1f MiB/s) to local cache", step, len(blobs), total,
+                    total / (1 << 20) / max(dt, 1e-9))
+
+    def _pool(self):
+        """The worker's channel pool to the local pod's cache service
+        (lazy: the advert may not exist yet at construction time)."""
         if self._client is None:
             eps = advert.list_adverts(self._store, self._job_id)
             ep = eps.get(self._pod_id)
             if ep is None:
                 raise ConnectionError(
                     f"no memstate advert for own pod {self._pod_id[:8]}")
-            from edl_tpu.rpc.client import RpcClient
-            self._client = RpcClient(ep)
-        return self._client.call(method, **kw)
+            from edl_tpu.rpc.client import RpcChannelPool
+            self._client = RpcChannelPool(ep)
+        return self._client
+
+    def _call(self, method: str, **kw):
+        return self._pool().call(method, **kw)

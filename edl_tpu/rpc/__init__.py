@@ -8,7 +8,7 @@ stack serves every control-plane and data-plane service; the wire format
 daemon (native/coordd.cc) speaks it too.
 """
 
-from edl_tpu.rpc.client import RpcClient
-from edl_tpu.rpc.server import RpcServer
+from edl_tpu.rpc.client import RpcChannelPool, RpcClient
+from edl_tpu.rpc.server import RpcServer, Streaming
 
-__all__ = ["RpcClient", "RpcServer"]
+__all__ = ["RpcChannelPool", "RpcClient", "RpcServer", "Streaming"]

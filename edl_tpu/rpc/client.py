@@ -1,77 +1,380 @@
-"""RPC client: sync request/response over one pooled connection.
+"""RPC clients: the one-connection :class:`RpcClient` and the
+multi-connection, pipelining :class:`RpcChannelPool`.
 
 Transport failures surface as :class:`EdlCoordError` (retryable) so
 callers can wrap calls in ``retry_until_timeout`` — the reference's
 pattern of decorating every client RPC with
 ``handle_errors_until_timeout`` (python/edl/utils/data_server_client.py).
+
+Connecting NEVER happens under a lock another caller can be waiting on:
+``RpcClient`` checks its pooled socket out, connects outside the lock,
+and checks it back in, so a dead endpoint costs each caller one connect
+timeout instead of serializing every thread behind the first victim.
+``RpcChannelPool`` holds one lock per connection for the same reason.
+
+The pool adds the bulk-transfer paths the peer checkpoint cache's
+restore bandwidth comes from:
+
+- ``call``            — one round trip on any free channel;
+- ``call_pipelined``  — a *window* of requests in flight on ONE channel
+  (the server's per-connection handler loop answers strictly in order,
+  so responses match requests positionally — no ids on the wire);
+- ``call_streaming``  — one request answered by multiple ordered frames
+  (server handlers returning :class:`~edl_tpu.rpc.server.Streaming`),
+  with strict ``q``-sequence validation: a gap or duplicate raises a
+  typed :class:`EdlStreamError` and poisons the channel, never silently
+  corrupts the payload.
 """
 
 from __future__ import annotations
 
+import itertools
 import socket
 import threading
+from collections import deque
+from typing import Iterable
 
 from edl_tpu.obs import context as obs_context
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import framing
-from edl_tpu.utils import exceptions
+from edl_tpu.utils import constants, exceptions
 from edl_tpu.utils.network import split_endpoint
+
+# the data plane's in-flight depth, observable while a bulk transfer
+# runs (doc/observability.md catalog; 0 between transfers).  Summed
+# across channels via inc/dec so concurrent transfers don't clobber
+# each other's reading
+_INFLIGHT_WINDOW = obs_metrics.gauge(
+    "edl_transfer_inflight_window",
+    "Pipelined chunk requests currently in flight, summed over this "
+    "process's channels")
+
+
+def _connect(endpoint: str, timeout: float) -> socket.socket:
+    host, port = split_endpoint(endpoint)
+    sock = socket.create_connection((host or "127.0.0.1", port),
+                                    timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _envelope(method: str, kwargs: dict) -> dict:
+    """Request envelope; the ambient trace context (obs/context.py)
+    rides under ``"tc"`` — the server re-establishes it around its
+    handler, so spans emitted remotely join this caller's trace."""
+    req = {"m": method, "a": kwargs}
+    ctx = obs_context.current()
+    if ctx is not None:
+        req["tc"] = ctx.to_wire()
+    return req
 
 
 class RpcClient:
+    # idle connections kept per client: callers that genuinely overlap
+    # (e.g. the distributed reader's producer + consumer threads on one
+    # leader client) each keep a persistent connection instead of
+    # paying a TCP handshake per overlapping call
+    MAX_IDLE = 4
+
     def __init__(self, endpoint: str, timeout: float = 30.0):
         self.endpoint = endpoint
         self._timeout = timeout
-        self._sock: socket.socket | None = None
+        self._idle: list[socket.socket] = []
         self._lock = threading.Lock()
+        self._closed = False
 
     def _connect(self) -> socket.socket:
-        host, port = split_endpoint(self.endpoint)
-        sock = socket.create_connection((host or "127.0.0.1", port), timeout=self._timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return sock
+        return _connect(self.endpoint, self._timeout)
 
     def call(self, method: str, _timeout: float | None = None, **kwargs):
         """Invoke ``method`` remotely; returns the result payload.
 
         Retries the transport once on a broken pooled connection, then
-        raises EdlCoordError for callers' retry loops.
-
-        The ambient trace context (obs/context.py) rides the envelope
-        under ``"tc"`` — the server re-establishes it around its
-        handler, so spans emitted remotely join this caller's trace.
+        raises EdlCoordError for callers' retry loops.  Sockets are
+        checked out of a small free list under the lock but CONNECTED
+        outside it: concurrent callers against a dead endpoint each pay
+        one connect timeout in parallel instead of queueing behind the
+        first, and overlapping callers each keep a pooled connection
+        (up to MAX_IDLE) rather than churning connects.
         """
-        req = {"m": method, "a": kwargs}
-        ctx = obs_context.current()
-        if ctx is not None:
-            req["tc"] = ctx.to_wire()
-        with self._lock:
-            for attempt in (0, 1):
-                try:
-                    if self._sock is None:
-                        self._sock = self._connect()
-                    self._sock.settimeout(_timeout if _timeout is not None else self._timeout)
-                    framing.send_frame(self._sock, req)
-                    resp = framing.recv_frame(self._sock)
-                    break
-                except (OSError, framing.FramingError) as e:
-                    self._close_locked()
-                    if attempt == 1:
-                        raise exceptions.EdlCoordError(
-                            f"rpc {method} to {self.endpoint} failed: {e}") from e
-        exceptions.deserialize(resp["s"])
-        return resp["r"]
-
-    def _close_locked(self):
-        if self._sock is not None:
+        req = _envelope(method, kwargs)
+        for attempt in (0, 1):
+            sock = None
+            if attempt == 0:
+                with self._lock:
+                    if self._idle:
+                        sock = self._idle.pop()
+            # attempt 1 always dials fresh: after one transport error
+            # every idle socket is equally suspect
             try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+                if sock is None:
+                    sock = self._connect()
+                sock.settimeout(_timeout if _timeout is not None
+                                else self._timeout)
+                framing.send_frame(sock, req)
+                resp = framing.recv_frame(sock)
+            except (OSError, framing.FramingError) as e:
+                _close_quietly(sock)
+                if attempt == 1:
+                    raise exceptions.EdlCoordError(
+                        f"rpc {method} to {self.endpoint} failed: {e}") from e
+                continue
+            self._checkin(sock)
+            exceptions.deserialize(resp["s"])
+            return resp["r"]
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self.MAX_IDLE:
+                self._idle.append(sock)
+                return
+        _close_quietly(sock)  # closed, or enough idle connections kept
 
     def close(self):
         with self._lock:
-            self._close_locked()
+            self._closed = True
+            socks, self._idle = self._idle, []
+        for sock in socks:
+            _close_quietly(sock)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _close_quietly(sock: socket.socket | None) -> None:
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class _Channel:
+    """One pooled connection with its own lock: a slow connect or a
+    long transfer on this channel never blocks callers that can use a
+    sibling channel."""
+
+    __slots__ = ("endpoint", "timeout", "lock", "sock")
+
+    def __init__(self, endpoint: str, timeout: float):
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.lock = threading.Lock()
+        self.sock: socket.socket | None = None
+
+    # caller holds self.lock for every method below
+    def ensure(self, timeout: float | None = None) -> socket.socket:
+        if self.sock is None:
+            self.sock = _connect(self.endpoint, self.timeout)
+        self.sock.settimeout(self.timeout if timeout is None else timeout)
+        return self.sock
+
+    def fail(self) -> None:
+        _close_quietly(self.sock)
+        self.sock = None
+
+
+class RpcChannelPool:
+    """N connections to one endpoint + the windowed transfer paths.
+
+    ``size`` defaults to ``EDL_TPU_TRANSFER_CONNS``; plain ``call``s
+    pick any free channel (blocking on one round-robin slot only when
+    all are busy), so control RPCs keep flowing while bulk transfers
+    occupy their channels.
+    """
+
+    def __init__(self, endpoint: str, size: int | None = None,
+                 timeout: float = 30.0):
+        self.endpoint = endpoint
+        self._timeout = timeout
+        n = max(1, int(size or constants.TRANSFER_CONNS))
+        self._channels = [_Channel(endpoint, timeout) for _ in range(n)]
+        self._rr = itertools.count()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self._channels)
+
+    def _acquire(self) -> _Channel:
+        n = len(self._channels)
+        start = next(self._rr)
+        ch = None
+        for i in range(n):
+            c = self._channels[(start + i) % n]
+            if c.lock.acquire(blocking=False):
+                ch = c
+                break
+        if ch is None:
+            ch = self._channels[start % n]
+            ch.lock.acquire()
+        # checked UNDER the channel lock: close() flags before it takes
+        # the locks, so either we see it here, or close() waits for us
+        # and fails our socket right after we release
+        if self._closed:
+            ch.lock.release()
+            raise exceptions.EdlCoordError(
+                f"rpc pool to {self.endpoint} is closed")
+        return ch
+
+    def call(self, method: str, _timeout: float | None = None, **kwargs):
+        """One round trip on any free channel (RpcClient.call semantics,
+        including the single transport retry)."""
+        req = _envelope(method, kwargs)
+        for attempt in (0, 1):
+            ch = self._acquire()
+            try:
+                sock = ch.ensure(_timeout)
+                framing.send_frame(sock, req)
+                resp = framing.recv_frame(sock)
+            except (OSError, framing.FramingError) as e:
+                ch.fail()
+                if attempt == 1:
+                    raise exceptions.EdlCoordError(
+                        f"rpc {method} to {self.endpoint} failed: {e}") from e
+                continue
+            finally:
+                ch.lock.release()
+            exceptions.deserialize(resp["s"])
+            return resp["r"]
+
+    def call_pipelined(self, method: str, requests: Iterable[dict],
+                       window: int | None = None,
+                       _timeout: float | None = None) -> list:
+        """``call`` for a whole batch with up to ``window`` requests in
+        flight on one channel; returns results in request order.  See
+        :meth:`iter_call_pipelined` for the error contract."""
+        return list(self.iter_call_pipelined(method, requests, window,
+                                             _timeout))
+
+    def iter_call_pipelined(self, method: str, requests: Iterable[dict],
+                            window: int | None = None,
+                            _timeout: float | None = None):
+        """Incremental pipelined call: yields results in request order
+        as responses drain, keeping up to ``window`` requests in
+        flight — memory stays bounded by the window, not the batch.
+
+        The FIRST typed error stops further sends, drains the frames
+        already in flight (the connection stays usable) and raises.  A
+        transport failure raises EdlCoordError — results not yet
+        yielded are indeterminate and callers re-dispatch (safe: chunk
+        protocols are idempotent per request).  Abandoning the
+        generator mid-drain tears the channel down (unread frames
+        would poison the next call on it)."""
+        requests = list(requests)
+        if not requests:
+            return
+        window = max(1, int(window or constants.TRANSFER_WINDOW))
+        ch = self._acquire()
+        done = False
+        pending: deque[int] = deque()
+        try:
+            try:
+                sock = ch.ensure(_timeout)
+                i = 0
+                error = None
+                while i < len(requests) or pending:
+                    while error is None and i < len(requests) \
+                            and len(pending) < window:
+                        framing.send_frame(
+                            sock, _envelope(method, requests[i]))
+                        pending.append(i)
+                        i += 1
+                        _INFLIGHT_WINDOW.inc()
+                    if not pending:
+                        break
+                    resp = framing.recv_frame(sock)
+                    pending.popleft()
+                    _INFLIGHT_WINDOW.dec()
+                    if error is None:
+                        if resp["s"]:
+                            error = resp["s"]  # drain, then raise below
+                        else:
+                            done = not pending and i == len(requests)
+                            yield resp["r"]
+                            done = False
+            except (OSError, framing.FramingError) as e:
+                ch.fail()
+                raise exceptions.EdlCoordError(
+                    f"pipelined rpc {method} to {self.endpoint} "
+                    f"failed: {e}") from e
+            done = True
+            if error is not None:
+                exceptions.deserialize(error)
+        finally:
+            if not done:
+                ch.fail()
+            _INFLIGHT_WINDOW.dec(len(pending))  # frames never drained
+            ch.lock.release()
+
+    def call_streaming(self, method: str, _timeout: float | None = None,
+                       **kwargs):
+        """One request, many ordered response frames: yields each
+        frame's payload.  Strict sequence check — a gap, duplicate, or
+        non-streaming answer raises :class:`EdlStreamError` and tears
+        the channel down (the two ends have desynchronized).
+        Abandoning the generator mid-stream also closes the channel:
+        unread frames would poison the next call on it."""
+        ch = self._acquire()
+        done = False
+        try:
+            try:
+                sock = ch.ensure(_timeout)
+                framing.send_frame(sock, _envelope(method, kwargs))
+                expect = 0
+                while True:
+                    resp = framing.recv_frame(sock)
+                    if "q" not in resp:
+                        # a plain response where frames were expected —
+                        # the channel is still in sync (the whole
+                        # response was read), so don't tear it down:
+                        # surface its typed error (an old peer answers
+                        # "no such method" this way and callers demote
+                        # to the per-chunk path on that)
+                        done = True
+                        exceptions.deserialize(resp["s"])
+                        raise exceptions.EdlStreamError(
+                            f"{method} to {self.endpoint}: expected a "
+                            f"streamed response, got a single frame")
+                    q = int(resp["q"])
+                    if q != expect:
+                        kind = "duplicate" if q < expect else "gap"
+                        raise exceptions.EdlStreamError(
+                            f"{method} to {self.endpoint}: sequence "
+                            f"{kind} (frame {q}, expected {expect})")
+                    if resp.get("eof"):
+                        # terminator: clean eof, or the handler's
+                        # mid-stream failure — either way it was fully
+                        # read, so the channel stays healthy
+                        done = True
+                        exceptions.deserialize(resp["s"])
+                        return
+                    exceptions.deserialize(resp["s"])
+                    expect += 1
+                    if "nb" in resp:
+                        # raw payload frame: the bytes follow verbatim
+                        yield framing.recv_raw(sock, int(resp["nb"]))
+                    else:
+                        yield resp["r"]
+            except (OSError, framing.FramingError) as e:
+                raise exceptions.EdlCoordError(
+                    f"streaming rpc {method} to {self.endpoint} "
+                    f"failed: {e}") from e
+        finally:
+            if not done:
+                ch.fail()
+            ch.lock.release()
+
+    def close(self) -> None:
+        # flag first: a caller that acquires a channel after this sees
+        # the pool closed and aborts instead of silently reconnecting
+        # (the socket would leak — close() never runs again)
+        self._closed = True
+        for ch in self._channels:
+            with ch.lock:
+                ch.fail()
 
     def __enter__(self):
         return self

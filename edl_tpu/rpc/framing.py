@@ -29,22 +29,67 @@ def pack(obj) -> bytes:
 
 
 def send_frame(sock: socket.socket, obj) -> None:
-    sock.sendall(pack(obj))
+    """Write one frame without concatenating header + body: the header
+    is 8 bytes but the body is up to a whole checkpoint chunk, and the
+    ``header + body`` join in :func:`pack` copied every blob a second
+    time.  ``sendmsg`` writes both buffers in one syscall (so
+    TCP_NODELAY cannot split the header into its own packet); platforms
+    without it fall back to the packed copy."""
+    body = msgpack.packb(obj, use_bin_type=True)
+    if len(body) > MAX_FRAME:
+        raise FramingError(f"frame too large: {len(body)}")
+    header = _HEADER.pack(MAGIC, len(body))
+    if not hasattr(sock, "sendmsg"):
+        sock.sendall(header + body)
+        return
+    view = memoryview(body)
+    sent = sock.sendmsg([header, view])
+    total = len(header) + len(view)
+    while sent < total:
+        # partial write (large frame vs socket buffer): finish with
+        # sendall on the remainder — no copies, just views
+        off = sent - len(header)
+        if off < 0:
+            sent += sock.sendmsg([header[sent:], view])
+            continue
+        sock.sendall(view[off:])
+        return
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
-            raise FramingError("connection closed mid-frame" if buf else "connection closed")
-        buf.extend(chunk)
-    return bytes(buf)
+def send_raw(sock: socket.socket, payload) -> None:
+    """Write a bytes-like payload verbatim (no msgpack, no length
+    prefix — the preceding envelope frame carried the length).  The
+    streaming-response fast path: a multi-MiB chunk crosses the wire
+    with zero serialization copies on either side."""
+    sock.sendall(payload)
+
+
+def recv_raw(sock: socket.socket, n: int) -> bytearray:
+    """Counterpart of :func:`send_raw`: read exactly ``n`` payload
+    bytes into one fresh buffer."""
+    if n > MAX_FRAME:
+        raise FramingError(f"raw payload too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes into ONE preallocated buffer
+    (``recv_into``, no per-read chunk objects or final join-copy —
+    this is the hot path of every multi-MiB chunk frame)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise FramingError("connection closed mid-frame" if got
+                               else "connection closed")
+        got += r
+    return buf
 
 
 def recv_frame(sock: socket.socket):
-    header = _recv_exact(sock, _HEADER.size)
-    magic, length = _HEADER.unpack(header)
+    magic, length = _HEADER.unpack(bytes(_recv_exact(sock, _HEADER.size)))
     if magic != MAGIC:
         raise FramingError(f"bad magic {magic!r}")
     if length > MAX_FRAME:

@@ -8,6 +8,21 @@ client-side (see edl_tpu/utils/exceptions.py, mirroring the reference's
 proto-Status error contract).  One thread per connection — every
 service here is control-plane (barriers, discovery, batch metadata), so
 connection counts are O(pods + teachers).
+
+Because the handler loop recv/sends serially per connection, clients
+may *pipeline*: send several requests back-to-back and read the
+responses in order (``RpcChannelPool.call_pipelined``) — no server
+change needed, the socket buffers the backlog.
+
+**Streaming responses**: a handler that returns a :class:`Streaming`
+wrapper answers ONE request with multiple ordered frames
+``{"s": null, "r": item, "q": seq}`` followed by a terminator
+``{"s": null, "r": null, "q": n, "eof": true}`` (or ``"s"`` carrying a
+serialized error if the iterator failed mid-stream).  The client
+validates ``q`` strictly; a gap or duplicate is a typed
+``EdlStreamError``, never silent corruption.  Bulk fetches (checkpoint
+shards) use this to keep a window of chunks on the wire without a
+round-trip per chunk.
 """
 
 from __future__ import annotations
@@ -33,6 +48,17 @@ _REQUEST_SECONDS = obs_metrics.histogram(
     ("method",))
 _ERRORS_TOTAL = obs_metrics.counter(
     "edl_rpc_errors_total", "RPC handler exceptions, by method", ("method",))
+
+
+class Streaming:
+    """Return-type marker: the wrapped iterator's items each go out as
+    one ordered response frame (see module docstring).  Handlers yield
+    bytes-like chunks; anything msgpack-serializable works."""
+
+    __slots__ = ("it",)
+
+    def __init__(self, it):
+        self.it = it
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -62,7 +88,10 @@ class _Handler(socketserver.BaseRequestHandler):
                      if caller is not None else None)
             try:
                 result = fn(**(msg.get("a") or {}))
-                resp = {"s": None, "r": result}
+                if isinstance(result, Streaming):
+                    resp = self._stream(method, result)
+                else:
+                    resp = {"s": None, "r": result}
             except Exception as e:  # noqa: BLE001 — serialize everything
                 if not isinstance(e, exceptions.EdlRetryableError):
                     logger.warning("handler %s raised", method, exc_info=True)
@@ -75,10 +104,45 @@ class _Handler(socketserver.BaseRequestHandler):
                     obs_context.detach(token)
             _REQUEST_SECONDS.labels(method=method).observe(
                 time.perf_counter() - t0)
+            if resp is None:
+                return  # client vanished mid-stream; connection is done
             try:
                 framing.send_frame(self.request, resp)
             except OSError:
                 return
+
+    def _stream(self, method: str, result: Streaming) -> dict | None:
+        """Send ``result``'s items as ordered ``q``-numbered frames;
+        returns the terminator frame for the main loop to send (eof,
+        or the serialized error if the iterator failed mid-stream), or
+        None when the client went away.
+
+        Bytes-like items take the RAW fast path: a small envelope
+        ``{"q", "nb"}`` followed by the payload verbatim — the chunk
+        is never msgpack-packed (one whole copy saved per side, and
+        the client can ``recv_into`` a right-sized buffer)."""
+        q = 0
+        try:
+            for item in result.it:
+                try:
+                    if isinstance(item, (bytes, bytearray, memoryview)):
+                        framing.send_frame(self.request, {
+                            "s": None, "q": q,
+                            "nb": memoryview(item).nbytes})
+                        framing.send_raw(self.request, item)
+                    else:
+                        framing.send_frame(self.request,
+                                           {"s": None, "r": item, "q": q})
+                except OSError:
+                    return None
+                q += 1
+        except Exception as e:  # noqa: BLE001 — iterator failure
+            logger.warning("streaming handler %s failed at frame %d",
+                           method, q, exc_info=True)
+            _ERRORS_TOTAL.labels(method=method).inc()
+            return {"s": exceptions.serialize(e), "r": None,
+                    "q": q, "eof": True}
+        return {"s": None, "r": None, "q": q, "eof": True}
 
 
 class _TcpServer(socketserver.ThreadingTCPServer):

@@ -1,0 +1,255 @@
+"""Striped multi-holder bulk transfer + the transfer-plane metrics.
+
+The peer checkpoint cache made resize restores network-bound; this
+module is where the bandwidth comes back.  A blob that several peers
+hold (a shard's owner + its ring replica) is split into contiguous
+chunk-aligned ranges, one per holder, and the ranges are fetched
+concurrently — aggregate bandwidth scales with holders × per-channel
+window instead of being bounded by one stream's round-trip latency
+(CheckFreq/Gemini's recovery-path trick, PAPERS.md).
+
+Failure semantics: a holder that dies mid-range *demotes* — its
+unfetched remainder is re-assigned to the survivors and the transfer
+completes; only when every holder is dead does the fetch raise.
+
+CRC is OVERLAPPED with the network: each range keeps a running
+``zlib.crc32`` as its chunks land, and the per-range CRCs fold into
+the whole-blob CRC with :func:`crc32_combine` (zlib's GF(2) matrix
+trick, ported because :mod:`zlib` doesn't export it) — so verification
+adds no tail latency after the last byte arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Iterable, Iterator, Sequence
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+TRANSFER_BYTES = obs_metrics.counter(
+    "edl_transfer_bytes_total",
+    "Bulk-transfer payload bytes moved by the streaming data plane, "
+    "by direction", ("path",))
+TRANSFER_SECONDS = obs_metrics.histogram(
+    "edl_transfer_seconds",
+    "Wall time of one bulk transfer operation (a shard fetch / a "
+    "shard-set push), by direction", ("path",),
+    buckets=obs_metrics.RESIZE_BUCKETS)
+TRANSFER_BANDWIDTH = obs_metrics.histogram(
+    "edl_transfer_bandwidth_mib_s",
+    "Achieved bandwidth of one bulk transfer operation (MiB/s), by "
+    "direction", ("path",),
+    buckets=(1, 4, 16, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384))
+
+
+def record(path: str, nbytes: int, seconds: float) -> None:
+    """One completed transfer operation -> the three series above."""
+    TRANSFER_BYTES.labels(path=path).inc(nbytes)
+    TRANSFER_SECONDS.labels(path=path).observe(seconds)
+    TRANSFER_BANDWIDTH.labels(path=path).observe(
+        nbytes / (1 << 20) / max(seconds, 1e-9))
+
+
+# -- crc32_combine (zlib's algorithm, not exposed by the zlib module) -------
+def _gf2_times(mat: Sequence[int], vec: int) -> int:
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _gf2_square(mat: Sequence[int]) -> list[int]:
+    return [_gf2_times(mat, mat[n]) for n in range(32)]
+
+
+def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC32 of ``A + B`` from ``crc32(A)``, ``crc32(B)`` and
+    ``len(B)`` — lets striped ranges verify in parallel and still
+    produce the manifest's whole-blob checksum."""
+    if len2 <= 0:
+        return crc1
+    odd = [0xEDB88320] + [1 << (n - 1) for n in range(1, 32)]
+    even = _gf2_square(odd)
+    odd = _gf2_square(even)
+    while True:
+        even = _gf2_square(odd)
+        if len2 & 1:
+            crc1 = _gf2_times(even, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+        odd = _gf2_square(even)
+        if len2 & 1:
+            crc1 = _gf2_times(odd, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+    return crc1 ^ crc2
+
+
+# -- striped fetch ----------------------------------------------------------
+class _Segment:
+    """One contiguous fetched run: (start, length, crc-of-those-bytes)."""
+
+    __slots__ = ("start", "length", "crc")
+
+    def __init__(self, start: int):
+        self.start = start
+        self.length = 0
+        self.crc = 0
+
+    def feed(self, chunk) -> None:
+        self.crc = zlib.crc32(chunk, self.crc)
+        self.length += len(chunk)
+
+
+def _split_ranges(nbytes: int, n: int, chunk_bytes: int) -> list[tuple[int, int]]:
+    """``n`` contiguous chunk-aligned (offset, length) ranges covering
+    [0, nbytes); never returns empty ranges."""
+    n_chunks = max(1, -(-nbytes // chunk_bytes))
+    n = max(1, min(n, n_chunks))
+    out = []
+    per = n_chunks // n
+    extra = n_chunks % n
+    off = 0
+    for i in range(n):
+        take = (per + (1 if i < extra else 0)) * chunk_bytes
+        length = min(take, nbytes - off)
+        if length > 0:
+            out.append((off, length))
+            off += length
+    return out
+
+
+def fetch_striped(nbytes: int, holders: Sequence[str],
+                  make_iter: Callable[[str, int, int], Iterator],
+                  chunk_bytes: int, span_name: str = "transfer/stripe",
+                  **span_fields) -> tuple[bytearray, int]:
+    """Fetch ``nbytes`` striped across ``holders``; returns
+    ``(buffer, crc32)`` with the CRC computed during the fetch.
+
+    ``make_iter(holder, offset, length)`` yields the bytes of that
+    range in order (streaming or pipelined underneath — this layer
+    only needs ordered chunks).  A holder whose iterator raises is
+    demoted: its unfetched remainder re-runs on a surviving holder.
+    Raises the last holder error when nobody can serve a range.
+    """
+    buf = bytearray(nbytes)
+    view = memoryview(buf)
+    segments: list[_Segment] = []
+    dead: set[str] = set()
+    lock = threading.Lock()
+    errors: list[BaseException] = []
+
+    def fetch_range(holder: str, offset: int, length: int) -> None:
+        seg = _Segment(offset)
+        t0 = time.perf_counter()
+        try:
+            pos = offset
+            end = offset + length
+            for chunk in make_iter(holder, pos, end - pos):
+                if pos + len(chunk) > end:
+                    raise ValueError(
+                        f"holder {holder} overran its range by "
+                        f"{pos + len(chunk) - end} bytes")
+                view[pos:pos + len(chunk)] = chunk
+                seg.feed(chunk)
+                pos += len(chunk)
+            if pos != end:
+                raise ConnectionError(
+                    f"holder {holder} stream ended {end - pos} bytes "
+                    f"short of its range")
+        except Exception as e:  # noqa: BLE001 — demote, survivors finish
+            with lock:
+                if seg.length:
+                    segments.append(seg)  # the prefix it DID deliver
+                dead.add(holder)
+                errors.append(e)
+                remaining.append((offset + seg.length, length - seg.length))
+            obs_trace.emit(span_name, holder=holder, offset=offset,
+                           nbytes=seg.length, ok=False,
+                           dur=time.perf_counter() - t0, **span_fields)
+            logger.warning("striped fetch: holder %s failed %d bytes into "
+                           "range [%d, %d): %s", holder, seg.length, offset,
+                           offset + length, e)
+        else:
+            with lock:
+                segments.append(seg)
+            obs_trace.emit(span_name, holder=holder, offset=offset,
+                           nbytes=length, ok=True,
+                           dur=time.perf_counter() - t0, **span_fields)
+
+    remaining: list[tuple[int, int]] = []
+    ranges = _split_ranges(nbytes, len(holders), chunk_bytes)
+    assignments = [(h, off, ln)
+                   for h, (off, ln) in zip(holders, ranges)]
+    while assignments:
+        if len(assignments) == 1:
+            fetch_range(*assignments[0])  # inline: no thread overhead
+        else:
+            threads = [threading.Thread(
+                target=fetch_range, args=(h, off, ln),
+                name=f"stripe:{h[:8]}", daemon=True)
+                for h, off, ln in assignments]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        with lock:
+            todo, remaining = remaining, []
+            live = [h for h in holders if h not in dead]
+        if not todo:
+            break
+        if not live:
+            raise (errors[-1] if errors else
+                   ConnectionError("striped fetch: every holder failed"))
+        # demote: spread the failed remainders over the survivors
+        assignments = [(live[i % len(live)], off, ln)
+                       for i, (off, ln) in enumerate(todo) if ln > 0]
+
+    segments.sort(key=lambda s: s.start)
+    crc = 0
+    covered = 0
+    for seg in segments:
+        if seg.start != covered:
+            raise ConnectionError(
+                f"striped fetch left a hole at byte {covered}")
+        crc = crc32_combine(crc, seg.crc, seg.length) if covered else seg.crc
+        covered += seg.length
+    if covered != nbytes:
+        raise ConnectionError(
+            f"striped fetch covered {covered} of {nbytes} bytes")
+    return buf, crc
+
+
+def fetch_sequential(nbytes: int, it: Iterable, label: str = "") \
+        -> tuple[bytearray, int]:
+    """Single-holder variant: drain ``it`` into a buffer with the CRC
+    computed as chunks arrive (same overlap, no striping)."""
+    buf = bytearray(nbytes)
+    view = memoryview(buf)
+    pos = 0
+    crc = 0
+    for chunk in it:
+        if pos + len(chunk) > nbytes:
+            raise ConnectionError(
+                f"fetch{' of ' + label if label else ''} overran "
+                f"{nbytes} bytes")
+        view[pos:pos + len(chunk)] = chunk
+        crc = zlib.crc32(chunk, crc)
+        pos += len(chunk)
+    if pos != nbytes:
+        raise ConnectionError(
+            f"fetch{' of ' + label if label else ''} ended {nbytes - pos} "
+            f"bytes short")
+    return buf, crc

@@ -121,6 +121,26 @@ MEMSTATE_CHUNK_BYTES = int(_f("EDL_TPU_MEMSTATE_CHUNK_BYTES", 4 << 20))
 # cache completeness
 MEMSTATE_MAX_BYTES = int(_f("EDL_TPU_MEMSTATE_MAX_BYTES", 0))
 
+# -- streaming data plane (rpc/client pool, rpc/transfer) ------------------
+# connections per endpoint in an RpcChannelPool: bulk transfers occupy
+# one channel each, so this bounds per-peer transfer parallelism
+TRANSFER_CONNS = int(_f("EDL_TPU_TRANSFER_CONNS", 4))
+# chunk requests in flight per channel on the pipelined/streaming paths
+# (1 = the legacy one-chunk-per-round-trip behavior, bit-identical)
+TRANSFER_WINDOW = int(_f("EDL_TPU_TRANSFER_WINDOW", 8))
+# worker threads a restore/push fans distinct shards across
+TRANSFER_WORKERS = int(_f("EDL_TPU_TRANSFER_WORKERS", 4))
+# a single shard at least this large is STRIPED across all live holders
+# (primary + ring replica) instead of fetched from one; smaller shards
+# gain more from per-shard concurrency than from splitting
+STRIPE_MIN_BYTES = int(_f("EDL_TPU_STRIPE_MIN_BYTES", 8 << 20))
+# cap on fetched-but-not-yet-assembled restore bytes: leaves are
+# fetched+assembled in batches of at most this many manifest bytes, so
+# peak host RAM stays ~one batch above the assembled arrays instead of
+# the process's whole checkpoint share.  0 = unlimited (one batch).
+# A single leaf larger than the budget still fetches whole (floor).
+TRANSFER_BUDGET_BYTES = int(_f("EDL_TPU_TRANSFER_BUDGET_BYTES", 1 << 30))
+
 # -- elastic serving gateway (edl_tpu/gateway, serving/replica) -----------
 # how often a replica refreshes its leased advert with live load stats
 # (free slots, queue depth, prefill stall) and republishes engine gauges

@@ -79,6 +79,15 @@ class EdlDataError(EdlRetryableError):
     """Data-server state not ready (e.g. balanced metas not computed)."""
 
 
+class EdlStreamError(EdlError):
+    """Streamed-response protocol violation (sequence gap/duplicate,
+    short stream, or a non-streaming answer where frames were
+    expected).  NOT retryable on the same connection — the two ends
+    have desynchronized and the transport must be torn down; callers
+    that hold alternatives (another holder of the same shard) may
+    retry there."""
+
+
 class EdlFileListNotMatchError(EdlError):
     """Pod's file-list slice doesn't match the checkpointed one."""
 
@@ -106,6 +115,7 @@ _REGISTRY = {
         EdlUnavailableError,
         EdlStopIteration,
         EdlDataError,
+        EdlStreamError,
         EdlFileListNotMatchError,
         EdlInternalError,
         EdlUnauthorizedError,

@@ -46,13 +46,19 @@ JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
 # renders one cross-process timeline with valid Perfetto JSON
 JAX_PLATFORMS=cpu python scripts/obs_agg_smoke.py
 
+# transfer smoke: the streaming data plane's microbench (loopback,
+# small payload, subprocess holders) — pipelined/striped fetch must not
+# regress below the serial baseline, and the MiB/s numbers land in the
+# CI log so throughput trends are visible per run
+JAX_PLATFORMS=cpu python scripts/transfer_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
 # now exits 0 even on failure (partial-artifact contract), so CI must
 # assert the artifact is COMPLETE — no error/partial keys, real value
 EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
 EDL_TPU_BENCH_WIDTH=8 EDL_TPU_BENCH_PIPELINE=0 EDL_TPU_BENCH_LM=0 \
-EDL_TPU_BENCH_MEMSTATE_MB=8 \
+EDL_TPU_BENCH_MEMSTATE_MB=8 EDL_TPU_BENCH_TRANSFER_MB=8 \
 JAX_PLATFORMS=cpu python bench.py | tail -1 \
     | python -c "
 import json, sys

@@ -1,0 +1,48 @@
+"""CI smoke for the streaming data plane: run the transfer microbench
+(loopback, small payload, subprocess holders — the same code path as
+``bench.py``'s transfer section) and assert the pipelined/striped
+paths did not regress below the serial baseline.
+
+Small-payload loopback numbers are noisy (scheduler, shared CI hosts),
+so the gate compares the BEST of the new paths against serial —
+structurally, pipelining the same work can't be slower than
+serializing it, so a loss here means a protocol-level regression
+(e.g. the window collapsed to 1 or streaming quietly fell back),
+which is exactly what this stage exists to catch.  The absolute
+bandwidth numbers go to the CI log for trend-eyeballing.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# small-but-not-tiny payload: enough chunks for a real window, fast on CPU
+os.environ.setdefault("EDL_TPU_BENCH_TRANSFER_MB", "24")
+os.environ.setdefault("EDL_TPU_BENCH_TRANSFER_CHUNK", str(1 << 20))
+os.environ.setdefault("EDL_TPU_BENCH_TRANSFER_REPS", "3")
+
+from edl_tpu.bench import _bench_transfer  # noqa: E402
+
+
+def main() -> int:
+    r = _bench_transfer()
+    print(json.dumps(r))
+    serial = r["transfer_serial_mib_s"]
+    best_new = max(r["transfer_pipelined_mib_s"], r["transfer_striped_mib_s"])
+    ratio = best_new / max(serial, 1e-9)
+    print(f"transfer smoke: serial={serial} MiB/s, "
+          f"pipelined={r['transfer_pipelined_mib_s']} MiB/s, "
+          f"striped={r['transfer_striped_mib_s']} MiB/s "
+          f"(best new path {ratio:.2f}x serial)")
+    if best_new < serial:
+        print("FAIL: pipelined/striped transfer slower than the serial "
+              "baseline", file=sys.stderr)
+        return 1
+    print("transfer smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
