@@ -40,6 +40,7 @@
 #include <stdexcept>
 #include <string>
 #include <thread>
+#include <tuple>
 #include <unordered_map>
 #include <vector>
 
@@ -352,30 +353,37 @@ class Engine {
     return true;
   }
 
-  std::pair<std::vector<Event>, int64_t> wait(const std::string& prefix,
-                                              int64_t since, double timeout) {
+  // events, revision, snapshot-resync flag (deletes compacted out of
+  // the log are only visible as absence from a snapshot, so watchers
+  // must replace — not merge — their view when it is set)
+  std::tuple<std::vector<Event>, int64_t, bool> wait(const std::string& prefix,
+                                                     int64_t since,
+                                                     double timeout) {
     std::unique_lock<std::mutex> g(mu_);
     auto deadline = Clock::now() + to_dur(timeout);
     for (;;) {
       expire_locked(Clock::now());
-      if (!events_.empty() && since < events_.front().first - 1 &&
-          since < revision_) {
-        // caller's revision predates the bounded log: snapshot-as-puts
+      if (since > revision_ ||  // rewound counter: a coordd restart
+          (since < revision_ &&
+           (events_.empty() || since < events_.front().first - 1))) {
+        // caller's revision predates the bounded log (compaction, or a
+        // restart emptied it) or exceeds it (position from a previous
+        // life): snapshot-as-puts
         std::vector<Event> evs;
         for (auto it = data_.lower_bound(prefix);
              it != data_.end() &&
              it->first.compare(0, prefix.size(), prefix) == 0;
              ++it)
           evs.push_back(Event{"put", it->second});
-        return {evs, revision_};
+        return {evs, revision_, true};
       }
       std::vector<Event> evs;
       for (const auto& re : events_)
         if (re.first > since &&
             re.second.rec.key.compare(0, prefix.size(), prefix) == 0)
           evs.push_back(re.second);
-      if (!evs.empty()) return {evs, revision_};
-      if (Clock::now() >= deadline) return {{}, revision_};
+      if (!evs.empty()) return {evs, revision_, false};
+      if (Clock::now() >= deadline) return {{}, revision_, false};
       cv_.wait_for(g, std::min(to_dur(0.25), deadline - Clock::now()));
     }
   }
@@ -453,8 +461,17 @@ class Engine {
   std::map<std::string, Rec> data_;
   std::unordered_map<int64_t, Lease> leases_;
   std::deque<std::pair<int64_t, Event>> events_;
-  int64_t revision_ = 0;
-  int64_t next_lease_ = 1;
+  // clock-seeded like MemoryKV: an amnesiac coordd restart must land
+  // its counter AHEAD of any prior watcher's position so the resync
+  // clauses in wait() fire even when re-registration churn would let a
+  // from-zero counter catch back up to a stale since_revision
+  int64_t revision_ = std::chrono::duration_cast<std::chrono::milliseconds>(
+      std::chrono::system_clock::now().time_since_epoch()).count();
+  // the lease counter too: a restart re-granting from 1 would reuse a
+  // pre-restart lease_id — a holder still refreshing its stale id then
+  // keeps a DIFFERENT owner's lease alive and revokes it on shutdown
+  int64_t next_lease_ = std::chrono::duration_cast<std::chrono::milliseconds>(
+      std::chrono::system_clock::now().time_since_epoch()).count();
   std::thread sweeper_;
 };
 
@@ -551,8 +568,8 @@ static Value dispatch(Engine& kv, const std::string& m, const Value& a) {
         arg_str(a, "key"), arg_bytes(a, "value"), arg_int(a, "lease_id", 0))));
   } else if (m == "wait") {
     double timeout = std::min(arg_num(a, "timeout", 30.0), 60.0);
-    auto [evs, rev] = kv.wait(arg_str(a, "prefix"),
-                              arg_int(a, "since_revision", 0), timeout);
+    auto [evs, rev, snap] = kv.wait(arg_str(a, "prefix"),
+                                    arg_int(a, "since_revision", 0), timeout);
     Value arr = Value::array();
     for (const auto& e : evs) {
       Value pair = Value::array();
@@ -562,6 +579,7 @@ static Value dispatch(Engine& kv, const std::string& m, const Value& a) {
     }
     set("events", std::move(arr));
     set("rev", Value::integer(rev));
+    set("snap", Value::boolean(snap));
   } else if (m == "ping") {
     set("pong", Value::boolean(true));
   } else {

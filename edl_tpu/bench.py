@@ -312,6 +312,17 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- coord outage: control-plane recovery time (ISSUE 6) -----------------
+    # SIGKILL + restart a WAL-backed coord server with live adverts on
+    # it: how long until the store answers again and every advert is
+    # back — the robustness headline (doc/robustness.md)
+    if os.environ.get("EDL_TPU_BENCH_COORD", "1") != "0":
+        try:
+            out.update(_bench_coord_outage())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -372,6 +383,81 @@ srv.start()
 print(srv.port, flush=True)
 sys.stdin.read()  # serve until the parent closes our stdin
 """
+
+
+def _bench_coord_outage() -> dict:
+    """Control-plane recovery microbench: a WAL-backed coord server
+    (subprocess, like production) carrying live TTL-leased adverts is
+    SIGKILLed and restarted.  Reported:
+
+    - ``coord_restart_mttr_s`` — SIGKILL to the store answering again
+      (includes server boot: the honest operator-facing number);
+    - ``coord_advert_reregister_s`` — recovery to every advert visible
+      with a live lease (WAL-frozen leases should make this ~0: nothing
+      ever expired).
+    """
+    import tempfile
+
+    from edl_tpu.coord.register import Register
+    from edl_tpu.coord.resilient import ResilientCoordClient
+    from edl_tpu.coord.server import spawn_subprocess, wait_ready
+    from edl_tpu.utils.network import find_free_ports
+
+    ttl = float(os.environ.get("EDL_TPU_BENCH_COORD_TTL", 2.0))
+    n_adverts = int(os.environ.get("EDL_TPU_BENCH_COORD_ADVERTS", 8))
+    data_dir = tempfile.mkdtemp(prefix="edl-bench-coord-")
+    port = find_free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn():
+        return spawn_subprocess(port, data_dir, restart_grace=ttl, env=env)
+
+    proc = spawn()
+    registers: list[Register] = []
+    store = None
+    try:
+        wait_ready(ep)
+        store = ResilientCoordClient([ep], retry_deadline=60.0,
+                                     backoff_init=0.02)
+        keys = [f"/edl_tpu/bench/resource/nodes/p{i}"
+                for i in range(n_adverts)]
+        registers = [Register(store, k, b"ep", ttl=ttl) for k in keys]
+
+        t_kill = time.perf_counter()
+        proc.kill()
+        proc.wait(timeout=30)
+        proc = spawn()
+        wait_ready(ep)
+        mttr = time.perf_counter() - t_kill
+
+        t_up = time.perf_counter()
+        deadline = t_up + ttl * 4 + 30.0
+        while time.perf_counter() < deadline:
+            recs, _ = store.get_prefix("/edl_tpu/bench/resource/nodes/")
+            if (len(recs) == n_adverts
+                    and all(r.lease_id for r in recs)
+                    and all(store.lease_keepalive(r.lease_id)
+                            for r in recs)):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("adverts never re-registered after restart")
+        rereg = time.perf_counter() - t_up
+        return {"coord_restart_mttr_s": round(mttr, 3),
+                "coord_advert_reregister_s": round(rereg, 3),
+                "coord_adverts": n_adverts}
+    finally:
+        for reg in registers:
+            try:
+                reg.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+        if store is not None:
+            store.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
 
 
 def _bench_transfer() -> dict:

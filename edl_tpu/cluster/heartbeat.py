@@ -25,6 +25,13 @@ import time
 from edl_tpu.cluster import paths
 from edl_tpu.utils import constants
 
+# liveness beats are written from inside the training step loop: on a
+# resilient store (coord/resilient.py) a coordination outage must cost
+# the hot loop at most this much retrying, never the full op budget —
+# a missed beat is recoverable, a stalled step is the exact hang the
+# beat exists to detect
+BEAT_BUDGET_S = 5.0
+
 # auto-threshold shape: generous multiple of the observed step time,
 # floored high enough that checkpoint saves / eval passes between
 # beats can never look like hangs
@@ -50,7 +57,8 @@ def beat(store, job_id: str, pod_id: str, now: float | None = None,
     val = repr(time.time() if now is None else now)
     if threshold is not None:
         val += f" {threshold!r}"
-    store.put(_key(job_id, pod_id), val.encode())
+    with store.scoped_deadline(BEAT_BUDGET_S):
+        store.put(_key(job_id, pod_id), val.encode())
 
 
 def last_beat(store, job_id: str, pod_id: str) -> float | None:

@@ -22,7 +22,7 @@ from edl_tpu.cluster.env import JobEnv
 from edl_tpu.cluster.pod import Pod
 from edl_tpu.cluster.status import Status, load_job_status
 from edl_tpu.collective.launcher import Launcher
-from edl_tpu.coord.client import connect
+from edl_tpu.coord.client import connect_wait
 from edl_tpu.utils.logger import configure, get_logger
 from edl_tpu.utils.network import find_free_ports, local_ip
 
@@ -91,7 +91,9 @@ def run(argv: list[str] | None = None) -> int:
     from edl_tpu import obs
     obs.install_from_env("launcher")  # /metrics + JSONL trace, env-gated
 
-    store = connect(job_env.coord_endpoints)
+    # tolerate the coordination pod booting (or restarting) after us:
+    # backoff-retried connect instead of one shot
+    store = connect_wait(job_env.coord_endpoints)
     if load_job_status(store, job_env.job_id) == Status.SUCCEED:
         logger.info("job %s already SUCCEED; nothing to do", job_env.job_id)
         return 0

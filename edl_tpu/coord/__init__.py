@@ -13,6 +13,14 @@ transactions, guarded puts, revisioned prefix reads, watches) backed by
   protocol (epoll, single-writer); and
 - :class:`edl_tpu.coord.client.CoordClient` — the client, which is what
   every other subsystem programs against.
+
+Fault tolerance (doc/robustness.md): ``coord/wal.py`` makes the Python
+server durable (WAL + snapshot replay on restart, leases frozen across
+downtime); :class:`edl_tpu.coord.resilient.ResilientCoordClient` (what
+``connect()`` returns) retries with backoff + jitter and fails over
+across endpoints; :class:`edl_tpu.coord.session.CoordSession` owns a
+lease and its registered keys and re-grants/re-puts them idempotently
+after reconnect or lease loss.
 """
 
 from edl_tpu.coord.kv import KVRecord, KVStore, WatchEvent

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
 from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import exceptions, retry
 
 
 def _wire_to_rec(w):
@@ -23,51 +24,80 @@ class CoordClient(KVStore):
         self._rpc = RpcClient(endpoint, timeout)
 
     # -- kv ----------------------------------------------------------------
-    def put(self, key, value, lease_id=0):
-        return self._rpc.call("kv_put", key=key, value=value, lease_id=lease_id)["rev"]
+    # every short op takes ``_timeout`` (in-flight transport bound for
+    # this one call; None = the client default) so budget-scoped callers
+    # (ResilientCoordClient) can keep a HUNG endpoint — not just a
+    # refused one — inside their deadline
+    def put(self, key, value, lease_id=0, _timeout=None):
+        return self._rpc.call("kv_put", _timeout=_timeout, key=key,
+                              value=value, lease_id=lease_id)["rev"]
 
-    def get(self, key):
-        return _wire_to_rec(self._rpc.call("kv_get", key=key)["rec"])
+    def get(self, key, _timeout=None):
+        return _wire_to_rec(self._rpc.call("kv_get", _timeout=_timeout,
+                                           key=key)["rec"])
 
-    def get_prefix(self, prefix):
-        r = self._rpc.call("kv_range", prefix=prefix)
+    def get_prefix(self, prefix, _timeout=None):
+        r = self._rpc.call("kv_range", _timeout=_timeout, prefix=prefix)
         return [_wire_to_rec(w) for w in r["recs"]], r["rev"]
 
-    def delete(self, key):
-        return self._rpc.call("kv_del", key=key)["deleted"]
+    def delete(self, key, _timeout=None):
+        return self._rpc.call("kv_del", _timeout=_timeout, key=key)["deleted"]
 
-    def delete_prefix(self, prefix):
-        return self._rpc.call("kv_del_range", prefix=prefix)["n"]
+    def delete_prefix(self, prefix, _timeout=None):
+        return self._rpc.call("kv_del_range", _timeout=_timeout,
+                              prefix=prefix)["n"]
 
     # -- leases ------------------------------------------------------------
-    def lease_grant(self, ttl):
-        return self._rpc.call("lease_grant", ttl=ttl)["lease_id"]
+    def lease_grant(self, ttl, _timeout=None):
+        return self._rpc.call("lease_grant", _timeout=_timeout,
+                              ttl=ttl)["lease_id"]
 
-    def lease_keepalive(self, lease_id):
-        return self._rpc.call("lease_keepalive", lease_id=lease_id)["alive"]
+    def lease_keepalive(self, lease_id, _timeout=None):
+        return self._rpc.call("lease_keepalive", _timeout=_timeout,
+                              lease_id=lease_id)["alive"]
 
-    def lease_revoke(self, lease_id):
-        self._rpc.call("lease_revoke", lease_id=lease_id)
+    def lease_revoke(self, lease_id, _timeout=None):
+        self._rpc.call("lease_revoke", _timeout=_timeout, lease_id=lease_id)
 
     # -- transactions ------------------------------------------------------
-    def put_if_absent(self, key, value, lease_id=0):
-        return self._rpc.call("txn_put_if_absent", key=key, value=value,
-                              lease_id=lease_id)["succeeded"]
+    def put_if_absent(self, key, value, lease_id=0, _timeout=None):
+        return self._rpc.call("txn_put_if_absent", _timeout=_timeout, key=key,
+                              value=value, lease_id=lease_id)["succeeded"]
 
-    def put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
-        return self._rpc.call("txn_put_if_equals", guard_key=guard_key,
-                              guard_value=guard_value, key=key, value=value,
+    def put_if_equals(self, guard_key, guard_value, key, value, lease_id=0,
+                      _timeout=None):
+        return self._rpc.call("txn_put_if_equals", _timeout=_timeout,
+                              guard_key=guard_key, guard_value=guard_value,
+                              key=key, value=value,
                               lease_id=lease_id)["succeeded"]
 
     # -- watches -----------------------------------------------------------
     def wait(self, prefix, since_revision, timeout):
         r = self._rpc.call("wait", prefix=prefix, since_revision=since_revision,
                            timeout=timeout, _timeout=timeout + 10.0)
-        return WaitResult([WatchEvent(t, _wire_to_rec(w)) for t, w in r["events"]], r["rev"])
+        return WaitResult([WatchEvent(t, _wire_to_rec(w)) for t, w in r["events"]],
+                          r["rev"], snapshot=bool(r.get("snap", False)))
+
+    # -- debug/chaos --------------------------------------------------------
+    def dump_state(self, _timeout=None) -> dict:
+        """Canonical state image (Python server only — the chaos smoke's
+        WAL-restart bit-exactness check)."""
+        return self._rpc.call("dump_state", _timeout=_timeout)["state"]
 
     def ping(self) -> bool:
+        """True if this endpoint answers a coordination ping.
+
+        Transport failures (endpoint unreachable, connection refused)
+        RAISE ``EdlCoordError`` so callers — ``connect()``'s endpoint
+        scan above all — can report the real cause instead of a silent
+        False; a *reachable* server whose handler errors (e.g. a
+        non-coord RPC server answering "no such method") returns False,
+        because retrying that endpoint cannot help.
+        """
         try:
             return bool(self._rpc.call("ping").get("pong"))
+        except exceptions.EdlCoordError:
+            raise
         except Exception:
             return False
 
@@ -88,17 +118,56 @@ class CoordClient(KVStore):
         self._rpc.close()
 
 
-def connect(endpoints: str | list[str], timeout: float = 30.0) -> CoordClient:
-    """Connect to the first reachable endpoint of a comma-separated list."""
+def connect(endpoints: str | list[str], timeout: float = 30.0,
+            resilient: bool = True) -> KVStore:
+    """Connect to a comma-separated endpoint list.
+
+    Returns a :class:`~edl_tpu.coord.resilient.ResilientCoordClient`
+    (retry + backoff + endpoint failover on every op) seated on the
+    first reachable endpoint — a later coordination-store restart is a
+    bounded hiccup for every subsystem that came through here, not a
+    job-killer.  ``resilient=False`` restores the old pinned
+    single-endpoint ``CoordClient`` (tests that assert raw transport
+    behavior).
+    """
     if isinstance(endpoints, str):
         endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
     last_err: Exception | None = None
-    for ep in endpoints:
+    for i, ep in enumerate(endpoints):
         client = CoordClient(ep, timeout)
+        ok = False
         try:
-            if client.ping():
-                return client
-        except Exception as e:  # pragma: no cover - defensive
+            ok = client.ping()
+        except Exception as e:
             last_err = e
+        if not ok:
+            client.close()
+            continue
+        if not resilient:
+            return client
         client.close()
+        # deferred import: resilient.py wraps CoordClient (cycle)
+        from edl_tpu.coord.resilient import ResilientCoordClient
+        # seat the resilient client on the endpoint that just answered
+        return ResilientCoordClient(list(endpoints), timeout, start_index=i)
     raise ConnectionError(f"no reachable coordination endpoint in {endpoints}: {last_err}")
+
+
+@retry.retry_until_timeout(interval=0.5, backoff=2.0, max_interval=8.0)
+def _connect_retryable(endpoints, timeout, resilient):
+    try:
+        return connect(endpoints, timeout, resilient)
+    except ConnectionError as e:
+        raise exceptions.EdlCoordError(str(e)) from e
+
+
+def connect_wait(endpoints: str | list[str], timeout: float = 30.0,
+                 resilient: bool = True, wait: float = 60.0) -> KVStore:
+    """``connect`` that tolerates the store booting (or restarting)
+    AFTER this process: retries with exponential backoff + jitter for
+    up to ``wait`` seconds before giving up — the launch-path fix for
+    jobs racing their coordination pod."""
+    try:
+        return _connect_retryable(endpoints, timeout, resilient, timeout=wait)
+    except exceptions.EdlCoordError as e:
+        raise ConnectionError(str(e)) from e

@@ -18,6 +18,7 @@ Semantics follow what the reference used from etcd3
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -41,6 +42,12 @@ class WatchEvent:
 class WaitResult:
     events: list[WatchEvent] = field(default_factory=list)
     revision: int = 0          # store revision as of this response
+    # True when ``events`` is a FULL current-state resync (all live keys
+    # under the prefix as "put"s), not an incremental delta: the caller's
+    # revision predated the bounded event log (compaction) or a server
+    # restart.  Consumers must REPLACE their view — deletes that fell out
+    # of the log are only visible as absence from the snapshot.
+    snapshot: bool = False
 
 
 class KVStore:
@@ -95,6 +102,14 @@ class KVStore:
     def close(self) -> None:
         pass
 
+    @contextlib.contextmanager
+    def scoped_deadline(self, seconds: float):
+        """Bound this thread's retry budget for ops inside the block.
+        A no-op on plain backends; the resilient client overrides it —
+        latency-sensitive callers (heartbeat beats, inline fleet
+        refresh, shutdown revokes) use it unconditionally."""
+        yield self
+
     # -- derived helpers ---------------------------------------------------
     def watch_prefix(self, prefix: str, callback: Callable[[list[WatchEvent]], None],
                      period: float = 5.0) -> "PrefixWatcher":
@@ -105,6 +120,16 @@ class KVStore:
 
 
 class PrefixWatcher(threading.Thread):
+    """Long-polls ``wait`` and feeds the callback incremental events.
+
+    Tracks the set of live keys it has reported so a **snapshot** result
+    (``WaitResult.snapshot`` — the watcher's revision fell out of the
+    bounded event log, or the store restarted) REPLACES the view instead
+    of merging: keys the watcher knew about that are absent from the
+    snapshot are surfaced as synthetic ``delete`` events, so consumers
+    never hold a phantom entry whose tombstone was compacted away.
+    """
+
     def __init__(self, store: KVStore, prefix: str, callback, period: float,
                  close_store: bool = False):
         super().__init__(daemon=True, name=f"watch:{prefix}")
@@ -114,7 +139,17 @@ class PrefixWatcher(threading.Thread):
         self._period = period
         self._close_store = close_store  # store is dedicated to this watcher
         self._halt = threading.Event()
-        _, self._revision = store.get_prefix(prefix)
+        recs, self._revision = store.get_prefix(prefix)
+        self._known: set[str] = {r.key for r in recs}
+
+    def _resync(self, events: list[WatchEvent]) -> list[WatchEvent]:
+        """Snapshot result → delta against the known view: deletes for
+        vanished keys first, then the snapshot's puts."""
+        live = {e.record.key for e in events if e.type == "put"}
+        gone = sorted(self._known - live)
+        deletes = [WatchEvent("delete", KVRecord(k, b"")) for k in gone]
+        self._known = live
+        return deletes + events
 
     def run(self):
         while not self._halt.is_set():
@@ -126,8 +161,17 @@ class PrefixWatcher(threading.Thread):
                 self._halt.wait(1.0)
                 continue
             self._revision = res.revision
-            if res.events:
-                self._callback(res.events)
+            events = res.events
+            if res.snapshot:
+                events = self._resync(events)
+            else:
+                for e in events:
+                    if e.type == "put":
+                        self._known.add(e.record.key)
+                    else:
+                        self._known.discard(e.record.key)
+            if events:
+                self._callback(events)
 
     def stop(self):
         self._halt.set()

@@ -9,6 +9,16 @@ Concurrency model: one lock + condition variable around a dict; waiters
 block on the condition and replay the bounded event log.  A background
 sweeper expires leases (and their keys) so TTL-failover tests behave
 like real etcd lease expiry.
+
+Durability (coord/wal.py): every mutation can be mirrored into a
+``journal`` (write-ahead log) while the lock is held, and a whole
+engine can be rebuilt from a restored state dict — revision counter,
+``_next_lease`` and live leases included, so a server restart neither
+resets revisions nor lets stale lease ids collide with fresh grants.
+``restart_grace`` suspends expiry sweeps after such a restore: leases
+come back with their remaining TTL frozen across the downtime, and
+holders get a window to reconnect and refresh before anything is
+mass-expired.
 """
 
 from __future__ import annotations
@@ -18,21 +28,35 @@ import time
 from collections import deque
 
 from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
 
 _EVENT_LOG_CAP = 4096
 
 
 class _Lease:
-    __slots__ = ("ttl", "expires_at", "keys")
+    __slots__ = ("ttl", "expires_at", "keys", "ka_logged", "revoking")
 
     def __init__(self, ttl: float, now: float):
         self.ttl = ttl
         self.expires_at = now + ttl
         self.keys: set[str] = set()
+        # monotonic instant of the last JOURNALED keepalive (the grant
+        # record covers the first ttl) — lets lease_keepalive coalesce
+        # ka journal records (see there for the staleness bound)
+        self.ka_logged = now
+        # a durable revoke record exists for this lease but a journal
+        # error deferred (some of) its key deletes: replay WILL drop it,
+        # so the live server must treat it as dead — keepalives refuse,
+        # puts refuse, snapshots exclude it — while the sweep retries
+        # the remaining deletes
+        self.revoking = False
 
 
 class MemoryKV(KVStore):
-    def __init__(self, sweep_period: float = 0.25):
+    def __init__(self, sweep_period: float = 0.25, journal=None,
+                 restart_grace: float = 0.0, restore: dict | None = None):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._data: dict[str, KVRecord] = {}
@@ -41,9 +65,122 @@ class MemoryKV(KVStore):
         self._next_lease = 1
         self._events: deque[tuple[int, WatchEvent]] = deque(maxlen=_EVENT_LOG_CAP)
         self._closed = False
+        self._stop_evt = threading.Event()
+        # serializes whole snapshot cycles (cut image -> write -> maybe
+        # truncate) between the sweeper and snapshot_now(): an older
+        # image landing via os.replace AFTER a newer cycle truncated the
+        # log would durably lose the acknowledged mutations in between.
+        # Ordering: _snap_mutex is always taken BEFORE _lock.
+        self._snap_mutex = threading.Lock()
+        self._journal = None  # attach AFTER restore: replay is not re-journaled
+        self._snapshot_due = False
+        self._sweep_resume_at = 0.0
+        if restore is not None:
+            self._restore_state(restore, restart_grace)
+        else:
+            # clock-seeded: an amnesiac (non-durable) restart must land
+            # its counter AHEAD of any prior watcher's position, so the
+            # wait() resync clauses fire even when re-registration churn
+            # would otherwise let a from-zero counter catch back up to a
+            # stale since_revision and deliver a truncated delta (safe
+            # while sustained mutation rate stays below 1000/s — this is
+            # a control plane, steady state is tens/s)
+            self._revision = int(time.time() * 1000)
+            # the lease counter too: re-granting from 1 would reuse a
+            # pre-restart lease_id — a holder still refreshing its stale
+            # id would keep a DIFFERENT owner's lease alive and revoke
+            # it on shutdown
+            self._next_lease = int(time.time() * 1000)
+        self._journal = journal
         self._sweeper = threading.Thread(target=self._sweep_loop, args=(sweep_period,),
                                          daemon=True, name="memkv-sweeper")
         self._sweeper.start()
+
+    # -- durability hooks ---------------------------------------------------
+    def _restore_state(self, state: dict, grace: float) -> None:
+        """Rebuild from a ``coord.wal`` state dict (constructor only, no
+        lock yet).  Leases come back with ``remaining`` TTL relative to
+        *now* — downtime does not count against them — and the sweeper
+        stays suspended for ``grace`` seconds on top, so holders can
+        reconnect and refresh before any expiry fires."""
+        now = time.monotonic()
+        self._revision = int(state.get("revision", 0))
+        self._next_lease = int(state.get("next_lease", 1))
+        for lid, ttl, remaining in state.get("leases", []):
+            lease = _Lease(float(ttl), now)
+            lease.expires_at = now + max(0.0, float(remaining))
+            self._leases[int(lid)] = lease
+        for key, value, rev, lease_id in state.get("data", []):
+            lease_id = int(lease_id)
+            if lease_id and lease_id not in self._leases:
+                # torn shutdown mid-expiry: the lease's revoke record hit
+                # the WAL but (some of) its key deletes did not.  Finish
+                # the job — WITH a revision bump, so a watcher positioned
+                # at the old head revision gets a snapshot resync instead
+                # of holding the phantom key forever (the bump count is a
+                # pure function of the replayed state, so repeated
+                # restarts from the same log stay deterministic).
+                self._revision += 1
+                continue
+            rec = KVRecord(key, value, int(rev), lease_id)
+            self._data[key] = rec
+            if lease_id:
+                self._leases[lease_id].keys.add(key)
+        self._sweep_resume_at = now + max(0.0, grace)
+
+    def _log(self, rec: dict) -> None:
+        """Journal one mutation BEFORE it is applied (lock held) — a
+        failed append propagates to the caller with the store and the
+        log still agreeing (neither has the op), instead of an applied
+        op the client was told failed and a restart would forget.  A
+        due snapshot is cut by the sweeper thread, OFF the client-op
+        path (see :meth:`_sweep_loop`)."""
+        if self._journal is None:
+            return
+        if self._journal.append(rec):
+            self._snapshot_due = True
+
+    def _snapshot_state_locked(self) -> dict:
+        now_m, now_w = time.monotonic(), time.time()
+        return {
+            "revision": self._revision,
+            "next_lease": self._next_lease,
+            "ts": now_w,
+            "data": [[r.key, r.value, r.revision, r.lease_id]
+                     for r in self._data.values()],
+            # wall-clock expiry: replay recomputes remaining TTL from
+            # it.  Revoking leases are EXCLUDED — their revoke record
+            # is durable, and a snapshot cut mid-retry would otherwise
+            # resurrect them once the log (and the revoke) is truncated;
+            # their leftover keys replay as torn-shutdown orphans and
+            # are dropped deterministically by _restore_state
+            "leases": [[lid, lease.ttl, now_w + (lease.expires_at - now_m)]
+                       for lid, lease in self._leases.items()
+                       if not lease.revoking],
+        }
+
+    def snapshot_now(self) -> None:
+        """Force a snapshot + WAL truncation (no-op without a journal).
+        Serialized with the sweeper's off-lock snapshot cycle: without
+        it, a sweeper image cut BEFORE a mutation could be replaced onto
+        disk AFTER this call truncated the log that held the mutation."""
+        with self._snap_mutex, self._lock:
+            if self._journal is not None:
+                self._journal.snapshot(self._snapshot_state_locked())
+                self._snapshot_due = False
+
+    def dump_state(self) -> dict:
+        """Canonical, time-independent image for restart-equality tests:
+        revision counter, lease table (id → ttl) and every record."""
+        with self._lock:
+            return {
+                "revision": self._revision,
+                "next_lease": self._next_lease,
+                "keys": sorted([k, r.value, r.revision, r.lease_id]
+                               for k, r in self._data.items()),
+                "leases": sorted([lid, lease.ttl]
+                                 for lid, lease in self._leases.items()),
+            }
 
     # -- internal (lock held) ----------------------------------------------
     def _bump(self) -> int:
@@ -55,10 +192,18 @@ class MemoryKV(KVStore):
         self._cond.notify_all()
 
     def _put_locked(self, key: str, value: bytes, lease_id: int) -> int:
+        lease = None
         if lease_id:
             lease = self._leases.get(lease_id)
-            if lease is None:
+            if lease is None or lease.revoking:
+                # revoking == dead: its revoke record is durable
                 raise KeyError(f"lease {lease_id} not found")
+        # ts: replay's last-alive estimate must advance on EVERY record
+        # — with ka records coalesced, a busy store's log tail can be
+        # put-only, and a stale end_ts would over-extend dead leases
+        self._log({"op": "put", "k": key, "v": value, "l": lease_id,
+                   "rev": self._revision + 1, "ts": time.time()})
+        if lease is not None:
             lease.keys.add(key)
         old = self._data.get(key)
         if old is not None and old.lease_id and old.lease_id != lease_id:
@@ -71,9 +216,12 @@ class MemoryKV(KVStore):
         return rec.revision
 
     def _delete_locked(self, key: str) -> bool:
-        rec = self._data.pop(key, None)
+        rec = self._data.get(key)
         if rec is None:
             return False
+        self._log({"op": "del", "k": key, "rev": self._revision + 1,
+                   "ts": time.time()})
+        self._data.pop(key)
         if rec.lease_id:
             lease = self._leases.get(rec.lease_id)
             if lease:
@@ -83,19 +231,79 @@ class MemoryKV(KVStore):
         return True
 
     def _expire_locked(self, now: float):
-        dead = [lid for lid, l in self._leases.items() if l.expires_at <= now]
+        if now < self._sweep_resume_at:
+            return  # post-restart grace: holders get to refresh first
+        dead = [lid for lid, l in self._leases.items()
+                if l.revoking or l.expires_at <= now]
         for lid in dead:
-            lease = self._leases.pop(lid)
-            for key in list(lease.keys):
-                self._delete_locked(key)
+            try:
+                lease = self._leases[lid]
+                if not lease.revoking:
+                    # journal the revoke ONCE; from here the lease is
+                    # dead to the living too (keepalive/put refuse) —
+                    # replay will drop it, so resurrecting it live
+                    # would diverge the store from its own log
+                    self._log({"op": "revoke", "id": lid,
+                               "ts": time.time()})
+                    lease.revoking = True
+                for key in list(lease.keys):
+                    self._delete_locked(key)
+                # pop LAST: a journal error above leaves the expired
+                # lease in the table (flagged revoking), so the next
+                # sweep retries the remaining deletes instead of
+                # orphaning keys forever
+                self._leases.pop(lid)
+            except OSError:
+                # journal hiccup: leave the remainder for the next
+                # sweep — expiry-driven deletes run on the sweeper
+                # thread and ahead of reads, so a transient disk error
+                # must neither kill the sweeper nor fail a get()
+                logger.warning("expiry sweep deferred by journal error",
+                               exc_info=True)
+                return
 
     def _sweep_loop(self, period: float):
         while True:
-            time.sleep(period)
-            with self._lock:
-                if self._closed:
-                    return
-                self._expire_locked(time.monotonic())
+            self._stop_evt.wait(period)
+            with self._snap_mutex:  # one snapshot cycle at a time
+                image = mark = journal = None
+                with self._lock:
+                    if self._closed:
+                        return
+                    self._expire_locked(time.monotonic())
+                    if self._snapshot_due and self._journal is not None:
+                        image = self._snapshot_state_locked()
+                        journal = self._journal  # close() may null the attr
+                        mark = journal.mark()
+                if image is None:
+                    continue
+                # pack + write OFF the lock: the dominant snapshot cost
+                # (serializing a whole store image to disk) must not stall
+                # concurrent client ops — heartbeat beats run on ~one-TTL
+                # scoped budgets and a gateway fleet refresh on 2 s
+                try:
+                    journal.write_snapshot(image)
+                except OSError:
+                    logger.warning("coord snapshot failed; retrying next "
+                                   "sweep", exc_info=True)
+                    continue
+                with self._lock:
+                    if self._closed or self._journal is None:
+                        return
+                    try:
+                        if journal.truncate_if_unmoved(mark):
+                            self._snapshot_due = False
+                        # else a mutation raced the off-lock write: the
+                        # snapshot on disk is still valid (replay re-applies
+                        # the log's older records onto it convergently) and
+                        # the next sweep cuts a fresher one
+                    except OSError:
+                        # log intact + snapshot written: replay onto the own
+                        # snapshot is tolerated, so don't hot-loop a sick disk
+                        self._snapshot_due = False
+                        logger.warning("wal truncation failed; replay will "
+                                       "converge onto the snapshot",
+                                       exc_info=True)
 
     # -- kv ----------------------------------------------------------------
     def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
@@ -132,25 +340,57 @@ class MemoryKV(KVStore):
     def lease_grant(self, ttl: float) -> int:
         with self._lock:
             lid = self._next_lease
+            self._log({"op": "grant", "id": lid, "ttl": ttl, "ts": time.time()})
             self._next_lease += 1
             self._leases[lid] = _Lease(ttl, time.monotonic())
             return lid
 
     def lease_keepalive(self, lease_id: int) -> bool:
         with self._lock:
-            self._expire_locked(time.monotonic())
+            now = time.monotonic()
+            self._expire_locked(now)
             lease = self._leases.get(lease_id)
-            if lease is None:
+            if lease is None or lease.revoking:
+                # revoking: the revoke record is already durable —
+                # replay will drop this lease, so the live server must
+                # not extend it (the holder re-grants, which IS journaled)
                 return False
-            lease.expires_at = time.monotonic() + lease.ttl
+            lease.expires_at = now + lease.ttl
+            # coalesce: the hottest steady-state op must not pay one
+            # journal append (flush + possible fsync) per beat.  The
+            # threshold sits ABOVE the clients' refresh period
+            # (ttl * TTL_REFRESH_FRACTION = ttl/2), so in-tree sessions
+            # journal every OTHER beat — replayed remaining TTL stale
+            # by at most ~one ttl, covered by the restart grace
+            # (default = one full TTL) plus the frozen-downtime rule
+            if now - lease.ka_logged >= lease.ttl * 0.6:
+                try:
+                    self._log({"op": "ka", "id": lease_id, "ts": time.time()})
+                    lease.ka_logged = now
+                except OSError:
+                    # a lost ka record only costs replay a slightly staler
+                    # remaining TTL (covered by the restart grace), so a sick
+                    # disk must not fail keepalives for healthy holders — same
+                    # tolerance as the expiry sweep above
+                    logger.warning("keepalive journal append deferred by "
+                                   "journal error", exc_info=True)
             return True
 
     def lease_revoke(self, lease_id: int) -> None:
         with self._lock:
-            lease = self._leases.pop(lease_id, None)
+            lease = self._leases.get(lease_id)
             if lease:
+                if not lease.revoking:
+                    self._log({"op": "revoke", "id": lease_id,
+                               "ts": time.time()})
+                    lease.revoking = True
                 for key in list(lease.keys):
                     self._delete_locked(key)
+                # pop LAST (see _expire_locked): a journal error mid-
+                # delete propagates with the lease intact, so a client
+                # retry re-runs the remaining deletes instead of
+                # no-opping on a half-revoked lease
+                self._leases.pop(lease_id)
 
     # -- transactions ------------------------------------------------------
     def put_if_absent(self, key: str, value: bytes, lease_id: int = 0) -> bool:
@@ -179,13 +419,22 @@ class MemoryKV(KVStore):
         with self._lock:
             while True:
                 self._expire_locked(time.monotonic())
-                if (self._events and since_revision < self._events[0][0] - 1
-                        and since_revision < self._revision):
+                if (since_revision > self._revision
+                        or (since_revision < self._revision
+                            and (not self._events
+                                 or since_revision < self._events[0][0] - 1))):
                     # caller's revision predates the bounded event log
-                    # (compaction): fall back to a full snapshot-as-puts
+                    # (compaction, or a restart emptied it) OR exceeds
+                    # the store's (an amnesiac restart REWOUND the
+                    # counter — the position is from a previous life):
+                    # fall back to a full current-state resync.  Marked
+                    # snapshot=True — deletes whose tombstones fell out
+                    # of the log are only visible as ABSENCE from this
+                    # set, so watchers must replace (not merge) their
+                    # view.
                     recs = [r for k, r in self._data.items() if k.startswith(prefix)]
                     return WaitResult([WatchEvent("put", r) for r in sorted(recs, key=lambda r: r.key)],
-                                      self._revision)
+                                      self._revision, snapshot=True)
                 evs = [e for rev, e in self._events
                        if rev > since_revision and e.record.key.startswith(prefix)]
                 if evs:
@@ -198,3 +447,15 @@ class MemoryKV(KVStore):
     def close(self) -> None:
         with self._lock:
             self._closed = True
+            journal, self._journal = self._journal, None
+        # join the sweeper so no off-lock write_snapshot is in flight
+        # once close() returns: a successor opened on the same data_dir
+        # may truncate the log, and a straggler snapshot landing AFTER
+        # that would rewind the store to the stale image.  The journal
+        # (and its data_dir flock) closes only after the join, so the
+        # successor cannot acquire the dir while a write is in flight.
+        self._stop_evt.set()
+        if threading.current_thread() is not self._sweeper:
+            self._sweeper.join(timeout=10.0)
+        if journal is not None:
+            journal.close()

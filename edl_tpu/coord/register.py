@@ -6,19 +6,20 @@ python/edl/utils/register.py): a server advertises itself under
 refreshes the lease at ttl/2; if the lease is lost (store restart,
 partition) it re-registers, giving up after a retry budget; optional
 liveness gating probes the advertised endpoint before registering.
+
+``Register`` is now a ONE-KEY facade over
+:class:`~edl_tpu.coord.session.CoordSession`, which owns the lease
+lifecycle (keepalive, re-grant after loss, idempotent re-put of deleted
+keys) for any number of keys — components with several adverts can
+share one session/lease directly and every advert rides the same
+self-healing loop.
 """
 
 from __future__ import annotations
 
-import threading
-import time
-
 from edl_tpu.coord.kv import KVStore
+from edl_tpu.coord.session import CoordSession
 from edl_tpu.utils import constants
-from edl_tpu.utils.exceptions import EdlRegisterError
-from edl_tpu.utils.logger import get_logger
-
-logger = get_logger(__name__)
 
 
 def service_key(root: str, service: str, name: str) -> str:
@@ -28,108 +29,47 @@ def service_key(root: str, service: str, name: str) -> str:
 class Register:
     """Keep ``key=value`` alive in the store until ``stop()``.
 
-    ``on_lost`` (optional) fires if re-registration exhausts its budget —
-    the launcher uses this to fail the pod (reference launcher.py:205-213
-    checks ``is_stopped`` on its registers each supervisor tick).
+    ``max_reregister`` bounds consecutive *transport* failures before
+    the registration gives up — the launcher checks ``is_stopped`` on
+    its registers each supervisor tick and fails the pod (reference
+    launcher.py:205-213).  Lease loss itself (a blip longer than one
+    TTL) is healed in place for plain adverts: the session re-grants
+    and re-puts; exclusive seats stop instead (leader re-contends).
     """
 
     def __init__(self, store: KVStore, key: str, value: bytes,
                  ttl: float = constants.ETCD_TTL, max_reregister: int = 45,
                  exclusive: bool = False):
-        self._store = store
         self._key = key
-        self._value = value
-        self._ttl = ttl
-        self._max_reregister = max_reregister
-        self._exclusive = exclusive
-        self._stop = threading.Event()
-        self._stopped_with_error: Exception | None = None
-        self._lease_id = self._acquire()
-        self._thread = threading.Thread(target=self._heartbeat, daemon=True,
-                                        name=f"register:{key}")
-        self._thread.start()
+        # initial= seizes the key BEFORE the heartbeat thread starts:
+        # a failed exclusive seize (every follower's election probe)
+        # costs the grant/put/revoke round trips only, not a thread
+        # spawn + join per attempt
+        self._session = CoordSession(store, ttl=ttl,
+                                     max_failures=max_reregister, name=key,
+                                     initial=(key, value, exclusive))
 
-    def _acquire(self) -> int:
-        lease_id = self._store.lease_grant(self._ttl)
-        if self._exclusive:
-            if not self._store.put_if_absent(self._key, self._value, lease_id):
-                self._store.lease_revoke(lease_id)
-                raise EdlRegisterError(f"key {self._key} already held")
-        else:
-            self._store.put(self._key, self._value, lease_id)
-        return lease_id
-
-    def _heartbeat(self):
-        period = self._ttl * constants.TTL_REFRESH_FRACTION
-        failures = 0
-        while not self._stop.wait(period):
-            try:
-                if self._store.lease_keepalive(self._lease_id):
-                    failures = 0
-                    # the lease is alive but the key may have been deleted
-                    # out from under us (e.g. a table sweep); self-heal like
-                    # the reference's transient-death re-register
-                    # (register.py:59-76)
-                    if self._store.get(self._key) is None:
-                        if self._exclusive:
-                            self._stopped_with_error = EdlRegisterError(
-                                f"exclusive key {self._key}: deleted")
-                            self._stop.set()
-                            return
-                        self._store.put(self._key, self._value, self._lease_id)
-                        logger.info("re-put deleted key %s", self._key)
-                    continue
-                if self._exclusive:
-                    # an exclusive seat whose lease lapsed may already belong
-                    # to someone else; a silent re-seize here would bypass the
-                    # owner's on-lose/on-become lifecycle (leader election), so
-                    # stop immediately and let the owner re-contend
-                    self._stopped_with_error = EdlRegisterError(
-                        f"exclusive key {self._key}: lease lost")
-                    self._stop.set()
-                    return
-                # plain advert: try a fresh registration
-                self._lease_id = self._acquire()
-                failures = 0
-                logger.info("re-registered %s after lost lease", self._key)
-            except EdlRegisterError as e:
-                self._stopped_with_error = e
-                self._stop.set()
-                return
-            except Exception as e:  # noqa: BLE001
-                failures += 1
-                logger.warning("heartbeat for %s failed (%d/%d): %s",
-                               self._key, failures, self._max_reregister, e)
-                if failures >= self._max_reregister:
-                    self._stopped_with_error = EdlRegisterError(
-                        f"lost registration {self._key}: {e}")
-                    self._stop.set()
-                    return
+    @property
+    def _lease_id(self) -> int:
+        # historical surface: TTL-failover tests revoke it directly
+        return self._session.lease_id
 
     def update(self, value: bytes) -> None:
-        self._value = value
-        self._store.put(self._key, value, self._lease_id)
+        self._session.update(self._key, value)
 
     @property
     def is_stopped(self) -> bool:
-        return self._stop.is_set()
+        return self._session.is_stopped
 
     @property
     def error(self) -> Exception | None:
-        return self._stopped_with_error
+        return self._session.error
 
     def stop(self, revoke: bool = True) -> None:
-        self._stop.set()
-        self._thread.join(timeout=5.0)
-        if revoke:
-            try:
-                self._store.lease_revoke(self._lease_id)
-            except Exception:  # noqa: BLE001 — best effort on shutdown
-                pass
+        self._session.close(revoke=revoke)
 
     def stop_heartbeat_only(self) -> None:
         """Test hook: stop refreshing but keep the lease until TTL expiry
         (how the reference's leader-failover test kills a leader,
         test_leader_pod.py:45-60)."""
-        self._stop.set()
-        self._thread.join(timeout=5.0)
+        self._session.abandon()

@@ -1,0 +1,311 @@
+"""Self-healing coordination client: retry, backoff, endpoint failover.
+
+``CoordClient`` is one TCP client pinned to one endpoint: a transport
+error surfaces immediately and a dead endpoint stays dead.  The
+reference survived coordination blips by leaning on etcd's
+multi-endpoint client with built-in retry; this wrapper is that layer
+for our store:
+
+- every op retries ``EdlCoordError`` (transport failures, including
+  injected ones — utils/faultinject.py) with exponential backoff +
+  full jitter under a total **deadline budget**, so a coord restart is
+  a bounded hiccup instead of an instant exception;
+- repeated transport errors **fail over** to the next endpoint of the
+  list (single-endpoint lists simply reconnect — the per-endpoint
+  ``CoordClient`` redials lazily).  Failover is deliberately sticky:
+  the in-tree servers are independent stores, not a replicated quorum,
+  so switching endpoints abandons the state registered on the old one
+  (sessions re-register, plain records do not) — one dropped packet
+  must not flip a whole process's world view, only an endpoint that
+  stays dead across ``FAILOVER_AFTER`` consecutive errors does;
+- handler-raised typed errors (``EdlRegisterError`` etc.) propagate
+  immediately: the server answered, retrying would not change its mind;
+- ``edl_coord_retries_total{op}`` / ``edl_coord_failovers_total``
+  expose the blip history per process.
+
+Latency-sensitive callers (trainer heartbeats) scope the budget down::
+
+    with store.scoped_deadline(5.0):
+        store.put(key, value)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.coord.kv import KVStore, WaitResult, WatchEvent
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlCoordError
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_RETRIES = obs_metrics.counter(
+    "edl_coord_retries_total",
+    "Coordination ops retried after a transport error, by op", ("op",))
+_FAILOVERS = obs_metrics.counter(
+    "edl_coord_failovers_total",
+    "Coordination client switches to another endpoint after a transport "
+    "error")
+
+
+class ResilientCoordClient(KVStore):
+    # consecutive transport errors on the CURRENT endpoint before the
+    # client abandons it for the next one (see module docstring: the
+    # endpoints are independent stores, so flapping between them on a
+    # single blip would strand registered state)
+    FAILOVER_AFTER = 3
+
+    def __init__(self, endpoints: str | list[str], timeout: float = 30.0,
+                 retry_deadline: float | None = None,
+                 backoff_init: float | None = None,
+                 backoff_max: float | None = None,
+                 start_index: int = 0):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+        if not endpoints:
+            raise ValueError("no coordination endpoints given")
+        self.endpoints = list(endpoints)
+        self._timeout = timeout
+        self._start_index = int(start_index) % len(self.endpoints)
+        self._deadline = (constants.COORD_RETRY_DEADLINE
+                          if retry_deadline is None else retry_deadline)
+        self._backoff_init = (constants.COORD_BACKOFF_INIT
+                              if backoff_init is None else backoff_init)
+        self._backoff_max = (constants.COORD_BACKOFF_MAX
+                             if backoff_max is None else backoff_max)
+        self._lock = threading.Lock()
+        self._clients: dict[str, CoordClient] = {}
+        self._cur = self._start_index  # seat on the caller-verified endpoint
+        self._cur_errors = 0  # consecutive transport errors on _cur
+        self._closed = False
+        self._local = threading.local()  # scoped deadline override
+        self._rng = random.Random()
+        # endpoint that answered the last wait() per prefix: a wait
+        # answered by a DIFFERENT (independent) store forces a snapshot
+        # resync — its revisions are unrelated to the watch position
+        self._wait_eps: dict[str, str] = {}
+
+    # -- endpoint management ------------------------------------------------
+    @property
+    def endpoint(self) -> str:
+        """The endpoint currently preferred (diagnostics only)."""
+        with self._lock:
+            return self.endpoints[self._cur]
+
+    def _client(self) -> CoordClient:
+        with self._lock:
+            if self._closed:
+                raise EdlCoordError("resilient coord client is closed")
+            ep = self.endpoints[self._cur]
+            client = self._clients.get(ep)
+            if client is None:
+                client = self._clients[ep] = CoordClient(ep, self._timeout)
+            return client
+
+    def _note_ok(self) -> None:
+        with self._lock:
+            self._cur_errors = 0
+
+    def _fail_over(self, from_ep: str) -> None:
+        with self._lock:
+            if self.endpoints[self._cur] != from_ep:
+                return  # another thread already moved on
+            self._cur_errors += 1
+            if (len(self.endpoints) > 1
+                    and self._cur_errors >= self.FAILOVER_AFTER):
+                self._cur = (self._cur + 1) % len(self.endpoints)
+                self._cur_errors = 0
+                _FAILOVERS.inc()
+                logger.warning("coord failover %s -> %s", from_ep,
+                               self.endpoints[self._cur])
+
+    @contextlib.contextmanager
+    def scoped_deadline(self, seconds: float):
+        """Bound the TOTAL retry budget of every op issued on THIS
+        THREAD inside the block to one shared absolute deadline — a
+        heartbeat beat issuing keepalive + k heal ops must finish (or
+        fail) within ~one TTL overall, not one TTL *per op* (which
+        would hold the session's _op_lock for k·TTL during a blip and
+        let the very lease the scope protects expire)."""
+        prev = getattr(self._local, "deadline_at", None)
+        self._local.deadline_at = time.monotonic() + seconds
+        try:
+            yield self
+        finally:
+            self._local.deadline_at = prev
+
+    # -- the retry loop -----------------------------------------------------
+    def _invoke(self, op: str, *args, _budget: float | None = None,
+                _served: list | None = None, **kwargs):
+        deadline = getattr(self._local, "deadline_at", None)
+        if deadline is None:
+            budget = self._deadline if _budget is None else _budget
+            deadline = time.monotonic() + budget
+        else:
+            budget = max(0.0, deadline - time.monotonic())
+        delay = self._backoff_init
+        # bound the in-flight RPC by the remaining budget too: a HUNG
+        # endpoint (accepted connection, no answer) must not stall a
+        # scoped caller for the full transport timeout.  Long-polls are
+        # exempt — wait() carries its own server-side timeout and a
+        # matching transport allowance.  With standby endpoints the
+        # remaining budget is further split so FAILOVER_AFTER hung
+        # attempts still leave room to actually try a standby: a
+        # blackholed (not refused) endpoint would otherwise eat the
+        # whole budget in one attempt and the healthy standby would
+        # never be reached within the op.
+        cap = op != "wait"
+        split = (self.FAILOVER_AFTER + 1) if len(self.endpoints) > 1 else 1
+        while True:
+            client = self._client()
+            try:
+                if cap:
+                    remaining = deadline - time.monotonic()
+                    kwargs["_timeout"] = max(0.25, min(self._timeout,
+                                                       remaining / split))
+                result = getattr(client, op)(*args, **kwargs)
+                self._note_ok()
+                if _served is not None:
+                    _served.append(client.endpoint)
+                return result
+            except EdlCoordError as e:
+                _RETRIES.labels(op=op).inc()
+                self._fail_over(client.endpoint)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise EdlCoordError(
+                        f"coord op {op} failed after retry budget "
+                        f"({budget:.1f}s) across {self.endpoints}: {e}") from e
+                # full jitter: spread synchronized retries from a whole
+                # job's processes across the window
+                time.sleep(min(self._rng.uniform(0, delay), remaining))
+                delay = min(delay * 2, self._backoff_max)
+
+    # -- KVStore surface ----------------------------------------------------
+    def put(self, key, value, lease_id=0):
+        return self._invoke("put", key, value, lease_id)
+
+    def get(self, key):
+        return self._invoke("get", key)
+
+    def get_prefix(self, prefix):
+        served: list[str] = []
+        res = self._invoke("get_prefix", prefix, _served=served)
+        # remember which (independent) store answered: a PrefixWatcher
+        # baselines its view here, so a later wait() served by a
+        # different endpoint knows the position is foreign (see wait)
+        with self._lock:
+            self._wait_eps[prefix] = served[0]
+        return res
+
+    def delete(self, key):
+        return self._invoke("delete", key)
+
+    def delete_prefix(self, prefix):
+        return self._invoke("delete_prefix", prefix)
+
+    def lease_grant(self, ttl):
+        return self._invoke("lease_grant", ttl)
+
+    def lease_keepalive(self, lease_id):
+        return self._invoke("lease_keepalive", lease_id)
+
+    def lease_revoke(self, lease_id):
+        return self._invoke("lease_revoke", lease_id)
+
+    # CAS retries are safe against the applied-but-response-lost race by
+    # the store's own semantics: put_if_absent also succeeds when the
+    # key already holds the SAME value under the SAME live lease (the
+    # idempotent re-seize, kv.py) — so a winning elector whose response
+    # vanished in a crash re-asserts and still sees True after a durable
+    # restart; put_if_equals re-checks the guard, and a guard that
+    # changed in between means False is the *correct* answer.
+    def put_if_absent(self, key, value, lease_id=0):
+        return self._invoke("put_if_absent", key, value, lease_id)
+
+    def put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
+        return self._invoke("put_if_equals", guard_key, guard_value, key,
+                            value, lease_id)
+
+    def dump_state(self):
+        return self._invoke("dump_state")
+
+    def wait(self, prefix, since_revision, timeout):
+        # a long-poll's retry budget is its own timeout (plus slack):
+        # watchers re-issue waits in a loop anyway, so burning the full
+        # op budget here would only delay their reconnect logic
+        served: list[str] = []
+        res = self._invoke("wait", prefix, since_revision, timeout,
+                           _budget=max(float(timeout), 1.0), _served=served)
+        with self._lock:
+            prev = self._wait_eps.get(prefix)
+        if (res.snapshot or prev == served[0]
+                or (prev is None and since_revision == 0)):
+            # trustworthy: already a full image, the same store as the
+            # watch position, or a fresh watch with no prior view
+            with self._lock:
+                self._wait_eps[prefix] = served[0]
+            return res
+        # failover moved this watch to a DIFFERENT endpoint — an
+        # independent store, so ``since_revision`` (and any delta it
+        # returned) is against unrelated revisions: phantom keys from
+        # the old store would survive and the new store's existing keys
+        # would never be delivered.  Synthesize a full snapshot resync
+        # so PrefixWatcher replaces its view.  get_prefix commits
+        # ``_wait_eps`` only when it succeeds, so a failed resync is
+        # retried on the next wait instead of silently skipped forever.
+        recs, rev = self.get_prefix(prefix)
+        return WaitResult([WatchEvent("put", r)
+                           for r in sorted(recs, key=lambda r: r.key)],
+                          rev, snapshot=True)
+
+    def ping(self) -> bool:
+        """True if ANY endpoint answers a ping right now (no retries)."""
+        last_err: Exception | None = None
+        for ep in list(self.endpoints):
+            with self._lock:
+                if self._closed:
+                    return False  # never resurrect clients after close()
+                client = self._clients.get(ep)
+                if client is None:
+                    client = self._clients[ep] = CoordClient(ep, self._timeout)
+            try:
+                if client.ping():
+                    return True
+            except Exception as e:  # noqa: BLE001 — probing, not failing
+                last_err = e
+        if last_err is not None:
+            logger.debug("ping failed on all endpoints: %s", last_err)
+        return False
+
+    def watch_prefix(self, prefix, callback, period: float = 5.0):
+        """Callback watch over a DEDICATED resilient client (long-polls
+        must not head-of-line-block regular ops)."""
+        from edl_tpu.coord.kv import PrefixWatcher
+        with self._lock:
+            cur = self._cur
+        dedicated = ResilientCoordClient(
+            self.endpoints, self._timeout, retry_deadline=self._deadline,
+            backoff_init=self._backoff_init, backoff_max=self._backoff_max,
+            start_index=cur)
+        try:
+            w = PrefixWatcher(dedicated, prefix, callback, period,
+                              close_store=True)
+        except BaseException:
+            dedicated.close()
+            raise
+        w.start()
+        return w
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            clients, self._clients = list(self._clients.values()), {}
+        for client in clients:
+            client.close()

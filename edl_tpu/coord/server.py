@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import time
 
 from edl_tpu.coord.memory import MemoryKV
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import constants
 from edl_tpu.utils.logger import configure, get_logger
 
 logger = get_logger(__name__)
@@ -101,29 +103,95 @@ class CoordService:
     def wait(self, prefix, since_revision, timeout):
         res = self._kv.wait(prefix, since_revision, min(float(timeout), 60.0))
         return {"events": [[e.type, _rec_to_wire(e.record)] for e in res.events],
-                "rev": res.revision}
+                "rev": res.revision, "snap": res.snapshot}
 
     @_timed
     def ping(self):
         return {"pong": True}
 
+    @_timed
+    def dump_state(self):
+        """Debug/chaos surface: the canonical time-independent state
+        image (revision counter, lease table, every record) — the chaos
+        smoke asserts a WAL-backed restart reproduces it bit-exactly."""
+        return {"state": self._kv.dump_state()}
 
-def start_server(host: str = "0.0.0.0", port: int = 0, kv: MemoryKV | None = None) -> RpcServer:
+
+def start_server(host: str = "0.0.0.0", port: int = 0,
+                 kv: MemoryKV | None = None,
+                 data_dir: str | None = None,
+                 restart_grace: float | None = None) -> RpcServer:
+    """Boot the RPC server; ``data_dir`` (or ``EDL_TPU_COORD_DATA_DIR``)
+    makes the store durable: WAL + snapshot, replayed on restart."""
+    if kv is None:
+        data_dir = constants.COORD_DATA_DIR if data_dir is None else data_dir
+        if data_dir:
+            from edl_tpu.coord.wal import open_durable
+            kv = open_durable(data_dir, restart_grace=restart_grace)
+        else:
+            kv = MemoryKV()
     server = RpcServer(host, port)
-    server.register_instance(CoordService(kv or MemoryKV()))
+    server.register_instance(CoordService(kv))
+    server.kv = kv  # owner handle: in-process restarts close the WAL
     return server.start()
+
+
+def spawn_subprocess(port: int, data_dir: str,
+                     restart_grace: float | None = None,
+                     host: str = "127.0.0.1", env: dict | None = None):
+    """Spawn ``python -m edl_tpu.coord.server`` as a subprocess — the
+    SIGKILL-able real thing the chaos smoke and the coord-outage bench
+    both drill (one spawner, so they measure the same setup)."""
+    import subprocess
+    import sys
+
+    argv = [sys.executable, "-m", "edl_tpu.coord.server", "--host", host,
+            "--port", str(port), "--data_dir", data_dir]
+    if restart_grace is not None:
+        argv += ["--restart_grace", str(restart_grace)]
+    return subprocess.Popen(argv, env=env or dict(os.environ),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+
+
+def wait_ready(endpoint: str, deadline_s: float = 120.0) -> float:
+    """Block until ``endpoint`` answers a coordination ping; returns the
+    seconds waited (the restart-MTTR building block)."""
+    from edl_tpu.coord.client import CoordClient
+
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+    while time.monotonic() < deadline:
+        probe = CoordClient(endpoint, timeout=1.0)
+        try:
+            if probe.ping():
+                return time.monotonic() - t0
+        except Exception:  # noqa: BLE001 — still booting
+            pass
+        finally:
+            probe.close()
+        time.sleep(0.05)
+    raise TimeoutError(f"coord server at {endpoint} never answered")
 
 
 def main():
     parser = argparse.ArgumentParser("edl_tpu coordination server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument("--data_dir", default=constants.COORD_DATA_DIR,
+                        help="WAL + snapshot directory; empty = in-memory "
+                             "only (a restart loses all state)")
+    parser.add_argument("--restart_grace", type=float, default=None,
+                        help="seconds to suspend expiry sweeps after a "
+                             "WAL-backed restart (-1/unset = one TTL)")
     args = parser.parse_args()
     configure()
     from edl_tpu import obs
     obs.install_from_env("coord")  # /metrics + JSONL trace, env-gated
-    server = start_server(args.host, args.port)
-    logger.info("coordination server listening on %s", server.endpoint)
+    server = start_server(args.host, args.port, data_dir=args.data_dir,
+                          restart_grace=args.restart_grace)
+    logger.info("coordination server listening on %s%s", server.endpoint,
+                f" (durable: {args.data_dir})" if args.data_dir else "")
     try:
         import threading
         threading.Event().wait()

@@ -1,0 +1,365 @@
+"""Write-ahead log + snapshot durability for the coordination store.
+
+The reference leaned on etcd's raft-backed disk state: a coord restart
+there loses nothing.  Our in-tree server was a pure in-memory process —
+a restart reset the revision counter and the lease counter to 1 (stale
+``lease_id``s from before the restart could collide with fresh grants)
+and mass-expired every advert in the job.  This module closes that gap
+for the Python server:
+
+- every mutation MemoryKV applies is mirrored here as one appended
+  record (``put``/``del``/``grant``/``ka``/``revoke``), written while
+  the KV lock is held so the log order IS the apply order;
+- every ``snapshot_every`` records a full point-in-time snapshot is cut
+  and the log truncated, bounding replay time — on the MemoryKV sweeper
+  thread, with the serialize + write OFF the KV lock so it never stalls
+  a client op;
+- :func:`load_state` rebuilds the exact engine state — keys, revision
+  counter, ``_next_lease``, live leases with their remaining TTL frozen
+  across the downtime (remaining is measured against the LAST record's
+  wall timestamp, i.e. the moment the server died, not the moment it
+  came back).
+
+File layout under ``data_dir``::
+
+    snapshot.bin   msgpack state dict (written tmp + rename, atomic)
+    wal.log        [u32 len | u32 crc32 | msgpack record]*
+
+Appends are flushed to the OS per record (a SIGKILL loses nothing; only
+power loss can — ``EDL_TPU_COORD_FSYNC=1`` upgrades to fsync per
+record).  Replay stops at the first short or corrupt record and
+truncates the torn tail, so a crash mid-append never poisons the log.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import struct
+import zlib
+
+import msgpack
+
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_REC_HEADER = struct.Struct(">II")  # length, crc32(body)
+SNAPSHOT = "snapshot.bin"
+WAL = "wal.log"
+
+
+class Wal:
+    """Append-only journal attached to a MemoryKV (its ``journal=``).
+
+    Not internally locked: MemoryKV calls ``append``/``snapshot``/
+    ``mark``/``truncate_if_unmoved`` while holding its own lock, which
+    is the ordering guarantee; only ``write_snapshot`` (touching just
+    the snapshot file) may run off the lock, concurrent with appends.
+    """
+
+    def __init__(self, data_dir: str,
+                 snapshot_every: int | None = None,
+                 fsync: bool | None = None,
+                 known_count: int | None = None):
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        # exclusivity: two instances appending to one wal.log from
+        # independent 'ab' handles interleave records (CRC framing
+        # overlaps) and clobber each other's snapshot.bin — replay then
+        # truncates at the first corrupt record and silently discards
+        # everything after it.  flock makes the misconfiguration (two
+        # servers sharing EDL_TPU_COORD_DATA_DIR) loud at startup; the
+        # kernel drops the lock on process death, so SIGKILL + restart
+        # needs no cleanup.
+        self._lock_f = open(os.path.join(data_dir, "lock"), "w")
+        try:
+            fcntl.flock(self._lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_f.close()
+            raise RuntimeError(
+                f"coord data_dir {data_dir!r} is already locked by a "
+                "running server instance; every coord server needs its "
+                "own data_dir") from None
+        self._wal_path = os.path.join(data_dir, WAL)
+        self._snap_path = os.path.join(data_dir, SNAPSHOT)
+        self._snapshot_every = int(snapshot_every
+                                   if snapshot_every is not None
+                                   else constants.COORD_SNAPSHOT_EVERY)
+        self._fsync = (bool(int(os.environ.get("EDL_TPU_COORD_FSYNC", "0")))
+                       if fsync is None else fsync)
+        # count (and torn-tail-truncate) BEFORE opening the append handle;
+        # a caller that just replayed the log (open_durable) passes the
+        # count through so the file is not read twice per restart
+        self._count = (self._count_existing() if known_count is None
+                       else known_count)
+        # offset the log must be cut back to before the next append —
+        # set when a disk error interrupted a repair or truncation, so
+        # the heal happens once the disk returns (None = log is clean)
+        self._repair_to: int | None = None
+        self._f = open(self._wal_path, "ab")  # None while a disk error persists
+
+    def _count_existing(self) -> int:
+        try:
+            return sum(1 for _ in iter_records(self._wal_path))
+        except OSError:
+            return 0
+
+    def append(self, rec: dict) -> bool:
+        """Write one record; returns True when a snapshot is due.
+
+        A failed append (ENOSPC, EIO) must not leave torn bytes in the
+        middle of the log — replay stops at the first corrupt record,
+        so torn bytes would silently discard every LATER record.  On
+        failure the file is truncated back to the pre-record offset
+        (the log stays a clean prefix) and the error propagates to the
+        mutating caller."""
+        body = msgpack.packb(rec, use_bin_type=True)
+        if self._f is None:
+            self._reopen()  # prior disk error lost the handle: self-heal
+        start = self._f.tell()
+        try:
+            self._f.write(_REC_HEADER.pack(len(body), zlib.crc32(body)))
+            self._f.write(body)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+        except OSError:
+            # the BufferedWriter may still hold part of the record; a
+            # later successful flush would land those torn bytes
+            # mid-log.  Drop the handle (its close-flush may fail again
+            # or land garbage — both cured by the truncate), cut the
+            # file back to the pre-record offset, and reopen with an
+            # empty buffer.  If the repair itself fails, _repair_to
+            # makes the next append finish it before writing.
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+            self._repair_to = start
+            try:
+                self._reopen()
+            except OSError:  # pragma: no cover - disk truly gone
+                logger.exception("wal %s: could not repair torn tail; "
+                                 "deferred to next append", self._wal_path)
+            raise
+        self._count += 1
+        return self._snapshot_every > 0 and self._count >= self._snapshot_every
+
+    def _reopen(self) -> None:
+        """Re-establish the append handle, completing any truncation a
+        disk error interrupted first so torn bytes never precede a new
+        record."""
+        if self._repair_to is not None:
+            with open(self._wal_path, "r+b") as g:
+                g.truncate(self._repair_to)
+            self._repair_to = None
+        self._f = open(self._wal_path, "ab")
+
+    def snapshot(self, state: dict) -> None:
+        """Atomically persist ``state`` and truncate the log: the
+        snapshot alone now reproduces everything up to this point.
+        The synchronous form for callers holding the MemoryKV lock with
+        a known-quiescent log (``snapshot_now``/``open_durable``); the
+        sweeper's off-lock path uses :meth:`write_snapshot` +
+        :meth:`truncate_if_unmoved` instead."""
+        self.write_snapshot(state)
+        self._truncate_log()
+
+    def write_snapshot(self, state: dict) -> None:
+        """Serialize + atomically persist ``state`` WITHOUT touching the
+        log — safe to call off the KV lock while appends continue:
+        replay tolerates a snapshot plus a log whose older records it
+        supersedes (they re-apply convergently).  fsync — the dominant
+        cost, a full disk flush — follows the same policy as appends
+        (SIGKILL loses nothing either way because the OS holds both the
+        rename and the dirty pages; only power loss needs
+        ``EDL_TPU_COORD_FSYNC=1``)."""
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(state, use_bin_type=True))
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+
+    def mark(self) -> int:
+        """Append-count cursor for :meth:`truncate_if_unmoved` (read
+        under the KV lock when cutting a snapshot image)."""
+        return self._count
+
+    def truncate_if_unmoved(self, mark: int) -> bool:
+        """Cut the log IFF nothing was appended since ``mark`` — the
+        caller holds the KV lock, so no append can race the cut.  A
+        moved log is left whole (the just-written snapshot plus the
+        intact log still replays correctly) and the next snapshot
+        retries; returns whether the cut happened."""
+        if self._count != mark:
+            return False
+        self._truncate_log()
+        return True
+
+    def _truncate_log(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:  # pragma: no cover - appends flush per record
+                pass
+        self._f = None
+        # the snapshot now supersedes the whole log; if the truncating
+        # reopen fails, the next append heals via _repair_to (replaying
+        # the stale log onto its own snapshot is tolerated, but a clean
+        # cut avoids it)
+        self._repair_to = 0
+        self._f = open(self._wal_path, "wb")
+        self._repair_to = None
+        self._count = 0
+
+    def close(self) -> None:
+        try:
+            if self._f is not None:
+                self._f.close()
+        except OSError:
+            pass
+        try:
+            self._lock_f.close()  # releases the flock
+        except OSError:
+            pass
+
+
+def iter_records(wal_path: str):
+    """Yield WAL records in order; stops (and truncates) at the first
+    torn or corrupt tail record."""
+    if not os.path.exists(wal_path):
+        return
+    good_end = 0
+    with open(wal_path, "rb") as f:
+        while True:
+            header = f.read(_REC_HEADER.size)
+            if len(header) < _REC_HEADER.size:
+                break
+            length, crc = _REC_HEADER.unpack(header)
+            body = f.read(length)
+            if len(body) < length or zlib.crc32(body) != crc:
+                logger.warning("wal %s: torn record at byte %d, truncating",
+                               wal_path, good_end)
+                break
+            good_end += _REC_HEADER.size + length
+            yield msgpack.unpackb(body, raw=False)
+    if good_end < os.path.getsize(wal_path):
+        with open(wal_path, "r+b") as f:
+            f.truncate(good_end)
+
+
+def load_state(data_dir: str) -> dict | None:
+    """Snapshot + WAL replay → a MemoryKV ``restore=`` state dict, or
+    None when the directory holds no prior state (fresh start).
+
+    Lease remaining-TTL is computed against ``end_ts`` — the wall time
+    of the last durable record, i.e. the newest instant the server is
+    known to have been alive — so downtime is frozen, not counted.
+    """
+    snap_path = os.path.join(data_dir, SNAPSHOT)
+    wal_path = os.path.join(data_dir, WAL)
+    if not os.path.exists(snap_path) and not os.path.exists(wal_path):
+        return None
+
+    revision, next_lease = 0, 1
+    data: dict[str, list] = {}           # key -> [key, value, rev, lease_id]
+    leases: dict[int, list] = {}         # lid -> [ttl, exp_wall]
+    end_ts = 0.0
+
+    if os.path.exists(snap_path):
+        with open(snap_path, "rb") as f:
+            snap = msgpack.unpackb(f.read(), raw=False)
+        revision = int(snap.get("revision", 0))
+        next_lease = int(snap.get("next_lease", 1))
+        end_ts = float(snap.get("ts", 0.0))
+        for key, value, rev, lid in snap.get("data", []):
+            data[key] = [key, value, int(rev), int(lid)]
+        for lid, ttl, exp_wall in snap.get("leases", []):
+            leases[int(lid)] = [float(ttl), float(exp_wall)]
+
+    n = 0
+    for rec in iter_records(wal_path):
+        n += 1
+        op = rec.get("op")
+        if op == "put":
+            rev = int(rec["rev"])
+            data[rec["k"]] = [rec["k"], rec["v"], rev, int(rec.get("l", 0))]
+            revision = max(revision, rev)
+            end_ts = max(end_ts, float(rec.get("ts", 0.0)))
+        elif op == "del":
+            rev = int(rec["rev"])
+            data.pop(rec["k"], None)
+            revision = max(revision, rev)
+            end_ts = max(end_ts, float(rec.get("ts", 0.0)))
+        elif op == "grant":
+            lid, ttl, ts = int(rec["id"]), float(rec["ttl"]), float(rec["ts"])
+            leases[lid] = [ttl, ts + ttl]
+            next_lease = max(next_lease, lid + 1)
+            end_ts = max(end_ts, ts)
+        elif op == "ka":
+            lid, ts = int(rec["id"]), float(rec["ts"])
+            if lid in leases:
+                leases[lid][1] = ts + leases[lid][0]
+            end_ts = max(end_ts, ts)
+        elif op == "revoke":
+            leases.pop(int(rec["id"]), None)
+            end_ts = max(end_ts, float(rec.get("ts", 0.0)))
+
+    if not end_ts:
+        # no timestamped record survived: the file mtime is the best
+        # available "last alive" estimate
+        try:
+            end_ts = os.path.getmtime(wal_path if os.path.exists(wal_path)
+                                      else snap_path)
+        except OSError:
+            import time
+            end_ts = time.time()
+
+    logger.info("wal %s: replayed %d records onto snapshot "
+                "(revision=%d, %d keys, %d leases)",
+                data_dir, n, revision, len(data), len(leases))
+    return {
+        "revision": revision,
+        "next_lease": next_lease,
+        "data": list(data.values()),
+        # remaining TTL frozen at the moment the server last breathed
+        "leases": [[lid, ttl, exp_wall - end_ts]
+                   for lid, (ttl, exp_wall) in leases.items()],
+        # record count for open_durable: the log (already torn-tail
+        # truncated above) need not be read a second time just to count
+        "wal_records": n,
+    }
+
+
+def open_durable(data_dir: str, sweep_period: float = 0.25,
+                 restart_grace: float | None = None,
+                 snapshot_every: int | None = None):
+    """Open (or create) a WAL-backed MemoryKV rooted at ``data_dir``.
+
+    On a restart this replays the prior state, re-arms the journal, and
+    immediately cuts a fresh snapshot (so the next replay starts from
+    the restored image, and torn-shutdown cleanup never accumulates).
+    ``restart_grace`` (default ``EDL_TPU_COORD_RESTART_GRACE``; -1 =
+    auto = the registration TTL) suspends expiry sweeps after the
+    restart so holders can reconnect and refresh their leases.
+    """
+    from edl_tpu.coord.memory import MemoryKV
+
+    grace = (constants.COORD_RESTART_GRACE if restart_grace is None
+             else restart_grace)
+    if grace < 0:
+        grace = constants.ETCD_TTL
+    state = load_state(data_dir)
+    known = 0 if state is None else int(state.pop("wal_records", 0))
+    journal = Wal(data_dir, snapshot_every=snapshot_every, known_count=known)
+    kv = MemoryKV(sweep_period=sweep_period, journal=journal,
+                  restart_grace=grace if state is not None else 0.0,
+                  restore=state)
+    if state is not None:
+        kv.snapshot_now()
+    return kv

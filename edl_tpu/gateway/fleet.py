@@ -29,7 +29,7 @@ import time
 
 from edl_tpu.cluster import paths
 from edl_tpu.coord.consistent_hash import ConsistentHash
-from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession, leased_register
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
@@ -45,11 +45,14 @@ def node_key(job_id: str, replica_id: str) -> str:
 
 
 def advertise(store, job_id: str, replica_id: str, payload: dict,
-              ttl: float = constants.ETCD_TTL) -> Register:
-    """TTL-leased replica advert; returns the Register (``update()`` to
-    refresh load stats, ``stop()`` to release the lease)."""
-    return Register(store, node_key(job_id, replica_id),
-                    json.dumps(payload).encode(), ttl=ttl)
+              ttl: float = constants.ETCD_TTL,
+              session: CoordSession | None = None):
+    """TTL-leased replica advert; returns a handle (``update()`` to
+    refresh load stats, ``stop()`` to release).  With ``session`` the
+    advert rides that shared self-healing lease instead of its own."""
+    return leased_register(store, node_key(job_id, replica_id),
+                           json.dumps(payload).encode(), ttl=ttl,
+                           session=session)
 
 
 def list_replicas(store, job_id: str) -> dict[str, dict]:
@@ -93,8 +96,13 @@ class FleetView:
         self._thread.start()
 
     def refresh(self) -> dict[str, dict]:
+        # the gateway calls this INLINE on a routing failure: on a
+        # resilient store, bound the retrying so a coord outage costs
+        # the request path a couple of seconds, not the full op budget
+        # — the stale view (plus quarantine) already covers the gap
         try:
-            fresh = list_replicas(self._store, self._job_id)
+            with self._store.scoped_deadline(2.0):
+                fresh = list_replicas(self._store, self._job_id)
         except Exception as e:  # noqa: BLE001 — store blips must not kill the view
             logger.warning("fleet refresh failed: %s", e)
             return self.replicas()

@@ -22,7 +22,7 @@ import json
 import time
 
 from edl_tpu.cluster import paths
-from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession, leased_register
 from edl_tpu.utils import constants
 
 
@@ -31,12 +31,18 @@ def _nodes_prefix(job_id: str) -> str:
 
 
 def advertise(store, job_id: str, pod_id: str, endpoint: str,
-              ttl: float = constants.ETCD_TTL) -> Register:
-    """TTL-leased cache advert; returns the Register to ``stop()``."""
-    return Register(store,
-                    paths.key(job_id, constants.ETCD_MEMSTATE,
-                              f"nodes/{pod_id}"),
-                    json.dumps({"endpoint": endpoint}).encode(), ttl=ttl)
+              ttl: float = constants.ETCD_TTL,
+              session: CoordSession | None = None):
+    """TTL-leased cache advert; returns a handle to ``stop()``.
+
+    With ``session`` the advert registers on that shared lease (one
+    keepalive loop per process, healed by
+    :class:`~edl_tpu.coord.session.CoordSession` after blips or lease
+    loss) instead of minting its own.
+    """
+    return leased_register(
+        store, paths.key(job_id, constants.ETCD_MEMSTATE, f"nodes/{pod_id}"),
+        json.dumps({"endpoint": endpoint}).encode(), ttl=ttl, session=session)
 
 
 def list_adverts(store, job_id: str) -> dict[str, str]:

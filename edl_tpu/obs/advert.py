@@ -29,6 +29,7 @@ import time
 
 from edl_tpu.cluster import paths
 from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession, SessionKey, leased_register
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
@@ -41,14 +42,16 @@ def _prefix(job_id: str) -> str:
 
 def advertise_metrics(store, job_id: str, component: str, endpoint: str,
                       name: str | None = None,
-                      ttl: float = constants.ETCD_TTL) -> Register:
-    """TTL-leased /metrics advert; returns the Register to ``stop()``."""
+                      ttl: float = constants.ETCD_TTL,
+                      session: CoordSession | None = None):
+    """TTL-leased /metrics advert; returns a handle to ``stop()``.
+    With ``session`` the advert rides that shared self-healing lease."""
     name = name or f"{component}-{os.getpid()}"
     payload = {"endpoint": endpoint, "component": component,
                "pid": os.getpid(), "ts": time.time()}
-    return Register(store, paths.key(job_id, constants.ETCD_OBS,
-                                     f"metrics/{name}"),
-                    json.dumps(payload).encode(), ttl=ttl)
+    return leased_register(
+        store, paths.key(job_id, constants.ETCD_OBS, f"metrics/{name}"),
+        json.dumps(payload).encode(), ttl=ttl, session=session)
 
 
 def list_metrics_targets(store, job_id: str) -> dict[str, dict]:
@@ -69,7 +72,9 @@ def list_metrics_targets(store, job_id: str) -> dict[str, dict]:
 
 
 def advertise_installed(store, job_id: str, component: str,
-                        ttl: float = constants.ETCD_TTL) -> Register | None:
+                        ttl: float = constants.ETCD_TTL,
+                        session: CoordSession | None = None
+                        ) -> Register | SessionKey | None:
     """Advertise this process's env-gated /metrics endpoint (if one is
     serving) in the coord store.  Best-effort, never raises."""
     from edl_tpu.obs import exposition
@@ -79,7 +84,7 @@ def advertise_installed(store, job_id: str, component: str,
         return None
     try:
         return advertise_metrics(store, job_id, component, srv.endpoint,
-                                 ttl=ttl)
+                                 ttl=ttl, session=session)
     except Exception:  # noqa: BLE001 — metrics must never fail a job
         logger.exception("metrics advert failed for %s", component)
         return None

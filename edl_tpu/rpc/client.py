@@ -37,7 +37,7 @@ from typing import Iterable
 from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import framing
-from edl_tpu.utils import constants, exceptions
+from edl_tpu.utils import constants, exceptions, faultinject
 from edl_tpu.utils.network import split_endpoint
 
 # the data plane's in-flight depth, observable while a bulk transfer
@@ -51,6 +51,7 @@ _INFLIGHT_WINDOW = obs_metrics.gauge(
 
 
 def _connect(endpoint: str, timeout: float) -> socket.socket:
+    faultinject.fire("connect", side="client")
     host, port = split_endpoint(endpoint)
     sock = socket.create_connection((host or "127.0.0.1", port),
                                     timeout=timeout)
@@ -83,8 +84,9 @@ class RpcClient:
         self._lock = threading.Lock()
         self._closed = False
 
-    def _connect(self) -> socket.socket:
-        return _connect(self.endpoint, self._timeout)
+    def _connect(self, timeout: float | None = None) -> socket.socket:
+        return _connect(self.endpoint,
+                        self._timeout if timeout is None else timeout)
 
     def call(self, method: str, _timeout: float | None = None, **kwargs):
         """Invoke ``method`` remotely; returns the result payload.
@@ -97,6 +99,7 @@ class RpcClient:
         first, and overlapping callers each keep a pooled connection
         (up to MAX_IDLE) rather than churning connects.
         """
+        faultinject.fire(method, side="client")
         req = _envelope(method, kwargs)
         for attempt in (0, 1):
             sock = None
@@ -108,7 +111,10 @@ class RpcClient:
             # every idle socket is equally suspect
             try:
                 if sock is None:
-                    sock = self._connect()
+                    # the per-call budget caps the dial too: a
+                    # blackholed (SYN-dropped) endpoint must not stall
+                    # a deadline-scoped caller for the client default
+                    sock = self._connect(_timeout)
                 sock.settimeout(_timeout if _timeout is not None
                                 else self._timeout)
                 framing.send_frame(sock, req)
@@ -222,6 +228,7 @@ class RpcChannelPool:
     def call(self, method: str, _timeout: float | None = None, **kwargs):
         """One round trip on any free channel (RpcClient.call semantics,
         including the single transport retry)."""
+        faultinject.fire(method, side="client")
         req = _envelope(method, kwargs)
         for attempt in (0, 1):
             ch = self._acquire()
@@ -263,6 +270,7 @@ class RpcChannelPool:
         protocols are idempotent per request).  Abandoning the
         generator mid-drain tears the channel down (unread frames
         would poison the next call on it)."""
+        faultinject.fire(method, side="client")
         requests = list(requests)
         if not requests:
             return
@@ -317,6 +325,7 @@ class RpcChannelPool:
         the channel down (the two ends have desynchronized).
         Abandoning the generator mid-stream also closes the channel:
         unread frames would poison the next call on it."""
+        faultinject.fire(method, side="client")
         ch = self._acquire()
         done = False
         try:

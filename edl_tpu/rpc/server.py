@@ -35,7 +35,7 @@ import time
 from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import framing
-from edl_tpu.utils import exceptions
+from edl_tpu.utils import exceptions, faultinject
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -87,6 +87,10 @@ class _Handler(socketserver.BaseRequestHandler):
             token = (obs_context.attach(caller.child())
                      if caller is not None else None)
             try:
+                # chaos hook: an injected error here is serialized to
+                # the caller as the retryable EdlCoordError, an injected
+                # delay models a slow handler (utils/faultinject.py)
+                faultinject.fire(method, side="server")
                 result = fn(**(msg.get("a") or {}))
                 if isinstance(result, Streaming):
                     resp = self._stream(method, result)

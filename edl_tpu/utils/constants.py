@@ -108,6 +108,24 @@ PREEMPT_CHECK_SECONDS = _f("EDL_TPU_PREEMPT_CHECK_SECONDS", 2.0)
 # the grace path can run (doc/usage.md "Preemption grace").
 PREEMPT_GRACE = _f("EDL_TPU_PREEMPT_GRACE", 120.0)
 
+# -- coordination-store fault tolerance (coord/wal.py, coord/resilient.py) --
+# WAL + snapshot directory for the Python coord server; empty = pure
+# in-memory (a restart loses everything, the pre-WAL behavior)
+COORD_DATA_DIR = _os.environ.get("EDL_TPU_COORD_DATA_DIR", "")
+# cut a snapshot + truncate the WAL every N appended records
+COORD_SNAPSHOT_EVERY = int(_f("EDL_TPU_COORD_SNAPSHOT_EVERY", 4096))
+# after a WAL-backed restart, expiry sweeps stay suspended this long so
+# holders can reconnect and refresh the restored leases before anything
+# is mass-expired; -1 = auto (one registration TTL)
+COORD_RESTART_GRACE = _f("EDL_TPU_COORD_RESTART_GRACE", -1.0)
+# ResilientCoordClient: total retry budget per op (exponential backoff
+# + jitter + endpoint failover inside it) before the EdlCoordError
+# finally propagates; callers with tighter latency needs scope it down
+# (heartbeat beats use scoped_deadline)
+COORD_RETRY_DEADLINE = _f("EDL_TPU_COORD_RETRY_DEADLINE", 30.0)
+COORD_BACKOFF_INIT = _f("EDL_TPU_COORD_BACKOFF_INIT", 0.05)
+COORD_BACKOFF_MAX = _f("EDL_TPU_COORD_BACKOFF_MAX", 2.0)
+
 # -- in-memory peer checkpoint cache (edl_tpu/memstate) -------------------
 # 0 disables the cache entirely (saves are not teed, restores go
 # straight to storage); on by default — the cache is best-effort and

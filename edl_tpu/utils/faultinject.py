@@ -1,0 +1,145 @@
+"""Env-driven fault injection for the control plane.
+
+Chaos testing needs failures that are *systematic*, not hand-rolled per
+test: one spec grammar, hook points on both RPC ends, and a counter so
+a run can prove its faults actually fired.  Enable with::
+
+    EDL_TPU_FAULTS="kv_put:error:0.3;connect:delay:1.5"
+
+Grammar — semicolon-separated rules, each ``point:action:arg[:prob]``
+with an optional ``client:``/``server:`` side prefix on the point:
+
+- **point** — the RPC wire method (``kv_put``, ``lease_keepalive``,
+  ``cache_fetch`` …), the transport pseudo-point ``connect`` (dialing a
+  TCP connection), or ``*`` (every point).
+- **action** ``error`` — raise :class:`EdlCoordError` (a transport-class
+  retryable failure) with probability ``arg``.
+- **action** ``delay`` — sleep ``arg`` seconds, with probability
+  ``prob`` (default 1.0) — models slow disks/links without killing the
+  call.
+- side prefix — ``client:kv_put`` fires only in
+  :mod:`edl_tpu.rpc.client` (before the request leaves),
+  ``server:kv_put`` only in the handler loop; a bare point fires on
+  both sides of whichever process carries the env var.
+
+``EDL_TPU_FAULTS_SEED`` pins the RNG so a chaos run is reproducible.
+``fire()`` is called on every RPC; with no spec configured it is one
+falsy check — the hot path pays nothing.
+
+Injected errors surface as ``EdlCoordError`` precisely because that is
+the transport-failure type the whole retry stack keys on
+(``retry_until_timeout``, ``ResilientCoordClient``, the gateway's
+failover): a chaos run exercises the SAME healing code a real outage
+does.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.exceptions import EdlCoordError
+
+_INJECTED = obs_metrics.counter(
+    "edl_faults_injected_total",
+    "Faults injected by utils/faultinject.py, by point and action",
+    ("point", "action"))
+
+_SIDES = ("client", "server")
+
+
+@dataclass(frozen=True)
+class Rule:
+    point: str              # method name, "connect", or "*"
+    side: str | None        # "client" | "server" | None (both)
+    action: str             # "error" | "delay"
+    arg: float              # error: probability; delay: seconds
+    prob: float             # delay only: firing probability
+
+    def matches(self, point: str, side: str) -> bool:
+        return (self.point in ("*", point)
+                and (self.side is None or self.side == side))
+
+
+class FaultSpecError(ValueError):
+    pass
+
+
+def parse(spec: str) -> list[Rule]:
+    rules: list[Rule] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        fields = raw.split(":")
+        side = None
+        if fields and fields[0] in _SIDES:
+            side = fields[0]
+            fields = fields[1:]
+        if len(fields) not in (3, 4):
+            raise FaultSpecError(
+                f"bad fault rule {raw!r}: want [side:]point:action:arg[:prob]")
+        point, action = fields[0], fields[1]
+        try:
+            arg = float(fields[2])
+            prob = float(fields[3]) if len(fields) == 4 else 1.0
+        except ValueError as e:
+            raise FaultSpecError(f"bad fault rule {raw!r}: {e}") from e
+        if action == "error":
+            if len(fields) == 4:
+                raise FaultSpecError(
+                    f"bad fault rule {raw!r}: error takes ONE number — "
+                    f"its probability (point:error:prob)")
+            prob, arg = arg, 0.0  # error's arg IS its probability
+        elif action != "delay":
+            raise FaultSpecError(
+                f"bad fault rule {raw!r}: unknown action {action!r}")
+        if not 0.0 <= prob <= 1.0:
+            raise FaultSpecError(f"bad fault rule {raw!r}: prob {prob}")
+        rules.append(Rule(point, side, action, arg, prob))
+    return rules
+
+
+_rules: list[Rule] = []
+_rng = random.Random()
+
+
+def configure(spec: str | None, seed: int | None = None) -> list[Rule]:
+    """(Re)load the active rule set; tests call this directly, normal
+    processes get it from the env at import."""
+    global _rules, _rng
+    _rules = parse(spec) if spec else []
+    _rng = random.Random(seed)
+    return _rules
+
+
+def active() -> bool:
+    return bool(_rules)
+
+
+def fire(point: str, side: str = "client") -> None:
+    """Hook point: maybe delay, maybe raise.  Called per RPC on both
+    ends (rpc/client.py before the request leaves and around connect;
+    rpc/server.py around the handler)."""
+    if not _rules:
+        return
+    for rule in _rules:
+        if not rule.matches(point, side):
+            continue
+        if rule.prob < 1.0 and _rng.random() >= rule.prob:
+            continue
+        _INJECTED.labels(point=point, action=rule.action).inc()
+        if rule.action == "delay":
+            time.sleep(rule.arg)
+        else:
+            raise EdlCoordError(
+                f"injected fault ({side}:{point}, EDL_TPU_FAULTS)")
+
+
+_seed = os.environ.get("EDL_TPU_FAULTS_SEED")
+configure(os.environ.get("EDL_TPU_FAULTS"),
+          int(_seed) if _seed else None)
+del _seed

@@ -2,40 +2,63 @@
 
 Reference: python/edl/utils/error_utils.py:20-39
 (``handle_errors_until_timeout``).  Retryable framework errors are
-swallowed and retried on an interval until ``timeout`` seconds elapse,
-then the last error propagates.  Non-retryable errors propagate
-immediately.
+swallowed and retried until ``timeout`` seconds elapse, then the last
+error propagates.  Non-retryable errors propagate immediately.
+
+Coordination-path callers pass ``backoff`` > 1 so a store outage is
+probed at an exponentially widening interval with full jitter (every
+retry at a fixed 1 s across a whole job's processes is a synchronized
+stampede on the recovering server); ``edl_retry_attempts_total{fn}``
+counts the retries per wrapped function so blip history is visible on
+/metrics.
 """
 
 from __future__ import annotations
 
 import functools
+import random
 import time
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.exceptions import EdlRetryableError
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
+_ATTEMPTS = obs_metrics.counter(
+    "edl_retry_attempts_total",
+    "retry_until_timeout retries, by wrapped function", ("fn",))
 
-def retry_until_timeout(func=None, *, interval: float = 1.0):
+
+def retry_until_timeout(func=None, *, interval: float = 1.0,
+                        backoff: float = 1.0, max_interval: float = 30.0,
+                        jitter: bool = True):
     """Decorate ``func(..., timeout=N)`` to retry EdlRetryableError.
 
     The wrapped function must accept a ``timeout`` keyword (seconds).
+    ``interval`` is the first retry delay; each subsequent delay is
+    multiplied by ``backoff`` (1.0 = the legacy fixed interval) and
+    capped at ``max_interval``.  With ``jitter`` each sleep is drawn
+    uniformly from (0, delay] — full jitter — so synchronized callers
+    fan out instead of stampeding.
     """
 
     def decorate(f):
         @functools.wraps(f)
         def wrapper(*args, timeout: float = 60.0, **kwargs):
             deadline = time.monotonic() + timeout
+            delay = interval
             while True:
                 try:
                     return f(*args, **kwargs)
                 except EdlRetryableError as e:
                     if time.monotonic() >= deadline:
                         raise
+                    _ATTEMPTS.labels(fn=f.__name__).inc()
                     logger.debug("retrying %s after %s: %s", f.__name__, type(e).__name__, e)
-                    time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+                    sleep = random.uniform(0, delay) if jitter else delay
+                    time.sleep(min(sleep, max(0.0, deadline - time.monotonic())))
+                    delay = min(delay * backoff, max_interval)
 
         return wrapper
 

@@ -39,6 +39,16 @@ JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
 # process's spans and merge into one ordered Perfetto-exportable timeline
 JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
 
+# chaos smoke: SIGKILL + restart the durable coord server mid-training
+# AND mid-serving — WAL replay must restore revision counter, lease
+# table and keys bit-exactly; training must resume without
+# restore-from-scratch (one trainer start, no membership-changed path);
+# zero accepted gateway requests lost; every advert (resource, memstate,
+# serving, obs) back within one TTL + restart grace; coord_restart_mttr_s
+# recorded; and the EDL_TPU_FAULTS injection harness must fire and be
+# healed by the resilient client
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
 # obs-agg smoke: 2 child processes + parent — one trace_id propagated
 # over the EDL1 wire into both children's trace files, the aggregator
 # discovers all three via coord-store adverts and serves a merged

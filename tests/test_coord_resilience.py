@@ -1,0 +1,1217 @@
+"""Control-plane fault tolerance: WAL durability, lease semantics across
+restart, self-healing clients/sessions, snapshot-marked watches, and
+the fault-injection harness.
+
+The restart battery runs against BOTH the plain in-memory engine (an
+amnesiac restart forgets everything, but its clock-seeded counters keep
+stale lease ids from colliding with fresh grants) and the WAL-backed
+store (which must restore revision counter, lease table and keys
+bit-exactly).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord.client import CoordClient, connect, connect_wait
+from edl_tpu.coord.kv import PrefixWatcher
+from edl_tpu.coord.memory import MemoryKV
+from edl_tpu.coord.register import Register
+from edl_tpu.coord.resilient import ResilientCoordClient
+from edl_tpu.coord.session import CoordSession
+from edl_tpu.coord.server import start_server
+from edl_tpu.coord.wal import load_state, open_durable
+from edl_tpu.utils import faultinject
+from edl_tpu.utils.exceptions import EdlCoordError, EdlRegisterError
+
+
+# ---------------------------------------------------------------------------
+# WAL durability
+# ---------------------------------------------------------------------------
+
+def test_wal_restart_restores_state_bit_exactly(tmp_path):
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.1)
+    kv.put("/a", b"1")
+    kv.put("/b", b"2")
+    kv.put("/a", b"3")          # overwrite: revision history matters
+    kv.delete("/b")
+    lid = kv.lease_grant(30.0)
+    kv.put("/leased", b"x", lid)
+    before = kv.dump_state()
+    kv.close()
+
+    kv2 = open_durable(d, sweep_period=0.1)
+    assert kv2.dump_state() == before
+    assert kv2.get("/a").value == b"3"
+    assert kv2.get("/b") is None
+    # restored lease is live and still owns its key
+    assert kv2.lease_keepalive(lid) is True
+    assert kv2.get("/leased").lease_id == lid
+    kv2.close()
+
+
+def test_wal_restart_restores_revision_and_lease_counters(tmp_path):
+    d = str(tmp_path / "coord")
+    kv = open_durable(d)
+    rev = kv.put("/k", b"v")
+    l1 = kv.lease_grant(30.0)
+    l2 = kv.lease_grant(30.0)
+    kv.close()
+
+    kv2 = open_durable(d)
+    # revisions keep climbing: watchers' since_revision stays meaningful
+    assert kv2.put("/k2", b"v") > rev
+    # stale lease ids can never collide with fresh grants
+    l3 = kv2.lease_grant(30.0)
+    assert l3 > max(l1, l2)
+    kv2.close()
+
+
+def test_close_joins_inflight_sweeper_snapshot(tmp_path):
+    # an off-lock snapshot write still in flight when close() is called
+    # must land BEFORE close() returns: a successor opened on the same
+    # data_dir may cut its own snapshot and truncate the log, and a
+    # straggler write_snapshot after that would atomically replace
+    # snapshot.bin with the stale pre-close image — rewinding the
+    # revision counter and losing every mutation since the image was cut
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05, snapshot_every=1)
+    in_write = threading.Event()
+    release = threading.Event()
+    finished = threading.Event()
+    real_write = kv._journal.write_snapshot
+
+    def slow_write(state):
+        in_write.set()
+        release.wait(10)
+        real_write(state)
+        finished.set()
+
+    kv._journal.write_snapshot = slow_write
+    kv.put("/k", b"v")                      # marks a snapshot due
+    assert in_write.wait(10), "sweeper never started the snapshot write"
+    closed = threading.Event()
+    t = threading.Thread(target=lambda: (kv.close(), closed.set()))
+    t.start()
+    time.sleep(0.3)
+    assert not closed.is_set(), \
+        "close() returned with a snapshot write still in flight"
+    release.set()
+    t.join(10)
+    assert closed.is_set() and finished.is_set()
+    assert not kv._sweeper.is_alive()
+
+
+def test_wal_data_dir_is_exclusive(tmp_path):
+    # two instances appending to one wal.log from independent handles
+    # interleave records and clobber each other's snapshot.bin — replay
+    # then truncates at the first CRC mismatch and silently discards
+    # later state.  The misconfiguration must be loud at startup, and
+    # the flock must release on close so a restart can re-acquire.
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.1)
+    with pytest.raises(RuntimeError, match="locked"):
+        open_durable(d, sweep_period=0.1)
+    kv.put("/k", b"v")
+    kv.close()
+    kv2 = open_durable(d, sweep_period=0.1)
+    assert kv2.get("/k").value == b"v"
+    kv2.close()
+
+
+def test_snapshot_now_serialized_with_sweeper_cycle(tmp_path):
+    # sweeper cuts image I1, releases the KV lock, stalls in the
+    # off-lock write; a put M is journaled; snapshot_now() writes I2
+    # (with M) and truncates the log.  If the sweeper's stale I1 then
+    # lands via os.replace, disk state is I1 + empty log: the
+    # acknowledged M is durably lost.  The whole cycle must serialize.
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05, snapshot_every=1)
+    real_write = kv._journal.write_snapshot
+    in_first = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def gated_write(state):
+        calls.append(state["revision"])
+        if len(calls) == 1:
+            in_first.set()
+            release.wait(10)
+        real_write(state)
+
+    kv._journal.write_snapshot = gated_write
+    kv.put("/a", b"1")                    # marks a snapshot due
+    assert in_first.wait(10), "sweeper never started the snapshot write"
+    kv.put("/m", b"2")                    # journaled after I1 was cut
+    t = threading.Thread(target=kv.snapshot_now)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive(), \
+        "snapshot_now overtook an in-flight sweeper snapshot cycle"
+    release.set()
+    t.join(10)
+    assert not t.is_alive()
+    kv.close()
+    kv2 = open_durable(d)
+    assert kv2.get("/m").value == b"2", \
+        "acknowledged put lost to a stale snapshot replacing a newer one"
+    kv2.close()
+
+
+def test_stale_lease_ids_cannot_collide_after_amnesiac_restart():
+    """The motivating bug, pinned (and closed): a plain in-memory
+    restart used to reset the lease counter to 1, so a fresh grant
+    REUSED a pre-restart id — a holder still refreshing its stale id
+    silently kept a DIFFERENT owner's lease alive and revoked it on
+    shutdown.  Amnesiac boots now clock-seed the lease counter (both
+    engines), so stale ids simply read as dead; the lease itself is
+    still LOST — only the WAL path above preserves it — which sessions
+    heal by re-granting."""
+    kv = MemoryKV(sweep_period=0.1)
+    stale = kv.lease_grant(30.0)
+    kv.close()
+    time.sleep(0.002)                          # a real restart spans >1 ms
+    kv2 = MemoryKV(sweep_period=0.1)           # "restart" without a WAL
+    fresh = kv2.lease_grant(30.0)
+    assert fresh != stale                      # no silent collision
+    assert kv2.lease_keepalive(stale) is False  # stale id is simply dead
+    kv2.close()
+
+
+def test_wal_snapshot_truncates_and_still_replays(tmp_path):
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05, snapshot_every=10)
+    for i in range(35):                 # > 3 snapshot cycles due
+        kv.put(f"/k{i % 5}", str(i).encode())
+    # snapshots are cut by the sweeper, OFF the mutation path: no put
+    # above paid for one, but the next sweep supersedes the whole log
+    wal_path = os.path.join(d, "wal.log")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and os.path.getsize(wal_path) > 0:
+        time.sleep(0.02)
+    assert os.path.getsize(wal_path) == 0, "sweeper never cut the snapshot"
+    before = kv.dump_state()
+    kv.close()
+    kv2 = open_durable(d, snapshot_every=10)
+    assert kv2.dump_state() == before
+    kv2.close()
+
+
+def test_snapshot_raced_by_append_leaves_log_whole(tmp_path):
+    # the sweeper serializes + writes the snapshot image OFF the KV
+    # lock; a mutation landing in that window must not be truncated
+    # away — the cut is skipped and snapshot + whole log replay
+    # converges (older records re-apply onto the image harmlessly)
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=30.0)   # sweeper effectively idle
+    kv.put("/a", b"1")
+    lid = kv.lease_grant(30.0)
+    kv.put("/b", b"2", lid)
+    with kv._lock:
+        image = kv._snapshot_state_locked()
+        mark = kv._journal.mark()
+    kv._journal.write_snapshot(image)         # off-lock write...
+    kv.put("/late", b"3")                     # ...raced by a mutation
+    with kv._lock:
+        assert kv._journal.truncate_if_unmoved(mark) is False
+    before = kv.dump_state()
+    kv.close()
+    kv2 = open_durable(d)                     # snapshot + WHOLE log replay
+    assert kv2.dump_state() == before
+    assert kv2.get("/late").value == b"3"
+    kv2.close()
+
+
+def test_keepalive_journal_records_coalesce(tmp_path):
+    # the hottest steady-state op must not pay one journal append
+    # (flush) per beat: one ka record per half-TTL per lease
+    from edl_tpu.coord.wal import iter_records
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.1)
+    lid = kv.lease_grant(1.0)
+    for _ in range(20):                       # ~1 s of 20 Hz refreshes
+        assert kv.lease_keepalive(lid) is True
+        time.sleep(0.05)
+    kv.close()
+    kas = [r for r in iter_records(os.path.join(d, "wal.log"))
+           if r.get("op") == "ka"]
+    assert len(kas) <= 6, f"{len(kas)} ka records for 20 beats: not coalesced"
+    # and replay still restores the lease live
+    kv2 = open_durable(d)
+    assert kv2.lease_keepalive(lid) is True
+    kv2.close()
+
+
+def test_wal_torn_tail_is_truncated(tmp_path):
+    d = str(tmp_path / "coord")
+    kv = open_durable(d)
+    kv.put("/good", b"1")
+    kv.close()
+    with open(os.path.join(d, "wal.log"), "ab") as f:
+        f.write(b"\x00\x00\x00\x40GARBAGE")   # torn record: length lies
+    kv2 = open_durable(d)
+    assert kv2.get("/good").value == b"1"     # everything durable survives
+    kv2.put("/after", b"2")                   # and the log keeps working
+    kv2.close()
+    kv3 = open_durable(d)
+    assert kv3.get("/after").value == b"2"
+    kv3.close()
+
+
+def test_wal_restart_freezes_lease_ttl_and_grace(tmp_path):
+    """A lease near its TTL at the crash must NOT be expired right at
+    restart: remaining TTL is measured against the server's last-alive
+    instant, and the post-restart grace holds sweeps off so the holder
+    can refresh first."""
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05)
+    lid = kv.lease_grant(2.0)
+    kv.put("/adv", b"x", lid)
+    kv.close()
+    time.sleep(3.0)  # downtime far beyond the TTL
+    kv2 = open_durable(d, sweep_period=0.05, restart_grace=2.0)
+    assert kv2.get("/adv") is not None, "downtime must not count against TTL"
+    assert kv2.lease_keepalive(lid) is True
+    # after the holder stops refreshing, expiry resumes post-grace
+    time.sleep(5.0)
+    assert kv2.get("/adv") is None
+    kv2.close()
+
+
+def test_wal_restart_expires_unrefreshed_leases_after_grace(tmp_path):
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05)
+    lid = kv.lease_grant(0.4)
+    kv.put("/dead", b"x", lid)
+    kv.close()
+    kv2 = open_durable(d, sweep_period=0.05, restart_grace=1.5)
+    assert kv2.get("/dead") is not None       # grace window
+    time.sleep(3.0)                           # grace + TTL both elapsed
+    assert kv2.get("/dead") is None           # nobody refreshed: swept
+    assert kv2.lease_keepalive(lid) is False
+    kv2.close()
+
+
+def test_load_state_empty_dir(tmp_path):
+    assert load_state(str(tmp_path / "nothing")) is None
+
+
+def test_load_state_end_ts_advances_on_puts(tmp_path):
+    # replay measures remaining TTL against the LAST record's wall
+    # timestamp; put/del records are timestamped too, so a put-only log
+    # tail (ka coalescing, busy store) cannot leave the last-alive
+    # estimate stale and over-extend a dead holder's lease past the
+    # TTL + grace bound the failure matrix promises
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=30.0)
+    lid = kv.lease_grant(1.0)
+    time.sleep(0.7)
+    kv.put("/busy", b"x")          # timestamped: the new last-alive instant
+    kv.close()
+    st = load_state(d)
+    remaining = {l[0]: l[2] for l in st["leases"]}[lid]
+    assert remaining <= 0.5, \
+        f"remaining {remaining:.2f}s: puts did not advance end_ts"
+
+
+def test_keepalive_tolerates_journal_error(tmp_path):
+    # a sick data_dir disk must not fail keepalives for healthy
+    # holders: a lost ka record only costs replay a staler remaining
+    # TTL (covered by the restart grace), so the in-memory refresh
+    # lands and the journal error is deferred — same tolerance as the
+    # expiry sweep
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=0.05)
+    lid = kv.lease_grant(0.5)
+    kv.put("/adv", b"x", lid)
+
+    def full_disk(rec):
+        raise OSError("No space left on device")
+    kv._journal.append = full_disk
+
+    deadline = time.monotonic() + 1.2
+    while time.monotonic() < deadline:
+        assert kv.lease_keepalive(lid) is True
+        time.sleep(0.1)
+    # refreshes really landed: the key outlived the original TTL
+    assert kv.get("/adv") is not None
+    kv.close()
+
+
+def test_wait_resyncs_when_amnesiac_restart_catches_up():
+    # the residual rewind hole: a NON-durable restart used to restart
+    # the revision counter from zero, so re-registration churn could
+    # push it back PAST a watcher's old position before its next poll —
+    # the watcher then got a truncated incremental delta (phantom keys
+    # kept, revisions 1..since never delivered).  Clock-seeded counters
+    # land every new boot AHEAD of any prior position, forcing the
+    # snapshot resync.
+    kv = MemoryKV(sweep_period=0.1)
+    for i in range(5):
+        kv.put(f"/w/k{i}", b"x")
+    since = kv.get_prefix("/w/")[1]
+    kv.close()
+    time.sleep(0.05)                  # clock advances past the 5 puts
+    kv2 = MemoryKV(sweep_period=0.1)  # amnesiac restart
+    for i in range(50):               # churn "catches up" a zero-seeded counter
+        kv2.put(f"/w/new{i}", b"y")
+    res = kv2.wait("/w/", since, timeout=0.2)
+    assert res.snapshot, "must resync, not deliver a truncated delta"
+    keys = {e.record.key for e in res.events}
+    assert "/w/k0" not in keys and "/w/new0" in keys
+    kv2.close()
+
+
+def test_keepalive_cannot_resurrect_half_revoked_lease(tmp_path):
+    # once a lease's revoke record is durable in the WAL, the live
+    # server must never extend it again: a journal error that defers
+    # the expiry sweep's key deletes leaves the lease in the table for
+    # retry, but a restart WILL replay the revoke and drop it — a
+    # keepalive resurrecting it live would diverge the store from its
+    # own log (holder told True forever, state lost at next restart)
+    d = str(tmp_path / "coord")
+    kv = open_durable(d, sweep_period=3600.0)   # manual sweeps only
+    lid = kv.lease_grant(0.2)
+    kv.put("/half", b"x", lid)
+    time.sleep(0.3)                             # lease expired
+    real_append = kv._journal.append
+
+    def sick_for_deletes(rec):
+        if rec.get("op") == "del":
+            raise OSError("EIO")
+        return real_append(rec)
+
+    kv._journal.append = sick_for_deletes
+    with kv._lock:
+        kv._expire_locked(time.monotonic())     # revoke lands, del fails
+    assert kv.lease_keepalive(lid) is False, \
+        "a durably-revoked lease must not be resurrected"
+    with pytest.raises(KeyError):
+        kv.put("/half2", b"y", lid)             # nor accept new keys
+    kv._journal.append = real_append
+    with kv._lock:
+        kv._expire_locked(time.monotonic())     # retry finishes the job
+    assert kv.get("/half") is None
+    before = kv.dump_state()
+    kv.close()
+    kv2 = open_durable(d)                       # replay agrees with live
+    assert kv2.dump_state() == before
+    assert kv2.lease_keepalive(lid) is False
+    kv2.close()
+
+
+# ---------------------------------------------------------------------------
+# lease semantics battery — plain engine AND WAL-backed server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["memory", "wal-server"])
+def battery_kv(request, tmp_path):
+    if request.param == "memory":
+        kv = MemoryKV(sweep_period=0.1)
+        yield kv
+        kv.close()
+    else:
+        server = start_server("127.0.0.1", 0,
+                              data_dir=str(tmp_path / "coord"))
+        client = CoordClient(f"127.0.0.1:{server.port}")
+        yield client
+        client.close()
+        server.stop()
+
+
+def test_keepalive_on_revoked_lease(battery_kv):
+    lid = battery_kv.lease_grant(5.0)
+    battery_kv.put("/rk", b"v", lid)
+    battery_kv.lease_revoke(lid)
+    assert battery_kv.lease_keepalive(lid) is False
+    assert battery_kv.get("/rk") is None
+
+
+def test_advert_reregisters_after_forced_lease_expiry(battery_kv):
+    reg = Register(battery_kv, "/svc/nodes/n0", b"ep", ttl=0.6)
+    first = reg._lease_id
+    battery_kv.lease_revoke(first)            # forced expiry
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rec = battery_kv.get("/svc/nodes/n0")
+        if rec is not None and rec.lease_id != first:
+            break
+        time.sleep(0.05)
+    rec = battery_kv.get("/svc/nodes/n0")
+    assert rec is not None and rec.value == b"ep", \
+        "advert must re-register after its lease was torn away"
+    assert rec.lease_id != first, "a NEW lease must back the re-registration"
+    assert not reg.is_stopped
+    reg.stop()
+    assert battery_kv.get("/svc/nodes/n0") is None
+
+
+# ---------------------------------------------------------------------------
+# self-healing client
+# ---------------------------------------------------------------------------
+
+def test_resilient_client_fails_over_to_live_endpoint(coord_server):
+    live = f"127.0.0.1:{coord_server.port}"
+    rc = ResilientCoordClient(["127.0.0.1:1", live], timeout=2.0,
+                              retry_deadline=20.0, backoff_init=0.01)
+    assert rc.put("/r/k", b"v") > 0          # dead first endpoint survived
+    assert rc.get("/r/k").value == b"v"
+    assert rc.endpoint == live               # seated on the survivor
+    rc.close()
+
+
+def test_resilient_client_survives_server_restart(tmp_path):
+    d = str(tmp_path / "coord")
+    server = start_server("127.0.0.1", 0, data_dir=d)
+    port = server.port
+    rc = ResilientCoordClient([f"127.0.0.1:{port}"], timeout=2.0,
+                              retry_deadline=20.0, backoff_init=0.01)
+    lid = rc.lease_grant(30.0)
+    rc.put("/sr/k", b"v", lid)
+    server.stop()
+    server.kv.close()  # release the WAL before the restart reopens it
+
+    done = threading.Event()
+    result: dict = {}
+
+    def op():
+        try:
+            result["rec"] = rc.get("/sr/k")
+        except Exception as e:  # noqa: BLE001
+            result["err"] = e
+        done.set()
+
+    t = threading.Thread(target=op)
+    t.start()                                 # retries against the dead port
+    time.sleep(0.5)
+    server2 = start_server("127.0.0.1", port, data_dir=d)
+    assert done.wait(15), "op never completed after restart"
+    assert "err" not in result, result.get("err")
+    assert result["rec"].value == b"v"
+    assert result["rec"].lease_id == lid      # WAL restored the lease link
+    assert rc.lease_keepalive(lid) is True
+    rc.close()
+    server2.stop()
+
+
+def test_resilient_client_scoped_deadline_bounds_blocking():
+    rc = ResilientCoordClient(["127.0.0.1:1"], timeout=0.2,
+                              retry_deadline=60.0, backoff_init=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(EdlCoordError):
+        with rc.scoped_deadline(0.5):
+            rc.put("/x", b"v")
+    assert time.monotonic() - t0 < 5.0, "scoped budget must bound retrying"
+    rc.close()
+
+
+def test_hung_endpoint_fails_over_within_one_op(coord_server):
+    # a blackholed endpoint (TCP accepts via the listen backlog, never
+    # answers) must not eat the whole retry budget in one in-flight
+    # attempt: with a standby available the per-attempt transport cap
+    # splits the remaining budget so FAILOVER_AFTER hung attempts still
+    # leave room to reach the healthy endpoint — the op SUCCEEDS inside
+    # its own budget instead of raising while a standby sat idle
+    import socket
+    sink = socket.socket()
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+    hung = f"127.0.0.1:{sink.getsockname()[1]}"
+    rc = ResilientCoordClient([hung, f"127.0.0.1:{coord_server.port}"],
+                              timeout=30.0, retry_deadline=8.0,
+                              backoff_init=0.01)
+    try:
+        t0 = time.monotonic()
+        rc.put("/ho/k", b"v")                  # must not raise
+        assert time.monotonic() - t0 < 8.0
+        assert rc.get("/ho/k").value == b"v"
+    finally:
+        rc.close()
+        sink.close()
+
+
+def test_scoped_deadline_budget_shared_across_ops():
+    # the scope's budget is one absolute deadline for EVERY op inside
+    # it: a heartbeat beat (keepalive + k heal ops under _op_lock)
+    # against a dead store must give up after ~one TTL total, not one
+    # TTL per op — per-op budgets would hold the session's _op_lock for
+    # k·TTL and expire the very lease the scope protects
+    rc = ResilientCoordClient(["127.0.0.1:1"], timeout=0.2,
+                              retry_deadline=60.0, backoff_init=0.01)
+    t0 = time.monotonic()
+    with rc.scoped_deadline(0.8):
+        for _ in range(3):
+            with pytest.raises(EdlCoordError):
+                rc.put("/x", b"v")
+    assert time.monotonic() - t0 < 2.0, \
+        "scoped budget must be shared across the scope's ops"
+    rc.close()
+
+
+def test_scoped_deadline_bounds_inflight_rpc_on_hung_server(coord_server,
+                                                            clean_faults):
+    """A HUNG endpoint (connection accepted, answer delayed) must stay
+    inside the scoped budget too — the in-flight transport timeout is
+    capped by the remaining budget, not just the sleeps between
+    retries (else heartbeat.beat's 5s cap could stall a full 30s
+    transport timeout, or 60s with the internal redial)."""
+    faultinject.configure("server:kv_put:delay:6")
+    rc = ResilientCoordClient([f"127.0.0.1:{coord_server.port}"],
+                              timeout=30.0, retry_deadline=60.0,
+                              backoff_init=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(EdlCoordError):
+        with rc.scoped_deadline(1.0):
+            rc.put("/hang/k", b"v")
+    assert time.monotonic() - t0 < 5.0, \
+        "scoped budget must bound the in-flight RPC, not only retries"
+    rc.close()
+
+
+def test_resilient_wait_snapshot_resync_after_failover():
+    """Failover lands on an INDEPENDENT store whose revisions are
+    unrelated to the watch position: the first wait answered by the new
+    endpoint must be a snapshot resync (old store's keys become
+    phantoms otherwise, and the new store's existing keys would never
+    be delivered as events)."""
+    a = start_server("127.0.0.1", 0)
+    b = start_server("127.0.0.1", 0)
+    ep_a, ep_b = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    try:
+        # store B has pre-existing state the watcher must discover
+        cb = CoordClient(ep_b)
+        cb.put("/fo/only-on-b", b"b1")
+        cb.close()
+        rc = ResilientCoordClient([ep_a, ep_b], timeout=2.0,
+                                  retry_deadline=20.0, backoff_init=0.01)
+        rc.put("/fo/only-on-a", b"a1")
+        res = rc.wait("/fo/", 0, 0.2)
+        seen_rev = res.revision
+        assert any(e.record.key == "/fo/only-on-a" for e in res.events)
+
+        # "kill" store A.  stop() closes the listener but an in-process
+        # ThreadingTCPServer leaves live handler threads serving already-
+        # open sockets (a real SIGKILL kills those too), so also drop
+        # the client's pooled connection to make the death real.
+        a.stop()
+        with rc._lock:
+            stale = rc._clients.pop(ep_a, None)
+        if stale is not None:
+            stale.close()
+        assert rc.put("/fo/healed", b"h") > 0  # retried + failed over to B
+        assert rc.endpoint == ep_b
+        res2 = rc.wait("/fo/", seen_rev, 0.2)
+        assert res2.snapshot is True, \
+            "wait answered by a different independent store must resync"
+        keys = {e.record.key for e in res2.events}
+        assert keys == {"/fo/only-on-b", "/fo/healed"}
+        assert all(e.type == "put" for e in res2.events)
+        rc.close()
+    finally:
+        b.stop()
+        try:
+            a.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+
+
+def test_resilient_wait_resyncs_when_baseline_came_from_dead_endpoint():
+    """PrefixWatcher baselines its view with get_prefix; if that was
+    served by an endpoint that dies before the FIRST wait, the wait —
+    answered by the other independent store — must still resync."""
+    a = start_server("127.0.0.1", 0)
+    b = start_server("127.0.0.1", 0)
+    ep_a, ep_b = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    try:
+        cb = CoordClient(ep_b)
+        cb.put("/fb/on-b", b"b1")
+        cb.close()
+        rc = ResilientCoordClient([ep_a, ep_b], timeout=2.0,
+                                  retry_deadline=20.0, backoff_init=0.01)
+        rc.put("/fb/on-a", b"a1")
+        recs, rev = rc.get_prefix("/fb/")  # baseline view, served by A
+        assert {r.key for r in recs} == {"/fb/on-a"}
+
+        a.stop()  # see the note in the test above: make the death real
+        with rc._lock:
+            stale = rc._clients.pop(ep_a, None)
+        if stale is not None:
+            stale.close()
+        assert rc.put("/fb/poke", b"p") > 0   # drives the failover to B
+        res = rc.wait("/fb/", rev, 0.2)       # FIRST wait on this prefix
+        assert res.snapshot is True
+        assert {e.record.key for e in res.events} == {"/fb/on-b", "/fb/poke"}
+        rc.close()
+    finally:
+        b.stop()
+        try:
+            a.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+
+
+def test_connect_returns_resilient_and_reports_cause(coord_server):
+    store = connect(f"127.0.0.1:{coord_server.port}")
+    assert isinstance(store, ResilientCoordClient)
+    store.put("/c/k", b"v")
+    store.close()
+    with pytest.raises(ConnectionError) as ei:
+        connect("127.0.0.1:1", timeout=0.2)
+    # ping's transport error is surfaced, not swallowed into "None"
+    assert "None" not in str(ei.value)
+
+
+def test_connect_wait_tolerates_late_server():
+    from edl_tpu.utils.network import find_free_ports
+    port = find_free_ports(1)[0]
+    holder: dict = {}
+
+    def boot_later():
+        time.sleep(1.0)
+        holder["server"] = start_server("127.0.0.1", port)
+
+    t = threading.Thread(target=boot_later)
+    t.start()
+    store = connect_wait(f"127.0.0.1:{port}", timeout=2.0, wait=30.0)
+    store.put("/late/k", b"v")
+    store.close()
+    t.join()
+    holder["server"].stop()
+
+
+def test_ping_distinguishes_transport_from_handler_errors():
+    # transport-unreachable RAISES (connect()'s last_err gets populated)
+    with pytest.raises(EdlCoordError):
+        CoordClient("127.0.0.1:1", timeout=0.2).ping()
+    # a reachable server that is NOT a coord store answers False
+    from edl_tpu.rpc.server import RpcServer
+    srv = RpcServer("127.0.0.1", 0).start()
+    try:
+        client = CoordClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        assert client.ping() is False
+        client.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CoordSession
+# ---------------------------------------------------------------------------
+
+def test_session_owns_multiple_keys_one_lease(memkv):
+    s = CoordSession(memkv, ttl=5.0)
+    s.register("/m/a", b"1")
+    s.register("/m/b", b"2")
+    assert memkv.get("/m/a").lease_id == s.lease_id
+    assert memkv.get("/m/b").lease_id == s.lease_id
+    s.update("/m/a", b"1b")
+    assert memkv.get("/m/a").value == b"1b"
+    s.unregister("/m/b")
+    assert memkv.get("/m/b") is None
+    s.close()
+    assert memkv.get("/m/a") is None          # revoke swept the lease's keys
+
+
+def test_session_regrants_and_reputs_after_lease_loss(memkv):
+    s = CoordSession(memkv, ttl=0.6)
+    s.register("/h/a", b"1")
+    s.register("/h/b", b"2")
+    first = s.lease_id
+    memkv.lease_revoke(first)                 # blip longer than one TTL
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            memkv.get("/h/a") is None or s.lease_id == first):
+        time.sleep(0.05)
+    assert s.lease_id != first
+    assert memkv.get("/h/a").value == b"1"
+    assert memkv.get("/h/b").value == b"2"
+    assert memkv.get("/h/a").lease_id == s.lease_id
+    assert not s.is_stopped
+    s.close()
+
+
+def test_session_exclusive_key_stops_on_lease_loss(memkv):
+    lost: list = []
+    s = CoordSession(memkv, ttl=0.6, on_lost=lost.append)
+    s.register("/seat/x", b"A", exclusive=True)
+    memkv.lease_revoke(s.lease_id)
+    memkv.put("/seat/x", b"B")                # usurper takes the seat
+    deadline = time.time() + 10
+    while not s.is_stopped and time.time() < deadline:
+        time.sleep(0.05)
+    assert s.is_stopped and isinstance(s.error, EdlRegisterError)
+    assert lost and isinstance(lost[0], EdlRegisterError)
+    assert memkv.get("/seat/x").value == b"B"  # usurper untouched
+
+
+def test_session_survives_nondurable_server_restart(tmp_path):
+    """No WAL: the restarted server forgot the lease entirely — the
+    session must re-grant and re-put, healing the 'blip longer than one
+    TTL permanently unregisters a healthy component' failure mode."""
+    server = start_server("127.0.0.1", 0)      # NOT durable, on purpose
+    port = server.port
+    rc = ResilientCoordClient([f"127.0.0.1:{port}"], timeout=2.0,
+                              retry_deadline=15.0, backoff_init=0.01)
+    s = CoordSession(rc, ttl=1.0)
+    s.register("/nv/adv", b"ep")
+    server.stop()
+    time.sleep(1.5)                            # outage > one TTL
+    server2 = start_server("127.0.0.1", port)  # fresh empty store
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        rec = rc.get("/nv/adv")
+        if rec is not None:
+            break
+        time.sleep(0.1)
+    assert rc.get("/nv/adv") is not None, \
+        "session must re-register on the amnesiac server"
+    assert not s.is_stopped
+    s.close()
+    rc.close()
+    server2.stop()
+
+
+def test_advert_modules_share_one_session(memkv):
+    from edl_tpu.gateway import fleet
+    from edl_tpu.memstate import advert as mem_advert
+    from edl_tpu.obs import advert as obs_advert
+
+    s = CoordSession(memkv, ttl=5.0)
+    h1 = mem_advert.advertise(memkv, "j", "pod0", "1.2.3.4:1", session=s)
+    h2 = fleet.advertise(memkv, "j", "rep0", {"endpoint": "1.2.3.4:2"},
+                         session=s)
+    h3 = obs_advert.advertise_metrics(memkv, "j", "trainer", "1.2.3.4:3",
+                                      name="t0", session=s)
+    assert mem_advert.list_adverts(memkv, "j") == {"pod0": "1.2.3.4:1"}
+    assert "rep0" in fleet.list_replicas(memkv, "j")
+    assert "t0" in obs_advert.list_metrics_targets(memkv, "j")
+    # all three ride ONE lease
+    lease_ids = {memkv.get(k).lease_id
+                 for k in ("/edl_tpu/j/memstate/nodes/pod0",
+                           "/edl_tpu/j/serving/nodes/rep0",
+                           "/edl_tpu/j/obs/metrics/t0")}
+    assert lease_ids == {s.lease_id}
+    h2.update(b'{"endpoint": "1.2.3.4:2", "free_slots": 3}')
+    assert fleet.list_replicas(memkv, "j")["rep0"]["free_slots"] == 3
+    h1.stop()
+    assert mem_advert.list_adverts(memkv, "j") == {}
+    assert "rep0" in fleet.list_replicas(memkv, "j")  # others unaffected
+    h3.stop()
+    h2.stop()
+    s.close()
+
+
+def test_unregister_failure_retried_by_heartbeat(memkv):
+    # a delete that fails mid-blip must not leave the key pinned to the
+    # shared lease (which the session keeps refreshing forever) — the
+    # heartbeat retries the orphaned removal until it lands
+    s = CoordSession(memkv, ttl=0.4)
+    s.register("/u/a", b"1")
+    real_delete = memkv.delete
+    fails = {"n": 2}
+
+    def flaky_delete(key):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise EdlCoordError("blip")
+        return real_delete(key)
+
+    memkv.delete = flaky_delete
+    try:
+        s.unregister("/u/a")              # parked as an orphan, no raise
+        deadline = time.time() + 10
+        while time.time() < deadline and memkv.get("/u/a") is not None:
+            time.sleep(0.05)
+        assert memkv.get("/u/a") is None, \
+            "heartbeat must retry the orphaned delete"
+        assert fails["n"] == 0
+    finally:
+        memkv.delete = real_delete
+        s.close()
+
+
+def test_unregister_wins_over_racing_heal_reput(memkv):
+    # the heartbeat's heal loop snapshots _keys, then re-puts any key
+    # missing from the store; an unregister racing that window must
+    # still end with the key GONE — not re-put on the refreshed shared
+    # lease with nothing left tracking it
+    s = CoordSession(memkv, ttl=0.3)
+    s.register("/r/k", b"v")
+    real_get = memkv.get
+    in_heal = threading.Event()
+    release = threading.Event()
+
+    def gated_get(key):
+        if key == "/r/k" and not release.is_set():
+            in_heal.set()
+            release.wait(10)
+        return real_get(key)
+
+    memkv.delete("/r/k")      # swept out from under the session
+    memkv.get = gated_get
+    try:
+        assert in_heal.wait(10), "heartbeat never entered heal"
+        # heal is mid-window (sees the key missing, will re-put it);
+        # unregister must serialize behind it and delete LAST
+        t = threading.Thread(target=lambda: s.unregister("/r/k"))
+        t.start()
+        time.sleep(0.2)
+        release.set()
+        t.join(10)
+        assert not t.is_alive()
+    finally:
+        memkv.get = real_get
+        release.set()
+    deadline = time.time() + 5
+    while time.time() < deadline and real_get("/r/k") is not None:
+        time.sleep(0.05)
+    assert real_get("/r/k") is None, \
+        "unregister racing a heal re-put must still remove the key"
+    s.close()
+
+
+def test_unregister_untracked_key_is_a_noop(memkv):
+    # stop(revoke=False) called twice (a drain path and a shutdown path
+    # both releasing the same advert) must not turn the second call into
+    # an immediate store delete — and unregister of a key this session
+    # never owned must not tear down someone else's record
+    s = CoordSession(memkv, ttl=5.0)
+    s.register("/n/k", b"v")
+    s.unregister("/n/k", delete=False)     # moved to a throwaway lease
+    assert memkv.get("/n/k") is not None   # lapses at TTL, not now
+    s.unregister("/n/k", delete=False)     # double-stop: must be a no-op
+    assert memkv.get("/n/k") is not None
+    s.unregister("/n/k")                   # even delete=True: not ours anymore
+    assert memkv.get("/n/k") is not None
+    memkv.put("/n/foreign", b"x")
+    s.unregister("/n/foreign")             # never registered here
+    assert memkv.get("/n/foreign") is not None
+    s.close()
+
+
+def test_update_losing_race_to_unregister_never_puts(memkv):
+    # SessionKey.update records the new value, then puts under
+    # _op_lock; an unregister whose pop lands while the update is
+    # still waiting for that lock must win outright — the update's
+    # membership re-check skips the put instead of landing it around
+    # the delete and resurrecting an untracked advert on the refreshed
+    # shared lease
+    s = CoordSession(memkv, ttl=5.0)
+    s.register("/r/u", b"v0")
+    puts = []
+    real_put = memkv.put
+
+    def spy_put(key, value, lease_id=0):
+        puts.append((key, value))
+        return real_put(key, value, lease_id)
+
+    memkv.put = spy_put
+    try:
+        s._op_lock.acquire()          # pin both racers at the lock
+        t_upd = threading.Thread(target=lambda: s.update("/r/u", b"v1"))
+        t_upd.start()
+        deadline = time.time() + 5    # value recorded before the lock wait
+        while time.time() < deadline and s._keys["/r/u"].value != b"v1":
+            time.sleep(0.01)
+        assert s._keys["/r/u"].value == b"v1"
+        t_unr = threading.Thread(target=lambda: s.unregister("/r/u"))
+        t_unr.start()
+        deadline = time.time() + 5    # the pop precedes its lock wait
+        while time.time() < deadline and "/r/u" in s._keys:
+            time.sleep(0.01)
+        assert "/r/u" not in s._keys
+        s._op_lock.release()          # let them race in either order
+        t_upd.join(10)
+        t_unr.join(10)
+        assert not t_upd.is_alive() and not t_unr.is_alive()
+        assert memkv.get("/r/u") is None, "unregister must win"
+        assert ("/r/u", b"v1") not in puts, \
+            "an update that lost the race must skip its put"
+    finally:
+        memkv.put = real_put
+        s.close()
+
+
+def test_reregister_cancels_pending_orphaned_unregister(memkv):
+    # an unregister whose delete failed mid-blip parks the key as an
+    # orphan; re-advertising the SAME key must cancel that orphan, or
+    # the heartbeat's drain would delete the fresh advert a beat later
+    s = CoordSession(memkv, ttl=0.4)
+    s.register("/o/k", b"old")
+    real_delete = memkv.delete
+
+    def failing_delete(key):
+        raise EdlCoordError("blip")
+
+    memkv.delete = failing_delete
+    try:
+        s.unregister("/o/k")          # parked as an orphan, no raise
+    finally:
+        memkv.delete = real_delete
+    deleted = []
+
+    def spy_delete(key):
+        deleted.append(key)
+        return real_delete(key)
+
+    memkv.delete = spy_delete
+    try:
+        s.register("/o/k", b"new")    # re-advertise: cancels the orphan
+        time.sleep(1.2)               # several beats of _drain_orphans
+        assert "/o/k" not in deleted, \
+            "orphan drain deleted the re-registered advert"
+        rec = memkv.get("/o/k")
+        assert rec is not None and rec.value == b"new"
+    finally:
+        memkv.delete = real_delete
+        s.close()
+
+
+def test_failed_exclusive_seize_spawns_no_heartbeat_thread():
+    # every follower probes the leader seat each retry_period for the
+    # whole job — a failed seize must cost round trips only, not a
+    # heartbeat thread spawn + join per attempt
+    kv = MemoryKV(sweep_period=0.1)
+    winner = Register(kv, "/seat", b"w", ttl=5.0, exclusive=True)
+    for _ in range(3):
+        with pytest.raises(EdlRegisterError):
+            Register(kv, "/seat", b"l", ttl=5.0, exclusive=True)
+    seat_threads = [t for t in threading.enumerate()
+                    if t.name == "coord-session:/seat"]
+    assert len(seat_threads) == 1, "losers must not have started threads"
+    assert len(kv.dump_state()["leases"]) == 1, "losers' leases revoked"
+    assert kv.get("/seat").value == b"w"
+    winner.stop()
+    kv.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot-marked waits / replace-not-merge watchers
+# ---------------------------------------------------------------------------
+
+def test_wait_compaction_result_is_marked_snapshot(memkv):
+    memkv.put("/s/live", b"v")
+    for i in range(5000):
+        memkv.put("/junk/k", str(i).encode())
+    res = memkv.wait("/s/", 0, timeout=0.5)
+    assert res.snapshot is True
+    assert [e.record.key for e in res.events] == ["/s/live"]
+    # an in-log wait stays incremental
+    res2 = memkv.wait("/s/", res.revision, timeout=0.1)
+    assert res2.snapshot is False
+
+
+def test_wait_snapshot_flag_crosses_the_wire(coord_client):
+    coord_client.put("/w/live", b"v")
+    for i in range(5000):
+        coord_client.put("/junk/k", str(i).encode())
+    res = coord_client.wait("/w/", 0, timeout=1.0)
+    assert res.snapshot is True
+    assert any(e.record.key == "/w/live" for e in res.events)
+
+
+def test_prefix_watcher_learns_deletes_across_compaction(memkv):
+    """The satellite fix: a watcher whose revision fell out of the event
+    log must not keep a phantom key — the snapshot resync REPLACES its
+    view, surfacing the compacted-away delete as a synthetic event."""
+    memkv.put("/pw/a", b"1")
+    memkv.put("/pw/b", b"2")
+    seen: list = []
+    w = PrefixWatcher(memkv, "/pw/", lambda evs: seen.extend(evs),
+                      period=0.5)
+    # mutate BEFORE the watcher's first poll, then blow out the log so
+    # its since_revision predates every buffered event
+    memkv.delete("/pw/a")
+    for i in range(5000):
+        memkv.put("/junk/k", str(i).encode())
+    w.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not any(
+            e.type == "delete" and e.record.key == "/pw/a" for e in seen):
+        time.sleep(0.05)
+    w.stop()
+    assert any(e.type == "delete" and e.record.key == "/pw/a"
+               for e in seen), f"phantom key never deleted: {seen}"
+    assert any(e.type == "put" and e.record.key == "/pw/b" for e in seen)
+
+
+def test_wait_after_wal_restart_serves_snapshot_to_old_watcher(tmp_path):
+    """After a restart the event log is empty but the revision counter
+    is restored: an old watcher must get a snapshot resync, not hang."""
+    d = str(tmp_path / "coord")
+    kv = open_durable(d)
+    kv.put("/ws/a", b"1")
+    rev_then = kv.put("/ws/b", b"2")
+    kv.delete("/ws/b")
+    kv.close()
+    kv2 = open_durable(d)
+    res = kv2.wait("/ws/", rev_then - 1, timeout=1.0)
+    assert res.snapshot is True
+    assert [e.record.key for e in res.events] == ["/ws/a"]
+    kv2.close()
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_faults():
+    yield
+    faultinject.configure(None)
+
+
+def test_faultinject_parse_grammar():
+    rules = faultinject.parse("kv_put:error:0.3;connect:delay:1.5;"
+                              "server:wait:delay:0.2:0.5")
+    assert rules[0].point == "kv_put" and rules[0].action == "error" \
+        and rules[0].prob == 0.3 and rules[0].side is None
+    assert rules[1].action == "delay" and rules[1].arg == 1.5 \
+        and rules[1].prob == 1.0
+    assert rules[2].side == "server" and rules[2].prob == 0.5
+    for bad in ("nope", "a:b:c", "kv_put:error:2.0", "kv_put:explode:1",
+                "kv_put:error:1.0:0.3"):  # error takes ONE number
+        with pytest.raises(faultinject.FaultSpecError):
+            faultinject.parse(bad)
+    assert faultinject.parse("") == []
+
+
+def test_faultinject_error_fires_and_counts(clean_faults):
+    from edl_tpu.utils.faultinject import _INJECTED
+    faultinject.configure("kv_put:error:1.0", seed=7)
+    before = _INJECTED.labels(point="kv_put", action="error").value
+    with pytest.raises(EdlCoordError):
+        faultinject.fire("kv_put")
+    assert _INJECTED.labels(point="kv_put", action="error").value == before + 1
+    faultinject.fire("kv_get")                 # other points untouched
+
+
+def test_faultinject_probability_is_seeded(clean_faults):
+    faultinject.configure("kv_put:error:0.5", seed=123)
+    outcomes1 = []
+    for _ in range(20):
+        try:
+            faultinject.fire("kv_put")
+            outcomes1.append(False)
+        except EdlCoordError:
+            outcomes1.append(True)
+    faultinject.configure("kv_put:error:0.5", seed=123)
+    outcomes2 = []
+    for _ in range(20):
+        try:
+            faultinject.fire("kv_put")
+            outcomes2.append(False)
+        except EdlCoordError:
+            outcomes2.append(True)
+    assert outcomes1 == outcomes2, "seeded runs must reproduce"
+    assert any(outcomes1) and not all(outcomes1)
+
+
+def test_faultinject_client_side_hits_rpc_path(coord_server, clean_faults):
+    client = CoordClient(f"127.0.0.1:{coord_server.port}")
+    faultinject.configure("client:kv_put:error:1.0")
+    with pytest.raises(EdlCoordError, match="injected"):
+        client.put("/fi/k", b"v")
+    faultinject.configure(None)
+    assert client.put("/fi/k", b"v") > 0
+    client.close()
+
+
+def test_faultinject_server_side_crosses_wire_as_retryable(coord_server,
+                                                          clean_faults):
+    client = CoordClient(f"127.0.0.1:{coord_server.port}")
+    faultinject.configure("server:kv_get:error:1.0")
+    with pytest.raises(EdlCoordError, match="injected"):
+        client.get("/fi/k")
+    assert client.put("/fi/other", b"v") > 0   # only kv_get is poisoned
+    client.close()
+
+
+def test_faultinject_delay(coord_server, clean_faults):
+    client = CoordClient(f"127.0.0.1:{coord_server.port}")
+    faultinject.configure("client:kv_put:delay:0.3")
+    t0 = time.monotonic()
+    client.put("/fi/slow", b"v")
+    assert time.monotonic() - t0 >= 0.3
+    client.close()
+
+
+def test_resilient_client_heals_injected_faults(coord_server, clean_faults):
+    """The harness proves the healing stack end to end: a 50% kv_put
+    error rate must be invisible above ResilientCoordClient."""
+    faultinject.configure("client:kv_put:error:0.5", seed=42)
+    rc = ResilientCoordClient([f"127.0.0.1:{coord_server.port}"],
+                              retry_deadline=30.0, backoff_init=0.01)
+    for i in range(20):
+        assert rc.put(f"/heal/{i}", b"v") > 0
+    rc.close()
+
+
+# ---------------------------------------------------------------------------
+# retry backoff satellite
+# ---------------------------------------------------------------------------
+
+def test_retry_backoff_and_counter(monkeypatch):
+    from edl_tpu.utils.retry import _ATTEMPTS, retry_until_timeout
+
+    sleeps: list = []
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    @retry_until_timeout(interval=0.1, backoff=2.0, max_interval=0.5,
+                         jitter=False)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise EdlCoordError("blip")
+        return "ok"
+
+    before = _ATTEMPTS.labels(fn="flaky").value
+    assert flaky(timeout=60.0) == "ok"
+    assert _ATTEMPTS.labels(fn="flaky").value == before + 4
+    assert sleeps == [0.1, 0.2, 0.4, 0.5]      # exponential, capped
+
+
+def test_retry_jitter_bounded(monkeypatch):
+    from edl_tpu.utils.retry import retry_until_timeout
+
+    sleeps: list = []
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    @retry_until_timeout(interval=0.2, backoff=2.0, jitter=True)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise EdlCoordError("blip")
+        return "ok"
+
+    assert flaky(timeout=60.0) == "ok"
+    assert len(sleeps) == 3
+    for s, cap in zip(sleeps, (0.2, 0.4, 0.8)):
+        assert 0.0 <= s <= cap
+
+
+def test_retry_jitter_applies_without_backoff(monkeypatch):
+    # jitter=True must fan out even at the legacy fixed interval
+    # (backoff=1.0) — a whole job retrying at exactly 1 s is the
+    # synchronized stampede the knob exists to prevent
+    from edl_tpu.utils.retry import retry_until_timeout
+
+    monkeypatch.setattr("random.uniform", lambda a, b: 0.123)
+    sleeps: list = []
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    @retry_until_timeout(interval=1.0, jitter=True)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise EdlCoordError("blip")
+        return "ok"
+
+    assert flaky(timeout=60.0) == "ok"
+    assert sleeps == [0.123, 0.123]
