@@ -323,6 +323,17 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- data-plane leader outage: recovery time + exactly-once (ISSUE 7) ----
+    # kill the leader DataService mid-epoch, rebuild a successor from
+    # the coord-store journal, reader reattaches and finishes: how long
+    # the data plane stalls, and the records-trained-exactly-once proof
+    if os.environ.get("EDL_TPU_BENCH_DATA", "1") != "0":
+        try:
+            out.update(_bench_data_outage())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -458,6 +469,92 @@ def _bench_coord_outage() -> dict:
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+def _bench_data_outage() -> dict:
+    """Data-plane leader recovery microbench: a journaled DataService
+    is killed mid-epoch and a successor rebuilds from the coord-store
+    journal while a live DistributedReader reattaches.  Reported:
+
+    - ``data_leader_mttr_s`` — leader gone to the reader's next
+      successfully delivered batch (the data plane's stall window);
+    - ``data_records_total`` / ``data_records_exactly_once`` — the
+      exactly-once audit over the epoch's raw span log (a duplicate or
+      a drop would make these differ)."""
+    import tempfile
+    import threading
+
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.data import DistributedReader, PodDataServer
+    from edl_tpu.data.data_server import DataService
+    from edl_tpu.data.journal import DataJournal
+    from edl_tpu.rpc.server import RpcServer
+
+    n_files = int(os.environ.get("EDL_TPU_BENCH_DATA_FILES", 8))
+    per_file = int(os.environ.get("EDL_TPU_BENCH_DATA_RECORDS", 40))
+    data_dir = tempfile.mkdtemp(prefix="edl-bench-data-")
+    for f in range(n_files):
+        with open(os.path.join(data_dir, f"part-{f}.txt"), "w") as fh:
+            fh.writelines(f"f{f}r{r}\n" for r in range(per_file))
+    files = sorted(os.path.join(data_dir, f) for f in os.listdir(data_dir))
+
+    def serve(journal):
+        srv = RpcServer("127.0.0.1", 0)
+        srv.register_instance(DataService(journal=journal,
+                                          rebuild_grace=0.5))
+        srv.start()
+        return srv, f"127.0.0.1:{srv.port}"
+
+    kv = MemoryKV()
+    journal = DataJournal(kv, "bench")
+    srv1, ep1 = serve(journal)
+    endpoint = {"ep": ep1}
+    cache = PodDataServer("bench-pod")
+    spans: list = []
+    failover_done: list[float] = []
+    killed = threading.Event()
+    srv2 = None
+    try:
+        # meta_prefetch=1: every batch costs one leader round trip, so
+        # the first post-kill batch really measures reattach + rebuild
+        reader = DistributedReader("bench@e0", "bench-pod",
+                                   lambda: endpoint["ep"], cache,
+                                   batch_size=8, retry_deadline=60.0,
+                                   meta_prefetch=1)
+        reader.create(files)
+        it = iter(reader)
+        kill_after = (n_files * per_file) // (8 * 3)  # ~1/3 of the epoch
+        for i, (_bid, payload) in enumerate(it):
+            spans.extend(payload["spans"])
+            if i == kill_after:
+                srv1.stop()
+                killed.set()
+                t_kill = time.perf_counter()
+                srv2, ep2 = serve(journal)
+                endpoint["ep"] = ep2
+            elif killed.is_set() and not failover_done:
+                failover_done.append(time.perf_counter() - t_kill)
+        counts: dict = {}
+        for f, b, e in spans:
+            for r in range(b, e):
+                counts[(f, r)] = counts.get((f, r), 0) + 1
+        total = n_files * per_file
+        exact = sum(1 for c in counts.values() if c == 1)
+        if len(counts) != total:
+            raise RuntimeError(
+                f"audit failed: {len(counts)} distinct records != {total}")
+        return {"data_leader_mttr_s": round(failover_done[0], 3),
+                "data_records_total": total,
+                "data_records_exactly_once": exact}
+    finally:
+        cache.stop()
+        for s in (srv1, srv2):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+        kv.close()
 
 
 def _bench_transfer() -> dict:

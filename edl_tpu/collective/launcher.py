@@ -110,8 +110,16 @@ class Launcher:
         # the distributed data service rides the launcher's RPC server on
         # EVERY pod (inert until addressed; trainers talk to the current
         # leader's), so its work-queue state survives trainer stop-resume
-        # — the integration the reference's WIP data server never had
-        self._data_service = DataService()
+        # — the integration the reference's WIP data server never had.
+        # With the journal (default on) every generation mutation also
+        # lands in the durable coord store, so a pod that BECOMES the
+        # addressed leader rebuilds live generations minus consumed
+        # spans and reattaching readers keep their epoch
+        journal = None
+        if constants.DATA_JOURNAL:
+            from edl_tpu.data.journal import DataJournal
+            journal = DataJournal(self._store, job_id)
+        self._data_service = DataService(journal=journal)
         self._server.register_instance(self._data_service)
         # the peer checkpoint cache rides the same server for the same
         # reason: the launcher outlives every trainer kill, so the

@@ -19,17 +19,30 @@ in data_server.py).  Three roles in one object:
   resumed job (any world size) re-creates the reader generation from
   it — exactly-once across stop-resume (reference data_filter.py
   stub + state.py:25-31, finished here).
+
+Every leader call rides a :class:`ResilientDataClient`: transport
+blips are retried with backoff + jitter under a deadline budget, a
+leader failover/restart re-resolves the endpoint and runs the
+**reattach handshake** — re-asserting this reader's consumed/claimed
+spans, unacked in-flight batch ids, and the producer's current file
+grant on the successor — and "generation gone" (successor with no
+journal) re-seeds the generation the same way.  The producer and
+consumer loops therefore only ever see three terminal outcomes:
+end-of-data (``EdlStopIteration``), a generation-fatal producer error
+(``EdlDataError``), or a leader unreachable past the whole retry
+budget.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Iterator
+from typing import Callable, Iterator
 
 from edl_tpu.cluster.state import DataCheckpoint
-from edl_tpu.data.data_server import PodDataServer, in_spans
+from edl_tpu.data.data_server import PodDataServer, in_spans, merge_span
 from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
+from edl_tpu.data.resilient import ResilientDataClient
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils.exceptions import EdlError, EdlStopIteration, EdlTableError
 from edl_tpu.utils.logger import get_logger
@@ -39,14 +52,19 @@ logger = get_logger(__name__)
 
 class DistributedReader:
     def __init__(self, reader_name: str, pod_id: str,
-                 leader_endpoint: str, data_server: PodDataServer,
+                 leader_endpoint: "str | Callable[[], str]",
+                 data_server: PodDataServer,
                  batch_size: int = 32,
                  splitter: FileSplitter | None = None,
                  checkpoint: DataCheckpoint | None = None,
-                 meta_prefetch: int = 4, mark_on_yield: bool = True):
+                 meta_prefetch: int = 4, mark_on_yield: bool = True,
+                 retry_deadline: float | None = None):
         self.name = reader_name
         self.pod_id = pod_id
-        self._leader = RpcClient(leader_endpoint)
+        self._leader = ResilientDataClient(
+            leader_endpoint, on_reattach=self._do_reattach,
+            retry_deadline=retry_deadline,
+            name=f"{reader_name}/{pod_id[:8]}")
         self._server = data_server
         self._bs = batch_size
         self._splitter = splitter or TxtFileSplitter()
@@ -63,17 +81,82 @@ class DistributedReader:
         self._backpressure = 128
         self._produce_exc: BaseException | None = None
         self._stop_produce = threading.Event()
+        self._producer: threading.Thread | None = None
         self._peer_clients: dict[str, RpcClient] = {}
+        self._closed = False
+        # -- reattach state (all guarded by _state_lock): what this
+        # reader would need to re-establish itself on a successor leader
+        self._state_lock = threading.Lock()
+        self._files: list[str] = []
+        self._held: set[str] = set()          # fetched/yielded, unacked
+        self._claimed: dict[int, list[list[int]]] = {}  # spans we own
+        self._finished_files: list[int] = []
+        self._producing: list | None = None   # [file_idx, only] in flight
+        self._abandon_produce = threading.Event()
 
     def create(self, files: list[str]) -> "DistributedReader":
         """Create/join this reader's generation on the leader, seeding it
         with this pod's restored checkpoint spans (identical across pods
         — every pod restores the same shared checkpoint)."""
-        consumed = [[r.file_idx, r.begin, r.end]
-                    for r in self.checkpoint.processed]
+        with self._state_lock:
+            self._files = list(files)
         self._leader.call("create_reader", reader=self.name, files=files,
-                          consumed=consumed)
+                          consumed=self._checkpoint_spans())
         return self
+
+    def _checkpoint_spans(self) -> list[list[int]]:
+        return [[r.file_idx, r.begin, r.end]
+                for r in self.checkpoint.processed]
+
+    # -- reattach ------------------------------------------------------------
+    def _do_reattach(self, raw_call) -> None:
+        """Handshake run by the resilient client after a leader
+        failover/restart (or "generation gone"): merge what this reader
+        owns back into the (possibly re-seeded) generation and reclaim
+        its in-flight work.  Replay-idempotent by construction."""
+        with self._state_lock:
+            if not self._files:
+                return  # create() not yet called: nothing to re-assert
+            consumed: dict[int, list[list[int]]] = {
+                fi: [list(s) for s in spans]
+                for fi, spans in self._claimed.items()}
+            held = sorted(self._held)
+            producing = list(self._producing) if self._producing else None
+            finished = list(self._finished_files)
+            files = list(self._files)
+        for fi, b, e in self._checkpoint_spans():
+            merge_span(consumed.setdefault(fi, []), b, e)
+        resp = raw_call(
+            "reattach_reader", reader=self.name, pod_id=self.pod_id,
+            endpoint=self._server.endpoint, files=files,
+            consumed=[[fi, b, e] for fi, spans in sorted(consumed.items())
+                      for b, e in spans],
+            held=held, producing=producing, finished=finished)
+        dropped = resp.get("drop") or []
+        with self._state_lock:
+            for bid in dropped:
+                self._held.discard(bid)
+        if dropped:
+            logger.warning(
+                "reader %s: leader dropped %d unrestorable in-flight "
+                "batches on reattach (their spans ride our consumed set)",
+                self.name, len(dropped))
+        if resp.get("abandon_file"):
+            # our in-flight file was re-granted elsewhere: stop emitting
+            # it (the producer loop checks this between records)
+            self._abandon_produce.set()
+        logger.info("reader %s: reattached to leader %s (%d held, "
+                    "producing=%s)", self.name, self._leader.endpoint,
+                    len(held), producing)
+
+    def _claim(self, spans: list) -> None:
+        """Record spans this reader now owns (fetched + will train):
+        they ride every reattach so a re-seeded generation never
+        re-produces them."""
+        with self._state_lock:
+            for file_idx, b, e in spans:
+                merge_span(self._claimed.setdefault(int(file_idx), []),
+                           int(b), int(e))
 
     # -- producer ------------------------------------------------------------
     def _produce(self) -> None:
@@ -91,7 +174,20 @@ class DistributedReader:
                 file_idx, path = assignment["file"]
                 skip = assignment["skip"]
                 only = assignment.get("only")
-                seq = self._produce_file(int(file_idx), path, skip, only, seq)
+                self._abandon_produce.clear()
+                with self._state_lock:
+                    # [file_idx, only, position]: position is a
+                    # conservative upper bound of records this producer
+                    # has (or is about to have) published — a re-seeded
+                    # successor repairs [0, position) since the old
+                    # leader's metas died with it
+                    self._producing = [int(file_idx), only, 0]
+                try:
+                    seq = self._produce_file(int(file_idx), path, skip, only,
+                                             seq)
+                finally:
+                    with self._state_lock:
+                        self._producing = None
         except BaseException as e:  # noqa: BLE001 — surfaced by consumer
             self._produce_exc = e
 
@@ -108,6 +204,16 @@ class DistributedReader:
             begin = None
             record_no = -1
             for record_no, record in self._splitter.split(path):
+                if self._abandon_produce.is_set():
+                    # the leader re-granted this file elsewhere while we
+                    # were partitioned: stop emitting, report nothing —
+                    # the new owner covers the remainder
+                    logger.warning("reader %s: abandoning file %d "
+                                   "mid-production (re-granted elsewhere)",
+                                   self.name, file_idx)
+                    return seq
+                if self._stop_produce.is_set():
+                    return seq
                 if (only is not None and not in_spans(only, record_no)) or \
                         in_spans(skip, record_no) or \
                         self.checkpoint.is_processed(file_idx, record_no):
@@ -120,14 +226,18 @@ class DistributedReader:
                 batch.append(record)
                 if len(batch) == self._bs:
                     spans.append([file_idx, begin, record_no + 1])
+                    self._note_position(record_no + 1)
                     seq = self._publish(seq, batch, spans)
                     batch, spans, begin = [], [], None
             if begin is not None:
                 spans.append([file_idx, begin, record_no + 1])
             if batch:
+                self._note_position(record_no + 1)
                 seq = self._publish(seq, batch, spans)
             self._leader.call("file_done", reader=self.name,
                               pod_id=self.pod_id, file_idx=file_idx)
+            with self._state_lock:
+                self._finished_files.append(file_idx)
             return seq
         except EdlError:
             raise  # leader unreachable etc. — not a file problem
@@ -139,6 +249,15 @@ class DistributedReader:
             except Exception:  # noqa: BLE001
                 pass
             raise
+
+    def _note_position(self, position: int) -> None:
+        """Advance the in-flight grant's published-records bound —
+        BEFORE the publish, so a crash mid-publish still repairs the
+        batch on a re-seeded leader (the retried publish makes its
+        records live, which the repair's grant-time skip then covers)."""
+        with self._state_lock:
+            if self._producing is not None:
+                self._producing[2] = max(self._producing[2], position)
 
     def _publish(self, seq: int, batch: list, spans: list) -> int:
         batch_id = f"{self.pod_id}:{self.name}:{seq}"
@@ -160,25 +279,35 @@ class DistributedReader:
 
     # -- consumer ------------------------------------------------------------
     def __iter__(self) -> Iterator[tuple[str, list]]:
-        producer = threading.Thread(target=self._produce, daemon=True,
-                                    name=f"produce:{self.name}")
-        producer.start()
+        self._producer = threading.Thread(target=self._produce, daemon=True,
+                                          name=f"produce:{self.name}")
+        self._producer.start()
         ack_ids: list[str] = []
+        req_id = 0
         try:
             while True:
                 try:
+                    # req_id makes the hand-out replay-safe: a RETRY of
+                    # this call (same id) whose first response was lost
+                    # gets the SAME metas back instead of stranding
+                    # them in our server-side inflight
+                    req_id += 1
                     metas = self._leader.call(
                         "get_batch_meta", reader=self.name,
                         pod_id=self.pod_id, n=self._prefetch,
-                        ack_ids=ack_ids)["metas"]
+                        ack_ids=ack_ids, req_id=req_id)["metas"]
                 except EdlStopIteration:
                     break
+                with self._state_lock:
+                    self._held.difference_update(ack_ids)
                 ack_ids = []
                 if not metas:
                     if self._produce_exc is not None:
                         raise self._produce_exc
                     time.sleep(0.05)
                     continue
+                with self._state_lock:
+                    self._held.update(m[2] for m in metas)
                 nacks: dict[bool, list[str]] = {True: [], False: []}
                 for producer_pod, endpoint, batch_id, spans in metas:
                     payload, failure = self._fetch(producer_pod, endpoint,
@@ -189,6 +318,7 @@ class DistributedReader:
                         # just this batch's spans
                         nacks[failure == "dead"].append(batch_id)
                         continue
+                    self._claim(payload["spans"])
                     if self._mark_on_yield:
                         for file_idx, begin, end in payload["spans"]:
                             self.checkpoint.mark_processed(file_idx, begin, end)
@@ -201,14 +331,38 @@ class DistributedReader:
                         self._leader.call("nack_batches", reader=self.name,
                                           pod_id=self.pod_id, batch_ids=ids,
                                           producer_dead=dead)
+                        with self._state_lock:
+                            self._held.difference_update(ids)
             if self._produce_exc is not None:
                 raise self._produce_exc
         finally:
-            self._stop_produce.set()
-            producer.join(timeout=5.0)
-            for c in self._peer_clients.values():
-                c.close()
-            self._leader.close()
+            self.close()
+
+    def close(self, deadline: float = 5.0) -> None:
+        """Shut the reader down within ``deadline`` seconds.
+
+        The stop flag is set *and* the leader client's in-flight retry
+        loops are capped by the deadline before the producer join, so a
+        producer thread blocked in a leader call unwinds instead of
+        outliving the join; a thread that still won't die (e.g. wedged
+        in a kernel recv) is logged — never silently leaked."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_produce.set()
+        self._leader.close_after(deadline)
+        producer = self._producer
+        if producer is not None and producer.is_alive():
+            producer.join(timeout=deadline)
+            if producer.is_alive():
+                logger.warning(
+                    "reader %s: producer thread still blocked in an "
+                    "in-flight leader call after the %.1fs close deadline; "
+                    "abandoning it (daemon thread, call timeout capped)",
+                    self.name, deadline)
+        for c in self._peer_clients.values():
+            c.close()
+        self._leader.close()
 
     def _fetch(self, producer_pod: str, endpoint: str, batch_id: str,
                ) -> tuple[dict | None, str | None]:
@@ -237,6 +391,6 @@ class DistributedReader:
             except EdlError as e:  # transport failure
                 logger.warning("fetch %s from %s failed (try %d/3): %s",
                                batch_id, endpoint, attempt + 1, e)
-                if attempt < 2:
+                if attempt < 2 and not self._closed:
                     time.sleep(1.0 * (attempt + 1))
         return None, "dead"

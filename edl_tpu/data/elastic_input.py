@@ -120,11 +120,18 @@ class ElasticInput:
         self._distributed = distributed
         self.server = PodDataServer(pod_id, cache_cap=cache_cap)
 
-    def _leader_endpoint(self, cluster: Cluster) -> str:
-        leader = cluster.leader
-        if leader is None:
-            raise EdlDataError("cluster has no pods")
-        return leader.endpoint
+    def _leader_resolver(self):
+        """Resolver handed to the reader's resilient client: the leader
+        endpoint is re-read from the CURRENT cluster record on every
+        failover, so a blipped leader that came back — or a successor
+        hosting the rebuilt DataService — is found without restarting
+        the epoch."""
+        def resolve() -> str:
+            cluster = Cluster.load_from_store(self._store, self._job_id)
+            if cluster is None or cluster.leader is None:
+                raise EdlDataError("cluster has no pods")
+            return cluster.leader.endpoint
+        return resolve
 
     def epoch(self, epoch: int, checkpoint: DataCheckpoint,
               ) -> Iterator[dict]:
@@ -139,16 +146,19 @@ class ElasticInput:
         checkpoint.reader_name = name
         reg = registry.register_reader(self._store, self._job_id, name,
                                        self._pod_id, self.server.endpoint)
+        reader = None
         try:
             registry.wait_dist_readers(self._store, self._job_id, name,
                                        cluster.pod_ids())
             reader = DistributedReader(
-                name, self._pod_id, self._leader_endpoint(cluster),
+                name, self._pod_id, self._leader_resolver(),
                 self.server, batch_size=self._bs, splitter=self._splitter,
                 checkpoint=checkpoint, mark_on_yield=False)
             reader.create(self._files)
             yield from self._batches(reader)
         finally:
+            if reader is not None:
+                reader.close()
             reg.stop()
 
     # -- the re-chunk + agreement loop ---------------------------------------

@@ -1,0 +1,209 @@
+"""Standalone elected data-service leader.
+
+Under the elastic launcher the :class:`DataService` rides every pod's
+launcher RPC server and trainers address the *cluster* leader's
+instance.  This module hosts the same service behind its own
+**exclusive coord-store seat** — elected exactly like the cluster
+leader (lease-guarded put-if-absent, TTL failover) — for deployments
+where the data plane outlives any one trainer world: the chaos smoke,
+standalone reader fleets, and the future shard-streaming tier.
+
+- the seat key's *value is the winner's RPC endpoint*, so election and
+  discovery are one record: readers resolve the leader with
+  :func:`resolve_data_leader` (their resilient client re-resolves it
+  on every failure, which is the whole failover story);
+- the winner's service carries the coord-store **journal**, so a
+  successor seizing the seat after a SIGKILL rebuilds every live
+  generation minus consumed spans and readers reattach without
+  restarting the epoch;
+- the winner watches the reader **registry** prefix: a pod whose
+  TTL-leased advert expires (SIGKILL, partition past one TTL) is
+  marked dead — its files and unconsumed batches requeue minus the
+  consumed union, which is how a producer kill mid-epoch heals with
+  no operator in the loop.
+
+``python -m edl_tpu.data.leader --coord_endpoints ... --job_id ...``
+runs a candidate: it contends forever, serves while it holds the seat,
+and goes back to contending if the seat is lost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.register import Register
+from edl_tpu.data.data_server import DataService
+from edl_tpu.data.journal import DataJournal
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlRegisterError, EdlRetryableError
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import local_ip
+
+logger = get_logger(__name__)
+
+_SEAT = "data_leader"
+
+
+def _seat_key(job_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_POD_RANK, _SEAT)
+
+
+def resolve_data_leader(store, job_id: str) -> str:
+    """Current data-leader endpoint (the seat's value); raises when no
+    leader holds the seat — resilient callers retry, which is exactly
+    the failover window."""
+    rec = store.get(_seat_key(job_id))
+    if rec is None or not rec.value:
+        from edl_tpu.utils.exceptions import EdlCoordError
+        raise EdlCoordError(f"no data leader seated for job {job_id}")
+    return rec.value.decode()
+
+
+class DataLeaderHost:
+    """One election candidate.  ``run()`` loops: contend for the seat,
+    serve the journaled DataService while held, stand down on loss."""
+
+    def __init__(self, store, job_id: str, host: str | None = None,
+                 port: int = 0, ttl: float = constants.ETCD_TTL,
+                 rebuild_grace: float | None = None,
+                 retry_period: float = 0.5):
+        self._store = store
+        self._job_id = job_id
+        self._host = host
+        self._port = port
+        self._ttl = ttl
+        self._grace = rebuild_grace
+        self._retry_period = retry_period
+        self._halt = threading.Event()
+        self._journal: DataJournal | None = None
+        self.service: DataService | None = None
+        self.endpoint: str | None = None
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    # -- one leadership term -------------------------------------------------
+    def _serve_term(self, register: Register, server: RpcServer) -> None:
+        watcher = None
+        try:
+            # registry watch: a reader advert expiring (pod SIGKILLed,
+            # partitioned past one TTL) requeues the pod's work.  The
+            # generation is parsed back out of the advert key
+            # (<reader>/<pod_id>)
+            prefix = paths.table_prefix(self._job_id, constants.ETCD_READER)
+
+            def on_events(events):
+                for ev in events:
+                    if ev.type != "delete":
+                        continue
+                    rel = ev.record.key[len(prefix):]
+                    if "/" not in rel:
+                        continue
+                    reader, pod_id = rel.rsplit("/", 1)
+                    logger.warning("reader advert %s/%s expired; marking "
+                                   "pod dead", reader, pod_id[:8])
+                    try:
+                        self.service.mark_pod_dead(pod_id, reader=reader)
+                    except Exception:  # noqa: BLE001 — keep watching
+                        logger.exception("mark_pod_dead failed")
+
+            try:
+                watcher = self._store.watch_prefix(prefix, on_events,
+                                                   period=2.0)
+            except Exception:  # noqa: BLE001 — degraded: no expiry watch
+                logger.exception("registry watch unavailable; dead pods "
+                                 "heal via consumer nacks only")
+            # reconcile journaled generations against the adverts as
+            # they are NOW: a pod that died before this term's watch
+            # started never fires a delete event, and its rebuilt
+            # grants would pin the generation open forever
+            try:
+                from edl_tpu.data.registry import load_readers
+                for gen_name in self._journal.list_readers():
+                    live = list(load_readers(self._store, self._job_id,
+                                             gen_name))
+                    try:
+                        self.service.reconcile_pods(gen_name, live)
+                    except Exception:  # noqa: BLE001 — torn/empty gen
+                        logger.exception("reconcile of %s failed", gen_name)
+            except Exception:  # noqa: BLE001 — store blip: nacks heal
+                logger.exception("seat-time registry reconcile failed")
+            while not self._halt.is_set() and not register.is_stopped:
+                self._halt.wait(self._retry_period)
+        finally:
+            if watcher is not None:
+                watcher.stop()
+
+    def run(self) -> None:
+        key = _seat_key(self._job_id)
+        while not self._halt.is_set():
+            server = RpcServer(host="0.0.0.0", port=self._port)
+            self._journal = DataJournal(self._store, self._job_id)
+            service = DataService(journal=self._journal,
+                                  rebuild_grace=self._grace)
+            server.register_instance(service)
+            server.start()
+            endpoint = f"{self._host or local_ip()}:{server.port}"
+            register = None
+            try:
+                while not self._halt.is_set() and register is None:
+                    try:
+                        register = Register(self._store, key,
+                                            endpoint.encode(), ttl=self._ttl,
+                                            exclusive=True)
+                    except EdlRegisterError:
+                        self._halt.wait(self._retry_period)  # seat held
+                    except EdlRetryableError as e:
+                        logger.warning("seat seize attempt failed "
+                                       "(transient): %s", e)
+                        self._halt.wait(self._retry_period)
+                if register is None:
+                    return  # halted while contending
+                self.service, self.endpoint = service, endpoint
+                logger.info("data leader seated at %s (job %s)", endpoint,
+                            self._job_id)
+                print(f"data leader serving on {endpoint}", flush=True)
+                self._serve_term(register, server)
+                if not self._halt.is_set():
+                    logger.warning("data leader seat lost; standing down "
+                                   "and re-contending")
+            finally:
+                self.service, self.endpoint = None, None
+                if register is not None:
+                    register.stop()  # frees the seat (no-op if lost)
+                server.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Standalone elected data-service leader")
+    p.add_argument("--coord_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--ttl", type=float, default=constants.ETCD_TTL)
+    p.add_argument("--rebuild_grace", type=float, default=None)
+    args = p.parse_args(argv)
+
+    from edl_tpu.coord.client import connect_wait
+    from edl_tpu.utils.logger import configure
+    configure()
+    store = connect_wait(args.coord_endpoints)
+    host = DataLeaderHost(store, args.job_id, host=args.host, port=args.port,
+                          ttl=args.ttl, rebuild_grace=args.rebuild_grace)
+    try:
+        host.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        host.stop()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,7 @@ vanish with their pods, and table sweeps at job cleanup cover the rest.
 from __future__ import annotations
 
 import json
+import random
 import time
 
 from edl_tpu.cluster import paths
@@ -39,15 +40,21 @@ def register_reader(store, job_id: str, reader: str, pod_id: str,
     return Register(store, _reader_key(job_id, reader, pod_id), meta, ttl=ttl)
 
 
-def load_readers(store, job_id: str, reader: str) -> dict[str, str]:
-    """{pod_id: endpoint} registered for ``reader``."""
+def _scan_readers(store, job_id: str, reader: str,
+                  ) -> tuple[dict[str, str], int]:
+    """({pod_id: endpoint}, store revision) for ``reader``'s adverts."""
     prefix = paths.key(job_id, constants.ETCD_READER, f"{reader}/")
-    recs, _rev = store.get_prefix(prefix)
+    recs, rev = store.get_prefix(prefix)
     out = {}
     for rec in recs:
         meta = json.loads(rec.value.decode())
         out[meta["pod_id"]] = meta["endpoint"]
-    return out
+    return out, rev
+
+
+def load_readers(store, job_id: str, reader: str) -> dict[str, str]:
+    """{pod_id: endpoint} registered for ``reader``."""
+    return _scan_readers(store, job_id, reader)[0]
 
 
 def wait_dist_readers(store, job_id: str, reader: str, pod_ids: list[str],
@@ -56,15 +63,39 @@ def wait_dist_readers(store, job_id: str, reader: str, pod_ids: list[str],
     """Block until the reader set equals the cluster pod set (reference
     check_dist_readers, reader.py:70-99); returns {pod_id: endpoint}.
     Raises EdlDataError on timeout — a pod that never registers means
-    the data plane can't serve this epoch."""
+    the data plane can't serve this epoch.
+
+    Uses the store's ``wait`` long-poll (a coord-store *watch*), so
+    epoch entry reacts to the last pod's registration in milliseconds
+    instead of a poll tick; against a store whose watch path errors
+    (old server, blip) it degrades to jittered-backoff polling —
+    ``period`` is the first poll interval, doubling (with full jitter)
+    up to 2 s so a big job's pods don't stampede the store in lockstep."""
     want = set(pod_ids)
+    prefix = paths.key(job_id, constants.ETCD_READER, f"{reader}/")
     deadline = time.monotonic() + timeout
+    delay = period
+    watch_ok = True
     while True:
-        got = load_readers(store, job_id, reader)
+        got, rev = _scan_readers(store, job_id, reader)
         if set(got) >= want:
             return {p: got[p] for p in want}
-        if time.monotonic() >= deadline:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
             raise EdlDataError(
                 f"reader {reader}: registered {sorted(got)} != cluster "
                 f"{sorted(want)} after {timeout:.0f}s")
-        time.sleep(period)
+        if watch_ok:
+            try:
+                # returns as soon as ANYTHING changes under the prefix
+                # (or after the slice) — then re-check the full set
+                store.wait(prefix, rev, min(remaining, 2.0))
+                delay = period
+                continue
+            except NotImplementedError:
+                watch_ok = False  # backend has no watch: poll forever
+            except Exception as e:  # noqa: BLE001 — blip: poll this round
+                logger.debug("reader-registry watch failed (%s); polling "
+                             "this round", e)
+        time.sleep(min(random.uniform(period, delay), remaining))
+        delay = min(delay * 2, 2.0)

@@ -1,0 +1,259 @@
+"""Self-healing leader-RPC client for the data plane.
+
+``DistributedReader``'s calls to the leader :class:`DataService` were
+bare ``RpcClient.call``s: one transport blip killed the reader, and a
+leader failover left it pinned to a dead endpoint.  This wrapper is the
+PR-6 ``ResilientCoordClient`` treatment for data RPCs:
+
+- every call retries the transport-class ``EdlCoordError`` (including
+  injected faults — utils/faultinject.py) with exponential backoff +
+  full jitter under a total deadline budget
+  (``EDL_TPU_DATA_RETRY_DEADLINE``);
+- between attempts the **leader endpoint is re-resolved** through the
+  caller's resolver (the cluster record, or the standalone data-leader
+  seat) — a failover swaps the underlying client and triggers a
+  **reattach** so the successor restores this reader's in-flight work;
+- the service's ``inc`` (incarnation id) echoed in every response
+  catches a leader that restarted *on the same endpoint*: the change
+  triggers the same reattach;
+- ``EdlReaderGoneError`` ("generation gone": a successor with no/torn
+  journal) reattaches — re-seeding the generation from the reader's
+  own state — then replays the original call; every DataService
+  mutation is replay-idempotent by ``(reader, batch_id)`` / per-pod
+  grant, so the retry can't double-count spans;
+- other typed errors (``EdlStopIteration`` end-of-data,
+  ``EdlDataError`` producer failure) propagate immediately: the server
+  answered, retrying would not change its mind;
+- ``close_after(deadline)`` caps every in-flight and future call by a
+  shutdown budget, so ``DistributedReader.close()`` can bound a
+  producer thread blocked mid-call instead of leaking it.
+
+``edl_data_rpc_retries_total{op}`` / ``edl_data_rpc_failovers_total``
+expose the blip history per process.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import EdlCoordError, EdlReaderGoneError
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_RETRIES = obs_metrics.counter(
+    "edl_data_rpc_retries_total",
+    "Data-plane leader RPCs retried after a transport error, by op",
+    ("op",))
+_FAILOVERS = obs_metrics.counter(
+    "edl_data_rpc_failovers_total",
+    "Data-plane leader client switches to a re-resolved leader endpoint")
+
+
+class ResilientDataClient:
+    """Retry + leader re-resolution + reattach for DataService calls.
+
+    ``endpoint`` may be a static ``host:port`` or a zero-arg resolver
+    returning the current leader endpoint (re-invoked after failures).
+    ``on_reattach(call)`` — when set — is invoked (serialized, at most
+    once per incident) with a raw single-shot call function the
+    handler uses to perform the reattach RPC itself; it must be
+    replay-idempotent."""
+
+    def __init__(self, endpoint: "str | Callable[[], str]",
+                 timeout: float = 10.0,
+                 retry_deadline: float | None = None,
+                 on_reattach=None, name: str = ""):
+        self._resolver = (endpoint if callable(endpoint)
+                          else (lambda: endpoint))
+        self._timeout = timeout
+        self._deadline = (constants.DATA_RETRY_DEADLINE
+                          if retry_deadline is None else retry_deadline)
+        self._on_reattach = on_reattach
+        self._name = name
+        self._lock = threading.Lock()
+        self._client: RpcClient | None = None
+        self._incarnation: str | None = None
+        self._closed = False
+        self._close_at: float | None = None
+        # reattach serialization: one incident heals once, however many
+        # threads (producer + consumer) tripped over it concurrently
+        self._attach_lock = threading.Lock()
+        self._attach_gen = 0
+        self._need_attach = False
+        self._rng = random.Random()
+
+    # -- endpoint management -------------------------------------------------
+    @property
+    def endpoint(self) -> str | None:
+        with self._lock:
+            return self._client.endpoint if self._client else None
+
+    def _ensure_client(self, reresolve: bool = False) -> RpcClient:
+        """Current client; with ``reresolve`` the resolver is consulted
+        and an endpoint change swaps the client (failover)."""
+        with self._lock:
+            if self._closed:
+                raise EdlCoordError(f"data client {self._name} is closed")
+            client = self._client
+        if client is not None and not reresolve:
+            return client
+        try:
+            endpoint = self._resolver()
+        except EdlCoordError:
+            raise
+        except Exception as e:  # noqa: BLE001 — resolver uses the store
+            # a resolver failure (store blip, cluster record mid-rewrite)
+            # is transport-class: surface it as retryable so the call's
+            # backoff loop re-resolves instead of killing the reader
+            raise EdlCoordError(
+                f"data client {self._name}: leader resolution failed: "
+                f"{e}") from e
+        if not endpoint:
+            raise EdlCoordError(
+                f"data client {self._name}: leader endpoint unresolved")
+        with self._lock:
+            if self._closed:
+                raise EdlCoordError(f"data client {self._name} is closed")
+            if self._client is not None and self._client.endpoint == endpoint:
+                return self._client
+            old, self._client = self._client, RpcClient(endpoint,
+                                                        self._timeout)
+            if old is not None:
+                _FAILOVERS.inc()
+                self._need_attach = True
+                logger.warning("data leader failover %s -> %s (%s)",
+                               old.endpoint, endpoint, self._name)
+            client = self._client
+        if old is not None:
+            old.close()
+        return client
+
+    def _remaining(self, deadline: float) -> float:
+        """Time left, additionally capped by the close deadline."""
+        with self._lock:
+            close_at = self._close_at
+        if close_at is not None:
+            deadline = min(deadline, close_at)
+        return deadline - time.monotonic()
+
+    # -- reattach ------------------------------------------------------------
+    def _flag_reattach(self) -> None:
+        with self._lock:
+            self._need_attach = True
+
+    def _note_incarnation(self, resp) -> None:
+        """FLAG-only: the reattach itself runs at the head of the NEXT
+        call.  Running it inline here would put its RPC inside the
+        caller's retry scope — a transient reattach failure would throw
+        away a response that was already received and applied, and the
+        replayed op could double-deliver."""
+        if not isinstance(resp, dict):
+            return
+        inc = resp.pop("inc", None)
+        if inc is None:
+            return
+        with self._lock:
+            prev, self._incarnation = self._incarnation, inc
+        if prev is not None and prev != inc:
+            logger.warning("data leader incarnation changed (%s -> %s); "
+                           "reattaching %s on the next call", prev, inc,
+                           self._name)
+            self._flag_reattach()
+
+    def _maybe_reattach(self) -> None:
+        """Run the reader's reattach handshake if one is pending.
+        Serialized; a second thread arriving for the same incident sees
+        the bumped generation and skips."""
+        if self._on_reattach is None:
+            return
+        with self._lock:
+            if not self._need_attach:
+                return
+            gen = self._attach_gen
+        with self._attach_lock:
+            with self._lock:
+                if not self._need_attach or self._attach_gen != gen:
+                    return
+            client = self._ensure_client()
+
+            def raw_call(method: str, **kwargs):
+                resp = client.call(method, _timeout=self._timeout, **kwargs)
+                if isinstance(resp, dict):
+                    inc = resp.pop("inc", None)
+                    if inc is not None:
+                        with self._lock:
+                            self._incarnation = inc
+                return resp
+
+            self._on_reattach(raw_call)
+            with self._lock:
+                self._need_attach = False
+                self._attach_gen += 1
+
+    # -- the retry loop ------------------------------------------------------
+    def call(self, op: str, **kwargs):
+        deadline = time.monotonic() + self._deadline
+        delay = constants.DATA_BACKOFF_INIT
+        attempt = 0
+        while True:
+            try:
+                client = self._ensure_client(reresolve=attempt > 0)
+                self._maybe_reattach()
+                remaining = self._remaining(deadline)
+                if remaining <= 0:
+                    raise EdlCoordError(
+                        f"data rpc {op} out of budget before dispatch")
+                resp = client.call(
+                    op, _timeout=max(0.25, min(self._timeout, remaining)),
+                    **kwargs)
+                self._note_incarnation(resp)
+                return resp
+            except EdlReaderGoneError:
+                # the addressed service has no state for this reader:
+                # plain retry would loop on the same answer — reattach
+                # (re-seed from reader state) then replay
+                if self._on_reattach is None:
+                    raise
+                self._flag_reattach()
+                if self._remaining(deadline) <= 0:
+                    raise
+                attempt += 1
+            except EdlCoordError as e:
+                _RETRIES.labels(op=op).inc()
+                attempt += 1
+                # a transport failure may be the leader dying: whatever
+                # answers next (successor, or the same server reborn)
+                # must restore our in-flight state before we trust it
+                self._flag_reattach()
+                remaining = self._remaining(deadline)
+                if remaining <= 0:
+                    raise EdlCoordError(
+                        f"data rpc {op} failed after retry budget "
+                        f"({self._deadline:.1f}s): {e}") from e
+                # full jitter: a whole job's readers must not stampede
+                # the successor in lockstep
+                time.sleep(min(self._rng.uniform(0, delay), remaining))
+                delay = min(delay * 2, constants.DATA_BACKOFF_MAX)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close_after(self, deadline: float) -> None:
+        """Cap every in-flight retry loop (and future call) to finish
+        within ``deadline`` seconds — the shutdown bound
+        ``DistributedReader.close()`` uses so a blocked producer call
+        cannot outlive the close."""
+        with self._lock:
+            self._close_at = time.monotonic() + max(0.0, deadline)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()

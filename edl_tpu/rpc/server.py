@@ -153,6 +153,37 @@ class _TcpServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._active_lock = threading.Lock()
+        self._active: set[socket.socket] = set()
+
+    def process_request(self, request, client_address):
+        with self._active_lock:
+            self._active.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._active_lock:
+            self._active.discard(request)
+        super().shutdown_request(request)
+
+    def close_active(self) -> None:
+        """Sever every established connection: a stopped server must
+        look DEAD to its peers, not keep answering on old sockets while
+        refusing new ones (clients would never fail over)."""
+        with self._active_lock:
+            socks = list(self._active)
+        for sock in socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
 
 class RpcServer:
     """Register methods, then ``start()``; ``endpoint`` gives ip:port."""
@@ -192,3 +223,7 @@ class RpcServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # in-flight handler threads are severed too: peers of a stopped
+        # server must see a transport error (and fail over), not a
+        # half-alive endpoint that answers old connections only
+        self._server.close_active()

@@ -159,6 +159,33 @@ STRIPE_MIN_BYTES = int(_f("EDL_TPU_STRIPE_MIN_BYTES", 8 << 20))
 # A single leaf larger than the budget still fetches whole (floor).
 TRANSFER_BUDGET_BYTES = int(_f("EDL_TPU_TRANSFER_BUDGET_BYTES", 1 << 30))
 
+# -- data-plane fault tolerance (data/journal, data/resilient) -------------
+# journal the leader DataService's generation state into the coord
+# store (write-ahead) so a successor leader rebuilds live generations
+# and readers reattach without restarting the epoch; 0 disables the
+# journal — a successor then answers EdlReaderGoneError and live
+# readers RE-SEED the generation from their own checkpoint + claimed
+# spans (published-but-unfetched batches re-produce via the reattach
+# position repair); only a reader that ALSO died still needs the full
+# stop-resume-from-DataCheckpoint path
+DATA_JOURNAL = int(_f("EDL_TPU_DATA_JOURNAL", 1))
+# per-journal-op store budget: a write that can't land within this
+# raises the retryable EdlCoordError to the reader (which retries), so
+# the journal never silently falls behind what a reader observed
+DATA_JOURNAL_BUDGET = _f("EDL_TPU_DATA_JOURNAL_BUDGET", 5.0)
+# reader-side resilient data RPCs: total retry budget per leader call
+# (backoff + full jitter + leader re-resolution inside it)
+DATA_RETRY_DEADLINE = _f("EDL_TPU_DATA_RETRY_DEADLINE", 30.0)
+DATA_BACKOFF_INIT = _f("EDL_TPU_DATA_BACKOFF_INIT", 0.05)
+DATA_BACKOFF_MAX = _f("EDL_TPU_DATA_BACKOFF_MAX", 2.0)
+# after a leader rebuild, parked (journal-recovered) batch metas and
+# new file grants are held back this long so live readers can reattach
+# and reclaim their in-flight work first — releasing earlier could
+# hand a reattaching reader's unacked batch to a second consumer.
+# Keep it >= DATA_RETRY_DEADLINE's typical blip recovery (readers
+# reattach on their first post-failover call, normally within ~1 s)
+DATA_REBUILD_GRACE = _f("EDL_TPU_DATA_REBUILD_GRACE", 5.0)
+
 # -- elastic serving gateway (edl_tpu/gateway, serving/replica) -----------
 # how often a replica refreshes its leased advert with live load stats
 # (free slots, queue depth, prefill stall) and republishes engine gauges

@@ -79,6 +79,14 @@ class EdlDataError(EdlRetryableError):
     """Data-server state not ready (e.g. balanced metas not computed)."""
 
 
+class EdlReaderGoneError(EdlTableError):
+    """The addressed DataService has no state for this reader
+    generation (a successor leader with no/torn journal, or the
+    generation was GC'd).  Readers REATTACH — re-seed the generation
+    from their own checkpoint + claimed spans — instead of plain
+    retrying; a retry alone would loop on the same answer."""
+
+
 class EdlStreamError(EdlError):
     """Streamed-response protocol violation (sequence gap/duplicate,
     short stream, or a non-streaming answer where frames were
@@ -115,6 +123,7 @@ _REGISTRY = {
         EdlUnavailableError,
         EdlStopIteration,
         EdlDataError,
+        EdlReaderGoneError,
         EdlStreamError,
         EdlFileListNotMatchError,
         EdlInternalError,

@@ -24,3 +24,21 @@ def merge_span(spans: list[list[int]], begin: int, end: int) -> None:
 
 def in_spans(spans: list[list[int]], record_no: int) -> bool:
     return any(b <= record_no < e for b, e in spans)
+
+
+def intersect_spans(a: list[list[int]], b: list[list[int]]) -> list[list[int]]:
+    """Merged intersection of two span lists (each need not be sorted
+    or disjoint)."""
+    am: list[list[int]] = []
+    for begin, end in a:
+        merge_span(am, begin, end)
+    bm: list[list[int]] = []
+    for begin, end in b:
+        merge_span(bm, begin, end)
+    out: list[list[int]] = []
+    for ab, ae in am:
+        for bb, be in bm:
+            lo, hi = max(ab, bb), min(ae, be)
+            if lo < hi:
+                merge_span(out, lo, hi)
+    return out

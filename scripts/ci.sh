@@ -49,6 +49,16 @@ JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
 # healed by the resilient client
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
+# data chaos smoke: durable coord + two elected data-leader candidates
+# + three reader pods with faults injected on every data RPC — SIGKILL
+# the ACTIVE leader mid-epoch (standby seizes the seat, rebuilds every
+# generation from the coord journal, readers reattach; data_leader_mttr_s
+# gated) and SIGKILL a producer pod mid-epoch (its files requeue minus
+# consumed spans); the exactly-once audit over the raw span logs must
+# show zero drops and zero duplicates outside the killed pod's unacked
+# tail, with zero reader failures (retries visible in metrics only)
+JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
+
 # obs-agg smoke: 2 child processes + parent — one trace_id propagated
 # over the EDL1 wire into both children's trace files, the aggregator
 # discovers all three via coord-store adverts and serves a merged

@@ -1,0 +1,378 @@
+"""CI data-plane chaos smoke: leader death, producer death, injected
+faults — and still every record trains exactly once.
+
+The full data-plane fault-tolerance story against REAL processes:
+
+1. A durable coord server (WAL, SIGKILL-proof — the PR-6 substrate),
+   TWO data-leader candidates contending for the exclusive seat
+   (``edl_tpu.data.leader``, journaled DataService), and THREE pod
+   processes each producing + consuming through a
+   :class:`DistributedReader` over the resilient data-RPC client, with
+   transport faults injected on every data RPC and coord put
+   (``EDL_TPU_FAULTS``).
+2. Mid-epoch the ACTIVE leader is SIGKILLed: the standby seizes the
+   seat within one TTL, **rebuilds every generation from the coord
+   journal**, readers re-resolve + reattach, and the epoch continues —
+   ``data_leader_mttr_s`` is recorded and gated.  No stop-resume, no
+   restart.
+3. Later one pod is SIGKILLed mid-epoch: its registry advert expires,
+   the leader requeues its files and unconsumed batches *minus the
+   consumed union*, and the survivors finish the epoch.
+4. The exactly-once audit over the pods' raw span logs gates the whole
+   run: the union of trained spans equals the file set, ZERO records
+   dropped, and duplicates are permitted ONLY inside the killed pod's
+   own consumed-but-unacked tail (the documented at-least-once caveat
+   of consumer death) — never among survivors.
+5. The surviving pods report their ``edl_data_rpc_retries_total``:
+   the injected faults and the failover must be visible as retries in
+   metrics, with ZERO reader failures.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("EDL_TPU_TTL", "2")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TTL = 2.0
+JOB = "data-chaos"
+READER = "chaos@e0"
+N_FILES, PER_FILE, BATCH = 16, 50, 4
+POD_FAULTS = ("client:get_batch_meta:error:0.05;"
+              "client:next_file:error:0.05;"
+              "client:report_batch_meta:error:0.05;"
+              "client:kv_put:error:0.05")
+LEADER_FAULTS = "client:kv_put:error:0.1"
+
+
+# ---------------------------------------------------------------------------
+# pod worker (re-exec'd role): produce + consume + audit-log every batch
+# ---------------------------------------------------------------------------
+
+def run_pod(args) -> int:
+    from edl_tpu.coord.client import connect_wait
+    from edl_tpu.data import DistributedReader, PodDataServer, register_reader
+    from edl_tpu.data.leader import resolve_data_leader
+    from edl_tpu.data.resilient import _RETRIES
+
+    store = connect_wait(args.coord_endpoints)
+    files = sorted(os.path.join(args.data_dir, f)
+                   for f in os.listdir(args.data_dir))
+    server = PodDataServer(args.pod_id)
+    reg = register_reader(store, JOB, READER, args.pod_id, server.endpoint)
+    reader = DistributedReader(
+        READER, args.pod_id, lambda: resolve_data_leader(store, JOB),
+        server, batch_size=BATCH, retry_deadline=90.0)
+    reader.create(files)
+    audit = open(args.audit, "a", buffering=1)
+    consumed = 0
+    for bid, payload in reader:
+        audit.write(json.dumps({"pod": args.pod_id, "bid": bid,
+                                "spans": payload["spans"]}) + "\n")
+        consumed += len(payload["records"])
+        time.sleep(args.step_sleep)
+    retries = sum(_RETRIES.labels(op=op).value
+                  for op in ("create_reader", "next_file",
+                             "report_batch_meta", "get_batch_meta",
+                             "file_done", "nack_batches"))
+    audit.write(json.dumps({"pod": args.pod_id, "done": True,
+                            "records": consumed,
+                            "data_rpc_retries": retries}) + "\n")
+    audit.close()
+    # keep serving the local batch cache briefly: peers may still hold
+    # metas pointing at it (exiting instantly would force nack churn)
+    time.sleep(2 * TTL)
+    reg.stop()
+    server.stop()
+    store.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def _spawn_coord(port: int, data_dir: str) -> subprocess.Popen:
+    from edl_tpu.coord.server import spawn_subprocess
+    env = dict(os.environ, EDL_TPU_TTL=str(TTL))
+    env.pop("EDL_TPU_METRICS_PORT", None)
+    env.pop("EDL_TPU_FAULTS", None)
+    return spawn_subprocess(port, data_dir, restart_grace=TTL, env=env)
+
+
+def _spawn_leader(coord_ep: str, tmp: str, name: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", EDL_TPU_TTL=str(TTL),
+               EDL_TPU_FAULTS=LEADER_FAULTS, EDL_TPU_FAULTS_SEED="11",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("EDL_TPU_METRICS_PORT", None)
+    log = open(os.path.join(tmp, f"leader-{name}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.data.leader",
+         "--coord_endpoints", coord_ep, "--job_id", JOB,
+         "--host", "127.0.0.1", "--ttl", str(TTL),
+         "--rebuild_grace", "3.0"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    proc._logpath = os.path.join(tmp, f"leader-{name}.log")  # noqa: SLF001
+    return proc
+
+
+def _spawn_pod(coord_ep: str, tmp: str, data_dir: str, pod_id: str,
+               seed: int) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", EDL_TPU_TTL=str(TTL),
+               EDL_TPU_FAULTS=POD_FAULTS, EDL_TPU_FAULTS_SEED=str(seed),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("EDL_TPU_METRICS_PORT", None)
+    log = open(os.path.join(tmp, f"pod-{pod_id}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", "pod",
+         "--coord_endpoints", coord_ep, "--pod_id", pod_id,
+         "--data_dir", data_dir,
+         "--audit", os.path.join(tmp, f"audit-{pod_id}.jsonl"),
+         "--step_sleep", "0.1"],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    return proc
+
+
+def _write_data(data_dir: str) -> None:
+    os.makedirs(data_dir, exist_ok=True)
+    for f in range(N_FILES):
+        with open(os.path.join(data_dir, f"part-{f:02d}.txt"), "w") as fh:
+            for r in range(PER_FILE):
+                fh.write(f"f{f}r{r}\n")
+
+
+def _seat_endpoint(store) -> str | None:
+    from edl_tpu.data.leader import _seat_key
+    rec = store.get(_seat_key(JOB))
+    return rec.value.decode() if rec is not None and rec.value else None
+
+
+def _consumed_batches(tmp: str, pods: list[str]) -> int:
+    n = 0
+    for pod in pods:
+        path = os.path.join(tmp, f"audit-{pod}.jsonl")
+        if os.path.exists(path):
+            with open(path) as fh:
+                n += sum(1 for line in fh if '"spans"' in line)
+    return n
+
+
+def _load_audit(tmp: str, pod: str) -> tuple[list, dict | None]:
+    spans, final = [], None
+    path = os.path.join(tmp, f"audit-{pod}.jsonl")
+    if not os.path.exists(path):
+        return spans, final
+    with open(path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a SIGKILLed pod
+            if rec.get("done"):
+                final = rec
+            else:
+                spans.extend(rec["spans"])
+    return spans, final
+
+
+def _dump_dup_forensics(tmp: str, pods: list[str]) -> None:
+    """On audit failure: which pods trained each multi-trained record,
+    via which batch ids — names the double-production path for triage."""
+    by_record: dict = {}
+    for pod in pods:
+        path = os.path.join(tmp, f"audit-{pod}.jsonl")
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("done"):
+                    continue
+                for f, b, e in rec["spans"]:
+                    for r in range(b, e):
+                        by_record.setdefault((f, r), []).append(
+                            (pod, rec.get("bid", "?")))
+    dups = {k: v for k, v in by_record.items() if len(v) > 1}
+    print(f"data-chaos FORENSICS: {len(dups)} multi-trained records")
+    for k in sorted(dups)[:40]:
+        print(f"  record {k}: {dups[k]}")
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)  # tests.helpers
+    from edl_tpu.coord.client import connect
+    from edl_tpu.utils.network import find_free_ports
+    from tests.helpers.exactly_once import audit_spans, span_counts
+
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="edl-data-chaos-")
+    data_dir = os.path.join(tmp, "data")
+    _write_data(data_dir)
+    port = find_free_ports(1)[0]
+    coord_ep = f"127.0.0.1:{port}"
+    pods = ["pod-0", "pod-1", "pod-2"]
+    coord = _spawn_coord(port, os.path.join(tmp, "coord"))
+    leaders: list[subprocess.Popen] = []
+    pod_procs: dict[str, subprocess.Popen] = {}
+    store = None
+    try:
+        from edl_tpu.coord.server import wait_ready
+        wait_ready(coord_ep, 120.0)
+        store = connect(coord_ep)
+        leaders = [_spawn_leader(coord_ep, tmp, "a"),
+                   _spawn_leader(coord_ep, tmp, "b")]
+        deadline = time.time() + 60
+        while _seat_endpoint(store) is None:
+            assert time.time() < deadline, "no data leader ever seated"
+            time.sleep(0.1)
+        active_ep = _seat_endpoint(store)
+        print(f"data-chaos: leader seated at {active_ep}")
+
+        for i, pod in enumerate(pods):
+            pod_procs[pod] = _spawn_pod(coord_ep, tmp, data_dir, pod,
+                                        seed=100 + i)
+
+        # --- phase 1: SIGKILL the ACTIVE leader mid-epoch ---------------
+        deadline = time.time() + 120
+        while _consumed_batches(tmp, pods) < 20:
+            assert time.time() < deadline, "pods never started consuming"
+            for pod, proc in pod_procs.items():
+                assert proc.poll() is None, f"{pod} died in warmup"
+            time.sleep(0.2)
+        victim = next(p for p in leaders
+                      if f"serving on {active_ep}" in open(
+                          p._logpath, errors="replace").read())  # noqa: SLF001
+        t_kill = time.monotonic()
+        victim.kill()
+        victim.wait(timeout=30)
+        print(f"data-chaos: SIGKILLed active leader {active_ep}")
+        deadline = time.time() + 60
+        new_ep = None
+        while time.time() < deadline:
+            new_ep = _seat_endpoint(store)
+            if new_ep is not None and new_ep != active_ep:
+                break
+            time.sleep(0.05)
+        assert new_ep is not None and new_ep != active_ep, \
+            "standby never seized the data-leader seat"
+        # MTTR = kill -> the successor ANSWERS for the rebuilt generation
+        from edl_tpu.rpc.client import RpcClient
+        cli = RpcClient(new_ep, timeout=5.0)
+        while True:
+            assert time.time() < deadline, "successor never answered"
+            try:
+                st = cli.call("reader_status", reader=READER)
+                break
+            except Exception:  # noqa: BLE001 — booting/rebuilding
+                time.sleep(0.05)
+        cli.close()
+        mttr = time.monotonic() - t_kill
+        out["data_leader_mttr_s"] = round(mttr, 3)
+        assert st["files"] == N_FILES, st
+        standby_log = next(p._logpath for p in leaders  # noqa: SLF001
+                           if p.poll() is None)
+        print(f"data-chaos: standby {new_ep} took over in {mttr:.2f}s "
+              f"({st['parked']} parked, {len(st['consumed'])} consumed "
+              f"files rebuilt)")
+
+        # --- phase 2: SIGKILL one pod mid-epoch -------------------------
+        before = _consumed_batches(tmp, pods)
+        deadline = time.time() + 120
+        while _consumed_batches(tmp, pods) < before + 20:
+            assert time.time() < deadline, "no progress after failover"
+            time.sleep(0.2)
+        pod_procs["pod-2"].kill()
+        pod_procs["pod-2"].wait(timeout=30)
+        print("data-chaos: SIGKILLed pod-2 mid-epoch")
+
+        # --- survivors finish the epoch ---------------------------------
+        for pod in ("pod-0", "pod-1"):
+            rc = pod_procs[pod].wait(timeout=300)
+            assert rc == 0, (
+                f"{pod} failed rc={rc}:\n"
+                + open(os.path.join(tmp, f"pod-{pod}.log"),
+                       errors="replace").read()[-3000:])
+        print("data-chaos: surviving pods drained the epoch (rc=0)")
+
+        # --- the exactly-once audit ------------------------------------
+        all_spans: list = []
+        finals = {}
+        for pod in pods:
+            spans, final = _load_audit(tmp, pod)
+            all_spans.extend(spans)
+            finals[pod] = final
+        killed_spans, _ = _load_audit(tmp, "pod-2")
+        killed_records = set(span_counts(killed_spans))
+        try:
+            stats = audit_spans(all_spans, N_FILES, PER_FILE,
+                                allow_duplicates_of=killed_records)
+        except AssertionError:
+            _dump_dup_forensics(tmp, pods)
+            raise
+        out.update(stats)
+        # duplicates among SURVIVORS alone are forbidden outright
+        surv_spans = []
+        for pod in ("pod-0", "pod-1"):
+            surv_spans.extend(_load_audit(tmp, pod)[0])
+        surv_dups = {k: c for k, c in span_counts(surv_spans).items()
+                     if c > 1}
+        assert not surv_dups, (
+            f"survivors double-trained {len(surv_dups)} records: "
+            f"{sorted(surv_dups)[:10]}")
+        retries = sum((finals[p] or {}).get("data_rpc_retries", 0)
+                      for p in ("pod-0", "pod-1"))
+        out["data_rpc_retries"] = int(retries)
+        assert retries > 0, \
+            "faults + failover must be visible as data-RPC retries"
+        log_text = open(standby_log, errors="replace").read()
+        assert "rebuilt from journal" in log_text, \
+            f"standby never rebuilt from the journal:\n{log_text[-2000:]}"
+        assert out["data_leader_mttr_s"] < 30.0, out
+        print(f"data-chaos: {stats['records_total']} records — "
+              f"{stats['records_exactly_once']} exactly once, "
+              f"{stats['records_duplicated']} duplicated (all inside the "
+              f"killed pod's unacked tail), 0 dropped; "
+              f"{int(retries)} reader retries, 0 reader failures")
+        print("DATA_CHAOS " + json.dumps(out))
+        print("data chaos smoke OK")
+    finally:
+        for proc in list(pod_procs.values()) + leaders + [coord]:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait(timeout=30)
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+        if store is not None:
+            store.close()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", default="main", choices=("main", "pod"))
+    p.add_argument("--coord_endpoints")
+    p.add_argument("--pod_id")
+    p.add_argument("--data_dir")
+    p.add_argument("--audit")
+    p.add_argument("--step_sleep", type=float, default=0.1)
+    args = p.parse_args()
+    if args.role == "pod":
+        raise SystemExit(run_pod(args))
+    main()

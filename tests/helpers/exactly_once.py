@@ -1,0 +1,89 @@
+"""Reusable exactly-once audit over record spans.
+
+The data plane's whole contract is one sentence — *the union of
+trained spans equals the file set, and no record trains twice* — so
+every test that claims it should assert it the same way.  Two entry
+points:
+
+- :func:`audit_spans` takes the RAW span log (one entry per trained
+  batch, unmerged, possibly from many pods) and proves both halves:
+  full coverage AND zero overlap.  Overlap is only detectable on raw
+  logs — merged checkpoint spans absorb duplicates silently.
+- :func:`audit_union` takes already-merged spans (a DataCheckpoint's
+  ``processed`` list, the sidecar's per-epoch log) and proves coverage;
+  it is the right check where only the merged record survives.
+
+Both return a small stats dict so smokes can publish the counts
+(``records_total`` / ``records_exactly_once`` / duplicates) into their
+artifacts.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+
+def span_counts(spans) -> Counter:
+    """(file_idx, record_no) -> times covered, from raw [f, b, e) spans."""
+    counts: Counter = Counter()
+    for file_idx, begin, end in spans:
+        for record_no in range(int(begin), int(end)):
+            counts[(int(file_idx), record_no)] += 1
+    return counts
+
+
+def audit_spans(spans, files: "dict[int, int] | int", per_file: int | None = None,
+                allow_duplicates_of=None) -> dict:
+    """Assert exactly-once delivery from a RAW (unmerged) span log.
+
+    ``files`` is either ``{file_idx: record_count}`` or a file count
+    (with ``per_file`` records each).  ``allow_duplicates_of`` — an
+    iterable of ``(file_idx, record_no)`` — whitelists records that may
+    legitimately appear twice: the consumed-but-unacked window of a
+    SIGKILLed consumer (the documented at-least-once caveat).  Any
+    duplicate outside the whitelist, and any gap, fails."""
+    if isinstance(files, int):
+        assert per_file is not None, "per_file required with a file count"
+        files = {f: per_file for f in range(files)}
+    expected = {(f, r) for f, n in files.items() for r in range(n)}
+    counts = span_counts(spans)
+    unexpected = sorted(set(counts) - expected)
+    assert not unexpected, f"records outside the file set: {unexpected[:10]}"
+    missing = sorted(expected - set(counts))
+    assert not missing, (
+        f"{len(missing)} records never trained (silent drop), e.g. "
+        f"{missing[:10]}")
+    allowed = set(allow_duplicates_of or ())
+    dups = {k: c for k, c in counts.items() if c > 1}
+    bad = sorted(set(dups) - allowed)
+    assert not bad, (
+        f"{len(bad)} records trained more than once outside the allowed "
+        f"set, e.g. {[(k, dups[k]) for k in bad[:10]]}")
+    return {
+        "records_total": len(expected),
+        "records_exactly_once": sum(1 for c in counts.values() if c == 1),
+        "records_duplicated": len(dups),
+        "max_multiplicity": max(counts.values(), default=0),
+    }
+
+
+def audit_union(spans, files: "dict[int, int] | int",
+                per_file: int | None = None) -> dict:
+    """Assert full coverage from MERGED spans: per file, the merged
+    disjoint spans must be exactly ``[[0, n)]`` — a gap cannot produce
+    that, and (because the input is already merged) duplicates are not
+    observable here."""
+    from edl_tpu.utils.spans import merge_span
+
+    if isinstance(files, int):
+        assert per_file is not None, "per_file required with a file count"
+        files = {f: per_file for f in range(files)}
+    merged: dict[int, list[list[int]]] = {}
+    for file_idx, begin, end in spans:
+        merge_span(merged.setdefault(int(file_idx), []), int(begin), int(end))
+    for file_idx, n in files.items():
+        assert merged.get(file_idx) == [[0, n]], (
+            f"file {file_idx}: union {merged.get(file_idx)} != [[0, {n}]]")
+    extra = sorted(set(merged) - set(files))
+    assert not extra, f"spans for unknown files: {extra}"
+    return {"records_total": sum(files.values()), "files": len(files)}

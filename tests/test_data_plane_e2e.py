@@ -80,13 +80,15 @@ FULL = {f"{f}": [[0, PER_FILE]] for f in range(N_FILES)}
 
 
 def assert_exactly_once(spans_by_epoch, epochs):
+    from tests.helpers.exactly_once import audit_union
     for e in epochs:
         spans = spans_by_epoch.get(f"spans_e{e}")
         assert spans is not None, f"epoch {e} missing span log"
         # merged disjoint spans covering [0,PER_FILE) per file == every
-        # record exactly once (a duplicate or a gap cannot produce this)
-        assert sorted(spans) == [[f, 0, PER_FILE] for f in range(N_FILES)], \
-            (e, spans)
+        # record delivered, no gap (the shared audit helper; these are
+        # checkpoint-merged spans, so overlap is asserted by the raw-log
+        # audits in test_data_service/test_data_resilience instead)
+        audit_union(spans, N_FILES, PER_FILE)
 
 
 @pytest.mark.slow

@@ -1,0 +1,599 @@
+"""Data-plane fault tolerance: journal replay on a successor leader,
+idempotent RPC replay, reader retry/reattach, rebuild grace, the
+registry watch, and the bounded reader shutdown."""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.cluster.state import DataCheckpoint
+from edl_tpu.data import DistributedReader, PodDataServer
+from edl_tpu.data.data_server import DataService
+from edl_tpu.data.journal import DataJournal
+from edl_tpu.data.resilient import ResilientDataClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import faultinject
+from edl_tpu.utils.exceptions import (
+    EdlCoordError,
+    EdlReaderGoneError,
+    EdlStopIteration,
+)
+from tests.helpers.exactly_once import audit_spans, audit_union
+
+ALL = sorted(f"f{f}r{r}" for f in range(4) for r in range(10))
+
+
+@pytest.fixture
+def files(tmp_path):
+    paths = []
+    for f in range(4):
+        p = tmp_path / f"part-{f}.txt"
+        p.write_text("".join(f"f{f}r{r}\n" for r in range(10)))
+        paths.append(str(p))
+    return paths
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    faultinject.configure(None)
+
+
+def serve(service: DataService) -> tuple[RpcServer, str]:
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register_instance(service)
+    srv.start()
+    return srv, f"127.0.0.1:{srv.port}"
+
+
+# -- the audit helper itself ------------------------------------------------
+
+def test_audit_spans_detects_overlap_and_gap():
+    ok = audit_spans([[0, 0, 5], [0, 5, 10]], {0: 10})
+    assert ok["records_exactly_once"] == 10
+    with pytest.raises(AssertionError, match="more than once"):
+        audit_spans([[0, 0, 6], [0, 5, 10]], {0: 10})
+    with pytest.raises(AssertionError, match="never trained"):
+        audit_spans([[0, 0, 9]], {0: 10})
+    # the consumer-death whitelist tolerates listed duplicates only
+    stats = audit_spans([[0, 0, 6], [0, 5, 10]], {0: 10},
+                        allow_duplicates_of={(0, 5)})
+    assert stats["records_duplicated"] == 1
+    audit_union([[0, 3, 10], [0, 0, 5]], {0: 10})
+    with pytest.raises(AssertionError):
+        audit_union([[0, 0, 9]], {0: 10})
+
+
+# -- journal replay ---------------------------------------------------------
+
+def test_journal_rebuild_minus_consumed(memkv, files):
+    journal = DataJournal(memkv, "j1")
+    a = DataService(journal=journal, rebuild_grace=0.0)
+    a.create_reader("r@e0@s1", files, consumed=[[3, 0, 4]])
+    assert a.next_file("r@e0@s1", "podA")["file"] == [0, files[0]]
+    a.report_batch_meta("r@e0@s1", "podA", "127.0.0.1:1",
+                        [["podA:0", [[0, 0, 4]]], ["podA:1", [[0, 4, 8]]]])
+    a.file_done("r@e0@s1", "podA", 0)
+    # consume + ack the first batch
+    got = a.get_batch_meta("r@e0@s1", "podB", n=1)["metas"]
+    assert got[0][2] == "podA:0"
+    a.get_batch_meta("r@e0@s1", "podB", n=0, ack_ids=["podA:0"])
+
+    # successor leader: same journal, fresh service — lazy rebuild
+    b = DataService(journal=journal, rebuild_grace=0.0)
+    st = b.reader_status("r@e0@s1")
+    assert st["files"] == 4
+    assert st["done"] == [0]
+    assert st["consumed"]["0"] == [[0, 4]]       # the ack survived
+    assert st["consumed"]["3"] == [[0, 4]]       # the restored checkpoint
+    assert st["parked"] == 1                     # podA:1 awaits its consumer
+    # grants resume (grace 0) and skip the consumed spans
+    nxt = b.next_file("r@e0@s1", "podC")
+    assert nxt["file"][0] in (1, 2, 3)
+    if nxt["file"][0] == 3:
+        assert nxt["skip"] == [[0, 4]]
+
+
+def test_idempotent_report_ack_and_grant(memkv, files):
+    journal = DataJournal(memkv, "j2")
+    svc = DataService(journal=journal)
+    svc.create_reader("r", files[:1])
+    # a retried next_file returns the SAME assignment, not a second file
+    first = svc.next_file("r", "podA")["file"]
+    assert svc.next_file("r", "podA")["file"] == first
+    # a replayed report must not double-queue
+    batches = [["podA:0", [[0, 0, 4]]]]
+    svc.report_batch_meta("r", "podA", "ep", batches)
+    svc.report_batch_meta("r", "podA", "ep", batches)
+    assert svc.reader_status("r")["produced"] == 1
+    # a replayed ack must not double-count
+    svc.get_batch_meta("r", "podB", n=1)
+    svc.get_batch_meta("r", "podB", n=0, ack_ids=["podA:0"])
+    svc.get_batch_meta("r", "podB", n=0, ack_ids=["podA:0"])
+    st = svc.reader_status("r")
+    assert st["acked"] == 1 and st["consumed"]["0"] == [[0, 4]]
+
+
+def test_ack_replay_lands_on_rebuilt_leader(memkv, files):
+    """A consumer that fetched from the OLD leader acks on the NEW one:
+    the parked meta resolves the ack (keyed by (reader, batch_id)), and
+    an acked batch can never be handed out again after a second crash
+    (the journal tombstone keeps the dedup alive)."""
+    journal = DataJournal(memkv, "j3")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    a.next_file("r", "podA")
+    a.report_batch_meta("r", "podA", "ep", [["podA:0", [[0, 0, 4]]]])
+    a.get_batch_meta("r", "podB", n=1)  # handed out, never acked on A
+
+    b = DataService(journal=journal, rebuild_grace=10.0)
+    b.get_batch_meta("r", "podB", n=0, ack_ids=["podA:0"])  # parked -> acked
+    st = b.reader_status("r")
+    assert st["parked"] == 0 and st["consumed"]["0"] == [[0, 4]]
+
+    c = DataService(journal=journal, rebuild_grace=0.0)
+    st = c.reader_status("r")
+    assert st["parked"] == 0 and st["acked"] == 1
+    # a stale report replay of the acked batch must not resurrect it
+    c.report_batch_meta("r", "podA", "ep", [["podA:0", [[0, 0, 4]]]])
+    assert c.reader_status("r")["queued"] == 0
+
+
+def test_rebuild_grace_parks_then_releases(memkv, files):
+    journal = DataJournal(memkv, "j4")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    a.next_file("r", "podA")
+    a.report_batch_meta("r", "podA", "ep", [["podA:0", [[0, 0, 4]]]])
+    a.get_batch_meta("r", "podX", n=1)  # podX holds it, unacked
+
+    b = DataService(journal=journal, rebuild_grace=0.6)
+    # during the grace neither parked metas nor new grants go out
+    assert b.get_batch_meta("r", "podY", n=4)["metas"] == []
+    assert b.next_file("r", "podY")["file"] is None
+    time.sleep(0.7)
+    # past the grace the unclaimed meta is released to any consumer
+    metas = b.get_batch_meta("r", "podY", n=4)["metas"]
+    assert [m[2] for m in metas] == ["podA:0"]
+    # the file stays with its journaled owner (podA may still be mid-
+    # production); the idempotent grant hands IT the same file back
+    assert b.next_file("r", "podY")["file"] is None
+    assert b.next_file("r", "podA")["file"][0] == 0
+
+
+def test_reattach_restores_held_and_producer(memkv, files):
+    journal = DataJournal(memkv, "j5")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:2])
+    assert a.next_file("r", "podA")["file"][0] == 0
+    a.report_batch_meta("r", "podA", "ep", [["podA:0", [[0, 0, 4]]]])
+    a.get_batch_meta("r", "podB", n=1)
+
+    b = DataService(journal=journal, rebuild_grace=30.0)
+    resp = b.reattach_reader("r", "podB", held=["podA:0", "ghost"])
+    assert resp["drop"] == ["ghost"]            # unknown: reader forgets it
+    # podB's held batch is back in ITS inflight: the ack works
+    b.get_batch_meta("r", "podB", n=0, ack_ids=["podA:0"])
+    assert b.reader_status("r")["consumed"]["0"] == [[0, 4]]
+    # the producer re-asserts its in-flight grant and keeps the file
+    resp = b.reattach_reader("r", "podA", producing=[0, None])
+    assert not resp["abandon_file"]
+    assert b.next_file("r", "podA")["file"][0] == 0   # same grant back
+
+    # a producer whose journaled grant it never heard of (lost response)
+    # gets the file re-pended; one it FINISHED (lost file_done) is done
+    c = DataService(journal=journal, rebuild_grace=0.0)
+    c.reattach_reader("r", "podA", producing=None, finished=[0])
+    st = c.reader_status("r")
+    assert 0 in st["done"] and st["owned"] == 0
+
+
+def test_reattach_reseeds_on_torn_journal(memkv, files):
+    """No (or torn) journal on the successor: readers re-seed the
+    generation from their own checkpoint + claimed spans — the clean
+    fallback onto the stop-resume contract — and the epoch still
+    drains exactly once."""
+    svc = DataService(journal=None, rebuild_grace=0.2)
+    with pytest.raises(EdlReaderGoneError):
+        svc.get_batch_meta("r", "podA", n=1)
+    svc.reattach_reader("r", "podA", files=files[:1],
+                        consumed=[[0, 0, 4]], held=["stale:0"])
+    # the unknown held id was dropped; its spans ride consumed
+    st = svc.reader_status("r")
+    assert st["consumed"]["0"] == [[0, 4]]
+    time.sleep(0.25)
+    nxt = svc.next_file("r", "podA")
+    assert nxt["file"] == [0, files[0]] and nxt["skip"] == [[0, 4]]
+
+
+def test_rebuild_pends_repairs_behind_live_whole_file_owner(memkv, files):
+    """A journaled repair entry for a file with a live whole-file owner
+    must survive the rebuild (the owner's skip says it is NOT emitting
+    those records) — dropping it would silently lose the records."""
+    journal = DataJournal(memkv, "jr1")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    # podB owns file 0 whole with records 0-4 in its skip (live batch)
+    a.next_file("r", "podX")
+    a.report_batch_meta("r", "podX", "epX", [["podX:0", [[0, 0, 4]]]])
+    a.get_batch_meta("r", "podA", n=1)
+    a.mark_pod_dead("podX")
+    assert a.next_file("r", "podB")["skip"] == [[0, 4]]
+    # the live batch dies too: its spans become a journaled repair
+    a.nack_batches("r", "podA", ["podX:0"], producer_dead=True)
+    assert a.reader_status("r")["pending"] == 1
+    # successor rebuild: the repair must re-pend even though podB's
+    # whole-file grant is restored; it is granted once podB finishes
+    b = DataService(journal=journal, rebuild_grace=0.0)
+    st = b.reader_status("r")
+    assert st["owned"] == 1 and st["pending"] == 1, st
+    b.file_done("r", "podB", 0)
+    rep = b.next_file("r", "podC")
+    assert rep["file"][0] == 0 and rep["only"] == [[0, 4]], rep
+
+
+def test_reattach_keeps_queued_full_pass(files):
+    """A (possibly spurious) reattach re-asserting a REPAIR grant must
+    not purge pending full-pass work for the same file — only entries
+    duplicating the grant's own type are absorbed."""
+    svc = DataService()
+    svc.create_reader("r", files[:1])  # pending: [0, None]
+    svc.reattach_reader("r", "podC", producing=[0, [[0, 4]], 0])
+    assert svc.reader_status("r")["pending"] == 1  # full pass survives
+    svc.reattach_reader("r", "podC", producing=[0, [[0, 4]], 0])
+    assert svc.reader_status("r")["pending"] == 1  # idempotent
+    # whereas re-asserting the WHOLE-file grant absorbs its own entry
+    svc2 = DataService()
+    svc2.create_reader("r2", files[:1])
+    svc2.reattach_reader("r2", "podB", producing=[0, None, 0])
+    assert svc2.reader_status("r2")["pending"] == 0
+
+
+def test_reseed_repairs_in_flight_file_behind_position(files):
+    """No journal: the successor re-seeds from reattaches.  A producer
+    mid-file re-asserts its grant WITH its position — the records
+    behind it (published to the dead leader, metas lost) re-pend as a
+    repair instead of silently never training."""
+    svc = DataService(journal=None, rebuild_grace=0.0)
+    with pytest.raises(EdlReaderGoneError):
+        svc.next_file("r", "podA")
+    # producer was at record 8 of file 0; consumer had claimed [0,4)
+    svc.reattach_reader("r", "podA", files=files[:1],
+                        consumed=[[0, 0, 4]], producing=[0, None, 8])
+    time.sleep(0.05)
+    st = svc.reader_status("r")
+    assert st["owned"] == 1 and st["pending"] == 1, st
+    # the repair waits for podA's grant to close (single owner slot)
+    assert svc.next_file("r", "podB")["file"] is None
+    svc.file_done("r", "podA", 0)
+    rep = svc.next_file("r", "podB")
+    # the repair covers the lost window [0,8); its grant-time skip
+    # excludes the consumed [0,4), so only [4,8) re-produces
+    assert rep["file"][0] == 0 and rep["only"] == [[0, 8]], rep
+    assert rep["skip"] == [[0, 4]], rep
+
+
+def test_grant_skip_covers_live_batches_and_nack_repairs(files):
+    """The chaos-smoke race, pinned: a dead pod's whole-file requeue
+    lands while batches covering the same records sit unacked in a
+    survivor's inflight.  The re-grant skip must cover LIVE batches
+    (not just acked spans) — re-producing them would train them twice
+    — and if such a live batch later nacks dead, exactly its skipped
+    spans re-pend as a repair (no drop either)."""
+    svc = DataService()
+    svc.create_reader("r", files[:1])
+    svc.next_file("r", "podX")
+    svc.report_batch_meta("r", "podX", "epX", [["podX:0", [[0, 0, 4]]]])
+    svc.get_batch_meta("r", "podA", n=1)   # podA holds podX:0, unacked
+    svc.mark_pod_dead("podX")
+    nxt = svc.next_file("r", "podB")       # file 0 re-granted to podB
+    assert nxt["file"][0] == 0
+    assert nxt["skip"] == [[0, 4]], nxt    # live-held records skipped
+    # the retried grant carries the IDENTICAL skip
+    assert svc.next_file("r", "podB")["skip"] == [[0, 4]]
+    # podA now nacks podX:0 (dead cache): records 0-4 were in podB's
+    # skip, so they re-pend as a repair — podB keeps its grant
+    svc.nack_batches("r", "podA", ["podX:0"], producer_dead=True)
+    st = svc.reader_status("r")
+    assert st["owned"] == 1 and st["pending"] == 1, st
+    # the repair waits while podB's grant is open (single owner slot)
+    assert svc.next_file("r", "podC")["file"] is None
+    svc.file_done("r", "podB", 0)
+    rep = svc.next_file("r", "podC")
+    assert rep["file"][0] == 0 and rep["only"] == [[0, 4]], rep
+
+
+def test_get_batch_meta_replay_returns_same_metas(files):
+    """A retried get_batch_meta (same req_id) whose first response was
+    lost on the wire must receive the SAME metas back — otherwise they
+    strand in the pod's inflight with no consumer aware of them and
+    the epoch never drains."""
+    svc = DataService()
+    svc.create_reader("r", files[:1])
+    svc.next_file("r", "podA")
+    svc.report_batch_meta("r", "podA", "ep",
+                          [["podA:0", [[0, 0, 4]]], ["podA:1", [[0, 4, 8]]]])
+    first = svc.get_batch_meta("r", "podB", n=2, req_id=1)["metas"]
+    assert [m[2] for m in first] == ["podA:0", "podA:1"]
+    replay = svc.get_batch_meta("r", "podB", n=2, req_id=1)["metas"]
+    assert replay == first
+    # a replay that also carries acks re-delivers only the unacked rest
+    replay = svc.get_batch_meta("r", "podB", n=2, ack_ids=["podA:0"],
+                                req_id=1)["metas"]
+    assert [m[2] for m in replay] == ["podA:1"]
+    assert svc.reader_status("r")["consumed"]["0"] == [[0, 4]]
+
+
+def test_requeue_keeps_live_owner_journaled(memkv, files):
+    """A nack for a file whose full production is already in progress
+    on a LIVE pod must not delete that owner's journal record — a
+    rebuilt successor would double-grant the file (two producers
+    emitting overlapping spans = records trained twice)."""
+    journal = DataJournal(memkv, "j9")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    # dead producer podX reported a batch, then its file re-pended and
+    # was re-granted WHOLE to live podB
+    a.next_file("r", "podX")
+    a.report_batch_meta("r", "podX", "epX", [["podX:0", [[0, 0, 4]]]])
+    a.get_batch_meta("r", "podC", n=1)          # podC holds podX:0
+    a.mark_pod_dead("podX")
+    assert a.next_file("r", "podB")["file"][0] == 0  # re-granted to podB
+    # a late nack of podX's batch must leave podB's grant journaled
+    # (the nacked records, being in podB's skip, re-pend as a repair)
+    a.nack_batches("r", "podC", ["podX:0"], producer_dead=True)
+    b = DataService(journal=journal, rebuild_grace=0.0)
+    st = b.reader_status("r")
+    assert st["owned"] == 1 and st["pending"] == 1, st
+    assert b.next_file("r", "podB")["file"][0] == 0  # still podB's
+
+
+def test_gcd_generation_fails_fast(files):
+    """A straggler addressing a GC'd (superseded) generation must get
+    a hard error — not resurrect the dead epoch through the reattach
+    re-seed fallback."""
+    from edl_tpu.utils.exceptions import EdlDataError
+
+    svc = DataService()
+    svc.create_reader("t@e0@s", files[:1])
+    svc.create_reader("t@e1@s", files[:1])  # GCs t@e0@s
+    with pytest.raises(EdlDataError, match="superseded"):
+        svc.get_batch_meta("t@e0@s", "podA", n=1)
+    with pytest.raises(EdlDataError, match="superseded"):
+        svc.reattach_reader("t@e0@s", "podA", files=files[:1])
+    with pytest.raises(EdlDataError, match="superseded"):
+        svc.create_reader("t@e0@s", files[:1])
+
+
+def test_gcd_tombstone_survives_failover(memkv, files):
+    """The GC tombstone is durable: a SUCCESSOR leader also refuses a
+    straggler's reattach for a superseded generation (in-memory
+    _dead_readers alone would not survive the failover)."""
+    from edl_tpu.utils.exceptions import EdlDataError
+
+    journal = DataJournal(memkv, "jt")
+    a = DataService(journal=journal)
+    a.create_reader("t@e0@s", files[:1])
+    a.create_reader("t@e1@s", files[:1])  # GCs t@e0@s + journals "dead"
+    b = DataService(journal=journal, rebuild_grace=0.0)  # fresh successor
+    with pytest.raises(EdlDataError, match="superseded"):
+        b.reattach_reader("t@e0@s", "podB", files=files[:1])
+    assert b.reader_status("t@e1@s")["files"] == 1  # live gen rebuilds
+
+
+def test_pod_death_event_rebuilds_lazily(memkv, files):
+    """A registry-expiry event naming a generation the successor has
+    not served yet must force the journal rebuild and requeue the dead
+    pod's grants — the advert delete never fires twice."""
+    journal = DataJournal(memkv, "jl")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    a.next_file("r", "podX")
+    b = DataService(journal=journal, rebuild_grace=0.0)  # nothing served
+    b.mark_pod_dead("podX", reader="r")  # the expiry event
+    st = b.reader_status("r")
+    assert st["owned"] == 0 and st["pending"] == 1, st
+
+
+def test_reconcile_requeues_pods_with_no_advert(memkv, files):
+    """A successor leader reconciles journal-restored grants against
+    the live registry: a pod that died BEFORE the successor's watch
+    started (no delete event will ever fire) must not pin its files."""
+    journal = DataJournal(memkv, "jr2")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:2])
+    a.next_file("r", "podX")                       # podX owns file 0
+    b = DataService(journal=journal, rebuild_grace=0.0)
+    assert b.reconcile_pods("r", ["podY"])["dead"] == ["podX"]
+    st = b.reader_status("r")
+    assert st["owned"] == 0 and st["pending"] == 2, st
+
+
+# -- reader-side resilience --------------------------------------------------
+
+def test_reader_survives_transient_faults(files):
+    """Injected transport errors below the retry deadline cause ZERO
+    reader failures — retries are visible in metrics, not exceptions
+    (the acceptance criterion for a transient leader blip)."""
+    from edl_tpu.data.resilient import _RETRIES
+
+    a = PodDataServer("podA", is_leader=True)
+    faultinject.configure(
+        "client:get_batch_meta:error:0.3;client:next_file:error:0.3;"
+        "client:report_batch_meta:error:0.3", seed=7)
+    before = sum(_RETRIES.labels(op=op).value
+                 for op in ("get_batch_meta", "next_file",
+                            "report_batch_meta"))
+    try:
+        ra = DistributedReader("rf", "podA", a.endpoint, a, batch_size=4)
+        ra.create(files)
+        spans = []
+        got = []
+        for _bid, payload in ra:
+            got.extend(payload["records"])
+            spans.extend(payload["spans"])
+        assert sorted(got) == ALL
+        audit_spans(spans, 4, 10)
+        retried = sum(_RETRIES.labels(op=op).value
+                      for op in ("get_batch_meta", "next_file",
+                                 "report_batch_meta")) - before
+        assert retried > 0, "a 30% fault rate must have exercised retries"
+    finally:
+        faultinject.configure(None)
+        a.stop()
+
+
+def test_reader_reattaches_across_leader_restart(memkv, files):
+    """SIGKILL-equivalent: the leader server dies mid-epoch; a
+    successor (same journal) comes up on a DIFFERENT endpoint; the
+    reader re-resolves, reattaches, and finishes the epoch with every
+    record delivered exactly once."""
+    journal = DataJournal(memkv, "j6")
+    cache = PodDataServer("podA")
+    srv1, ep1 = serve(DataService(journal=journal, rebuild_grace=1.0))
+    endpoint = {"ep": ep1}
+    srv2 = None
+    try:
+        ra = DistributedReader("rk", "podA", lambda: endpoint["ep"], cache,
+                               batch_size=4, retry_deadline=30.0)
+        ra.create(files)
+        got, spans = [], []
+        it = iter(ra)
+        for _ in range(3):
+            _bid, payload = next(it)
+            got.extend(payload["records"])
+            spans.extend(payload["spans"])
+        # kill the leader mid-epoch, seat a successor elsewhere
+        srv1.stop()
+        srv2, ep2 = serve(DataService(journal=journal, rebuild_grace=1.0))
+        endpoint["ep"] = ep2
+        for _bid, payload in it:
+            got.extend(payload["records"])
+            spans.extend(payload["spans"])
+        assert sorted(got) == ALL
+        audit_spans(spans, 4, 10)
+    finally:
+        cache.stop()
+        for s in (srv1, srv2):
+            if s is not None:
+                s.stop()
+
+
+def test_resilient_client_raises_after_budget():
+    client = ResilientDataClient("127.0.0.1:1", timeout=0.2,
+                                 retry_deadline=0.8)
+    t0 = time.monotonic()
+    with pytest.raises(EdlCoordError):
+        client.call("reader_status", reader="x")
+    assert time.monotonic() - t0 < 10.0
+    client.close()
+
+
+def test_close_bounds_stuck_producer(files, caplog):
+    """A producer blocked in an in-flight leader call must not leak
+    past close(): the stop flag + capped call budget unwind it, and a
+    truly wedged thread is logged, not silently abandoned."""
+    srv = RpcServer("127.0.0.1", 0)
+    release = threading.Event()
+
+    def slow_next_file(reader, pod_id):
+        release.wait(30.0)  # a leader that never answers in time
+        return {"file": None, "skip": [], "eof": True}
+
+    svc = DataService()
+    svc.create_reader("rc", files[:1])
+    srv.register_instance(svc)
+    srv.register("next_file", slow_next_file)  # shadow with the stall
+    srv.start()
+    cache = PodDataServer("podA")
+    try:
+        ra = DistributedReader("rc", "podA", f"127.0.0.1:{srv.port}", cache,
+                               batch_size=4)
+        ra._files = files[:1]
+        ra._producer = threading.Thread(target=ra._produce, daemon=True)
+        ra._producer.start()
+        time.sleep(0.3)  # the producer is now blocked inside next_file
+        t0 = time.monotonic()
+        ra.close(deadline=1.0)
+        took = time.monotonic() - t0
+        assert took < 5.0, f"close() blocked {took:.1f}s on a stuck producer"
+    finally:
+        release.set()
+        cache.stop()
+        srv.stop()
+
+
+# -- registry watch ----------------------------------------------------------
+
+def test_wait_dist_readers_watch_reacts_fast(memkv):
+    from edl_tpu.data import register_reader, wait_dist_readers
+
+    reg_a = register_reader(memkv, "jw", "r", "podA", "epA")
+    done = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        done["got"] = wait_dist_readers(memkv, "jw", "r", ["podA", "podB"],
+                                        timeout=10.0)
+        done["took"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.4)
+    reg_b = register_reader(memkv, "jw", "r", "podB", "epB")
+    t.join(5.0)
+    assert not t.is_alive() and done["got"] == {"podA": "epA", "podB": "epB"}
+    # the watch must react well inside a poll tick of the old 0.2s loop
+    assert done["took"] < 2.0, done
+    reg_a.stop(), reg_b.stop()
+
+
+def test_wait_dist_readers_falls_back_to_polling(memkv):
+    from edl_tpu.data import register_reader, wait_dist_readers
+
+    class NoWatch:
+        """Store whose watch path is broken (old server)."""
+
+        def __init__(self, kv):
+            self._kv = kv
+
+        def get_prefix(self, prefix):
+            return self._kv.get_prefix(prefix)
+
+        def wait(self, prefix, since_revision, timeout):
+            raise NotImplementedError("old server")
+
+    reg = register_reader(memkv, "jp", "r", "podA", "epA")
+    got = wait_dist_readers(NoWatch(memkv), "jp", "r", ["podA"], timeout=5.0)
+    assert got == {"podA": "epA"}
+    reg.stop()
+
+
+def test_wait_dist_readers_timeout(memkv):
+    from edl_tpu.data import wait_dist_readers
+    from edl_tpu.utils.exceptions import EdlDataError
+
+    t0 = time.monotonic()
+    with pytest.raises(EdlDataError):
+        wait_dist_readers(memkv, "jt", "r", ["ghost"], timeout=0.6)
+    assert time.monotonic() - t0 < 5.0
+
+
+# -- end-of-data across rebuild ----------------------------------------------
+
+def test_drain_completes_on_successor(memkv, files):
+    """The generation drains to EdlStopIteration on the successor: done
+    files stay done, parked work resolves, and eof gates on the grace."""
+    journal = DataJournal(memkv, "j8")
+    a = DataService(journal=journal)
+    a.create_reader("r", files[:1])
+    a.next_file("r", "podA")
+    a.report_batch_meta("r", "podA", "ep", [["podA:0", [[0, 0, 10]]]])
+    a.file_done("r", "podA", 0)
+    b = DataService(journal=journal, rebuild_grace=0.2)
+    b.get_batch_meta("r", "podA", n=0, ack_ids=["podA:0"])  # ack from parked
+    time.sleep(0.25)
+    with pytest.raises(EdlStopIteration):
+        b.get_batch_meta("r", "podA", n=1)
+    assert b.next_file("r", "podA")["eof"] is True

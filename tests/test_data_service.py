@@ -12,6 +12,7 @@ from edl_tpu.data import DistributedReader, PodDataServer
 from edl_tpu.data.data_server import merge_span
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils.exceptions import EdlDataError, EdlStopIteration
+from tests.helpers.exactly_once import audit_spans
 
 ALL = sorted(f"f{f}r{r}" for f in range(4) for r in range(10))
 
@@ -30,10 +31,12 @@ def make_pod(pod_id, leader=False):
     return PodDataServer(pod_id, is_leader=leader)
 
 
-def drain(reader):
+def drain(reader, spans: list | None = None):
     got = []
     for _bid, payload in reader:
         got.extend(payload["records"])
+        if spans is not None:
+            spans.extend(payload["spans"])
     return got
 
 
@@ -62,16 +65,19 @@ def test_two_pods_exactly_once(files):
         ra.create(files)
         rb.create(files)
         got = {"podA": [], "podB": []}
+        spans = {"podA": [], "podB": []}
 
         def consume(r, key):
-            got[key].extend(drain(r))
+            got[key].extend(drain(r, spans[key]))
 
         ta = threading.Thread(target=consume, args=(ra, "podA"))
         tb = threading.Thread(target=consume, args=(rb, "podB"))
         ta.start(); tb.start(); ta.join(20); tb.join(20)
         assert not ta.is_alive() and not tb.is_alive()
-        # exactly-once across both consumers, whatever the steal split
+        # exactly-once across both consumers, whatever the steal split:
+        # the raw span log proves full coverage AND zero overlap
         assert sorted(got["podA"] + got["podB"]) == ALL
+        audit_spans(spans["podA"] + spans["podB"], 4, 10)
     finally:
         a.stop(); b.stop()
 
@@ -206,9 +212,11 @@ def test_cache_eviction_repairs_without_killing_producer(files):
         ra = DistributedReader("rv", "podA", a.endpoint, a, batch_size=4)
         ra._backpressure = 10_000  # defeat throttling to force eviction
         ra.create(files[:1])
-        got = drain(ra)  # 3 batches published, cache keeps 2: one miss
+        spans: list = []
+        got = drain(ra, spans)  # 3 batches published, cache keeps 2: one miss
         assert sorted(got) == sorted(f"f0r{r}" for r in range(10))
         assert len(got) == 10  # exactly once — no double production
+        audit_spans(spans, 1, 10)
     finally:
         a.stop()
 
