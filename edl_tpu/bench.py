@@ -334,6 +334,17 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- alerting loop: detection latency + scrape-loop overhead (ISSUE 9) ---
+    # stall a synthetic trainer target and measure how long the
+    # aggregator's built-in trainer-hang rule takes to fire, plus what
+    # the background scrape loop costs a co-located step loop
+    if os.environ.get("EDL_TPU_BENCH_ALERTS", "1") != "0":
+        try:
+            out.update(_bench_alerts())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -555,6 +566,107 @@ def _bench_data_outage() -> dict:
                 except Exception:  # noqa: BLE001 — teardown
                     pass
         kv.close()
+
+
+def _bench_alerts() -> dict:
+    """Alerting-loop microbench (ISSUE 9).  Reported:
+
+    - ``alert_detect_latency_s`` — a live synthetic "trainer" target
+      (a real MetricsServer + coord advert, scraped over HTTP by a
+      real Aggregator scrape loop) stops observing steps; how long
+      until the BUILT-IN trainer-hang rule fires.  The floor is the
+      rule's window+hold (scaled via EDL_TPU_ALERT_SCALE), so the
+      number measures engine/loop slack on top of the declared bound;
+    - ``obs_scrape_overhead_pct`` — the same jitted step loop timed
+      with no aggregator vs with a background scrape loop actively
+      scraping this process's registry (best-of-3 each: the scrape
+      work rides other threads, so this is GIL/socket contention).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.obs import advert as obs_advert
+    from edl_tpu.obs import rules as obs_rules
+    from edl_tpu.obs.agg import Aggregator
+    from edl_tpu.obs.exposition import MetricsServer
+    from edl_tpu.obs.metrics import DEFAULT_BUCKETS, Registry
+
+    scale = float(os.environ.get("EDL_TPU_BENCH_ALERT_SCALE", 0.05))
+    interval = float(os.environ.get("EDL_TPU_BENCH_ALERT_INTERVAL", 0.2))
+    os.environ["EDL_TPU_ALERT_SCALE"] = str(scale)
+    rules = obs_rules.builtin_rules()
+    hang = next(r for r in rules if r.name == "trainer-hang")
+
+    reg = Registry()
+    steps = reg.histogram("edl_train_step_seconds", "steps",
+                          buckets=DEFAULT_BUCKETS)
+    srv = MetricsServer(reg, host="127.0.0.1").start()
+    kv = MemoryKV()
+    out: dict = {}
+    advert_reg = obs_advert.advertise_metrics(
+        kv, "bench-alerts", "trainer", srv.endpoint, ttl=60)
+    agg = Aggregator(kv, "bench-alerts", cache_s=0.0,
+                     scrape_interval=interval, rules=rules,
+                     include_self=False, incident_dir="")
+    try:
+        agg.start_loop()
+        # healthy phase: keep observing steps until the rule's window
+        # is covered and the engine reads "progressing"
+        deadline = time.monotonic() + hang.window * 4 + 30.0
+        while time.monotonic() < deadline:
+            steps.observe(0.01)
+            vals = hang.values(agg.tsdb, time.time())
+            if vals and not hang.condition(next(iter(vals.values()))):
+                break
+            time.sleep(interval / 2)
+        else:
+            raise RuntimeError("hang rule never saw healthy progress")
+        t_stall = time.monotonic()  # steps stop HERE
+        deadline = t_stall + (hang.window + hang.for_s) * 4 + 30.0
+        while time.monotonic() < deadline:
+            if any(a["alert"] == "trainer-hang"
+                   for a in agg.engine.firing()):
+                break
+            time.sleep(interval / 4)
+        else:
+            raise RuntimeError("trainer-hang alert never fired")
+        out["alert_detect_latency_s"] = round(time.monotonic() - t_stall, 3)
+        out["alert_rule_bound_s"] = round(hang.window + hang.for_s, 3)
+        agg.stop_loop()
+
+        # scrape-loop overhead on a co-located step loop (the advert
+        # stays up: the loop must really scrape this process over HTTP)
+        n = int(os.environ.get("EDL_TPU_BENCH_ALERT_STEPS", 150))
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(256, 256)).astype(np.float32))
+        step = jax.jit(lambda a: a @ a)
+        step(x).block_until_ready()
+
+        def run_steps() -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                steps.observe(0.01)
+                step(x).block_until_ready()
+            return (time.perf_counter() - t0) / n
+
+        base_s = min(run_steps() for _ in range(3))
+        agg2 = Aggregator(kv, "bench-alerts", cache_s=0.0,
+                          scrape_interval=interval, rules=rules,
+                          include_self=False, incident_dir="")
+        agg2.start_loop()
+        try:
+            loop_s = min(run_steps() for _ in range(3))
+        finally:
+            agg2.stop_loop()
+        out["obs_scrape_overhead_pct"] = round(
+            100.0 * (loop_s - base_s) / max(base_s, 1e-12), 2)
+    finally:
+        agg.stop_loop()
+        advert_reg.stop()
+        srv.stop()
+        kv.close()
+    return out
 
 
 def _bench_transfer() -> dict:

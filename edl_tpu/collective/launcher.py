@@ -171,6 +171,10 @@ class Launcher:
         cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
                                      timeout=self._barrier_timeout)
         save_pod_status(self._store, job_id, self._pod.pod_id, Status.RUNNING)
+        # publish this generation's trace_id so store readers (the
+        # aggregator's incident records, edl-obs-top) can join what
+        # they observe to this generation's causal span timeline
+        self._publish_stage_trace(job_id, cluster.stage)
 
         resize_times: dict | None = None
         while True:  # one iteration per cluster generation (stage)
@@ -205,6 +209,7 @@ class Launcher:
             # phase event below, the recovery-record trace events, and
             # the respawned trainers' spans all carry its trace_id
             self._stage_ctx = obs_context.new_trace()
+            self._publish_stage_trace(job_id, cluster.stage)
             # tagged from_stage: the change is detected in the OLD stage;
             # the per-phase events land under the post-barrier stage id
             # (the stage the recovery record is keyed by)
@@ -466,6 +471,18 @@ class Launcher:
     def _log_dir(self) -> str:
         import os
         return os.path.join(self._job_env.log_dir, self._pod.pod_id[:8])
+
+    def _publish_stage_trace(self, job_id: str,
+                             stage: str | None = None) -> None:
+        """Publish this pod's current generation trace as the job-wide
+        ``trace/current`` record — LEADER only: every pod roots its own
+        per-generation context, and letting all of them write one key
+        would make the record last-writer-wins across pods (flapping
+        every resize, and joining incidents to an arbitrary pod's
+        timeline).  Best-effort, like everything observability."""
+        if self._elector is not None and self._elector.is_leader:
+            obs_advert.publish_job_trace(self._store, job_id,
+                                         self._stage_ctx, stage=stage)
 
     def _trainer_trace_env(self) -> dict[str, str]:
         """Env for spawned trainers: the current stage's trace context,

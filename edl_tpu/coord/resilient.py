@@ -52,6 +52,11 @@ _FAILOVERS = obs_metrics.counter(
     "edl_coord_failovers_total",
     "Coordination client switches to another endpoint after a transport "
     "error")
+_OUTAGE_S = obs_metrics.gauge(
+    "edl_coord_outage_seconds",
+    "Duration of the last coord-store outage this client rode out "
+    "(first failed op to the next success) — the client-observed MTTR "
+    "the aggregator's coord-mttr-regression rule watches")
 
 
 class ResilientCoordClient(KVStore):
@@ -83,6 +88,7 @@ class ResilientCoordClient(KVStore):
         self._clients: dict[str, CoordClient] = {}
         self._cur = self._start_index  # seat on the caller-verified endpoint
         self._cur_errors = 0  # consecutive transport errors on _cur
+        self._outage_began: float | None = None  # first failure since last ok
         self._closed = False
         self._local = threading.local()  # scoped deadline override
         self._rng = random.Random()
@@ -111,9 +117,16 @@ class ResilientCoordClient(KVStore):
     def _note_ok(self) -> None:
         with self._lock:
             self._cur_errors = 0
+            if self._outage_began is not None:
+                # the first success after >=1 transport failures closes
+                # an observed outage: record how long the blip lasted
+                _OUTAGE_S.set(time.monotonic() - self._outage_began)
+                self._outage_began = None
 
     def _fail_over(self, from_ep: str) -> None:
         with self._lock:
+            if self._outage_began is None:
+                self._outage_began = time.monotonic()
             if self.endpoints[self._cur] != from_ep:
                 return  # another thread already moved on
             self._cur_errors += 1

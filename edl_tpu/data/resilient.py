@@ -54,6 +54,12 @@ _RETRIES = obs_metrics.counter(
 _FAILOVERS = obs_metrics.counter(
     "edl_data_rpc_failovers_total",
     "Data-plane leader client switches to a re-resolved leader endpoint")
+_OUTAGE_S = obs_metrics.gauge(
+    "edl_data_leader_outage_seconds",
+    "Duration of the last data-leader outage this reader rode out "
+    "(first failed leader call to the next success) — the "
+    "client-observed MTTR the aggregator's data-leader-mttr rule "
+    "watches")
 
 
 class ResilientDataClient:
@@ -87,6 +93,7 @@ class ResilientDataClient:
         self._attach_lock = threading.Lock()
         self._attach_gen = 0
         self._need_attach = False
+        self._outage_began: float | None = None  # first failure since last ok
         self._rng = random.Random()
 
     # -- endpoint management -------------------------------------------------
@@ -214,6 +221,12 @@ class ResilientDataClient:
                     op, _timeout=max(0.25, min(self._timeout, remaining)),
                     **kwargs)
                 self._note_incarnation(resp)
+                with self._lock:
+                    if self._outage_began is not None:
+                        # first success after >=1 leader-call failures:
+                        # record how long the data plane was stalled
+                        _OUTAGE_S.set(time.monotonic() - self._outage_began)
+                        self._outage_began = None
                 return resp
             except EdlReaderGoneError:
                 # the addressed service has no state for this reader:
@@ -227,6 +240,9 @@ class ResilientDataClient:
                 attempt += 1
             except EdlCoordError as e:
                 _RETRIES.labels(op=op).inc()
+                with self._lock:
+                    if self._outage_began is None:
+                        self._outage_began = time.monotonic()
                 attempt += 1
                 # a transport failure may be the leader dying: whatever
                 # answers next (successor, or the same server reborn)

@@ -71,6 +71,38 @@ def list_metrics_targets(store, job_id: str) -> dict[str, dict]:
     return out
 
 
+def publish_job_trace(store, job_id: str, ctx, stage: str | None = None
+                      ) -> None:
+    """Publish the job's CURRENT generation trace context (the launcher
+    calls this each time it roots a new cluster-generation trace), so
+    store readers — the aggregator's rule engine stamping incident
+    records, ``edl-obs-top`` — can link what they observe *now* to the
+    causal span timeline of the generation it happened in.  Best-effort,
+    never raises: observability must never fail a job."""
+    try:
+        payload = {"trace_id": ctx.trace_id, "ts": time.time()}
+        if stage is not None:
+            payload["stage"] = stage
+        store.put(paths.key(job_id, constants.ETCD_OBS, "trace/current"),
+                  json.dumps(payload).encode())
+    except Exception:  # noqa: BLE001 — metrics must never fail a job
+        logger.exception("job trace publish failed for %s", job_id)
+
+
+def current_job_trace(store, job_id: str) -> dict | None:
+    """The last published generation trace record
+    (``{"trace_id", "ts"[, "stage"]}``), or None."""
+    rec = store.get(paths.key(job_id, constants.ETCD_OBS, "trace/current"))
+    if rec is None:
+        return None
+    try:
+        payload = json.loads(rec.value.decode())
+        payload["trace_id"]
+    except (ValueError, KeyError, TypeError, AttributeError):
+        return None
+    return payload
+
+
 def advertise_installed(store, job_id: str, component: str,
                         ttl: float = constants.ETCD_TTL,
                         session: CoordSession | None = None

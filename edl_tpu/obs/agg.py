@@ -13,8 +13,20 @@ each endpoint, and serves
   appears exactly once even when several processes export the same
   name with different label sets;
 - ``/healthz`` — a JSON job summary: live processes by component, last
-  resize duration (from the store's recovery records), and gateway
-  p50/p99 estimated from the merged request-latency histogram.
+  resize duration (from the store's recovery records), gateway p50/p99
+  over a trailing window (lifetime-cumulative fallback is marked
+  ``"window": "lifetime"``), windowed throughput rates, and the
+  PR 6–7 robustness headlines (coord/data-leader MTTR, hang restarts,
+  requeue/reattach counters);
+- ``/alerts`` — the rule engine's firing/pending alerts as JSON
+  (:mod:`edl_tpu.obs.rules`), evaluated by the background scrape loop.
+
+A background **scrape loop** (``EDL_TPU_OBS_SCRAPE_INTERVAL``) feeds
+every scrape into an in-memory ring-buffer TSDB
+(:mod:`edl_tpu.obs.tsdb`, retention ``EDL_TPU_OBS_RETENTION``) and
+evaluates the alert ruleset against it, so history-dependent questions
+(rates, windowed quantiles, "has anything progressed in the last
+minute") are answerable without an external Prometheus.
 
 Discovery is store-driven, so targets come and go with their leases —
 a killed replica vanishes from the merged page within one TTL, a
@@ -26,6 +38,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import threading
 import time
@@ -35,7 +48,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from edl_tpu.obs import advert
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import rules as obs_rules
 from edl_tpu.obs.metrics import REGISTRY, parse_exposition
+from edl_tpu.obs.tsdb import TSDB, quantile_from_buckets  # noqa: F401 — re-export
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
 
@@ -49,6 +64,14 @@ _SCRAPES_TOTAL = obs_metrics.counter(
 _COLLECT_SECONDS = obs_metrics.histogram(
     "edl_obs_agg_collect_seconds",
     "Full discover+scrape+merge latency")
+_LOOP_SECONDS = obs_metrics.histogram(
+    "edl_obs_agg_scrape_loop_seconds",
+    "One background scrape-loop iteration: collect + ingest + rules")
+
+# cap the scrape fan-out pool, not the parallelism policy: the pool is
+# sized to len(targets) so EVERY dead target times out concurrently —
+# the ceiling only bounds thread spam on absurd fleets
+_SCRAPE_POOL_CEILING = 64
 
 _FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
 
@@ -126,29 +149,6 @@ def merge_expositions(pages) -> str:
     return "\n".join(lines) + "\n" if lines else ""
 
 
-def quantile_from_buckets(buckets: dict[float, float],
-                          q: float) -> float | None:
-    """Prometheus-style quantile estimate from cumulative ``le`` bucket
-    counts (linear interpolation within the winning bucket; the +Inf
-    bucket resolves to the previous bound, the classic histogram_quantile
-    behavior).  None when the histogram is empty."""
-    items = sorted(buckets.items())
-    if not items or items[-1][1] <= 0:
-        return None
-    total = items[-1][1]
-    target = q * total
-    prev_le, prev_c = 0.0, 0.0
-    for le, c in items:
-        if c >= target:
-            if le == math.inf:
-                return prev_le
-            span = c - prev_c
-            frac = 0.0 if span <= 0 else (target - prev_c) / span
-            return prev_le + (le - prev_le) * frac
-        prev_le, prev_c = le, c
-    return None
-
-
 def _histogram_buckets(parsed: dict, family: str) -> dict[float, float]:
     """Sum a family's cumulative bucket counts across all targets."""
     out: dict[float, float] = {}
@@ -163,20 +163,115 @@ def _histogram_buckets(parsed: dict, family: str) -> dict[float, float]:
 
 
 class Aggregator:
-    """Discover + scrape + merge; the HTTP surface sits on top.
+    """Discover + scrape + merge + remember; the HTTP surface sits on
+    top.
 
     ``collect()`` results are cached ``cache_s`` seconds so N scrapers
-    of the aggregator amplify into at most one fan-out per window."""
+    of the aggregator amplify into at most one fan-out per window.
+    :meth:`scrape_once` additionally ingests the scrape into the
+    ring-buffer TSDB and runs the rule engine over it — the background
+    loop (:meth:`start_loop`) calls it every ``scrape_interval``
+    seconds, turning the point-in-time scraper into a closed
+    observability loop with history, rates and alerts."""
 
     def __init__(self, store, job_id: str, scrape_timeout: float = 3.0,
-                 cache_s: float = 0.5, include_self: bool = True):
+                 cache_s: float = 0.5, include_self: bool = True,
+                 scrape_interval: float | None = None,
+                 retention_s: float | None = None,
+                 quantile_window: float | None = None,
+                 rules: list | None = None,
+                 incident_dir: str | None = None):
         self.store = store
         self.job_id = job_id
         self.scrape_timeout = scrape_timeout
         self.cache_s = cache_s
         self.include_self = include_self
+        self.scrape_interval = (
+            float(os.environ.get("EDL_TPU_OBS_SCRAPE_INTERVAL", 5.0))
+            if scrape_interval is None else float(scrape_interval))
+        self.quantile_window = (
+            float(os.environ.get("EDL_TPU_OBS_QUANTILE_WINDOW", 120.0))
+            if quantile_window is None else float(quantile_window))
+        retention = (float(os.environ.get("EDL_TPU_OBS_RETENTION", 600.0))
+                     if retention_s is None else float(retention_s))
+        self.tsdb = TSDB(retention_s=retention)
+        self.engine = obs_rules.RuleEngine(
+            self.tsdb,
+            obs_rules.load_rules() if rules is None else rules,
+            incident_log=obs_rules.IncidentLog(incident_dir, "obs-agg",
+                                               job_id),
+            trace_provider=self._job_trace_id)
         self._lock = threading.Lock()
         self._cached: tuple[float, str, dict] | None = None
+        # summarize_recovery hits the coord store; /healthz must not
+        # stall on a slow store even when collect() is cache-fresh
+        self._recovery_cache: tuple[float, object] | None = None
+        self._trace_cache: tuple[float, str | None] | None = None
+        self._loop_stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+
+    # -- background scrape loop ---------------------------------------------
+    def scrape_once(self, now: float | None = None) -> None:
+        """One loop iteration: fan-out scrape (through the collect
+        cache), ingest into the TSDB, evaluate the ruleset.  Never
+        raises — observability must outlive its own bad scrapes."""
+        t0 = time.perf_counter()
+        now = time.time() if now is None else now
+        try:
+            merged, _info = self.collect()
+            self.tsdb.ingest(parse_exposition(merged), now)
+            self.engine.evaluate(now)
+        except Exception:  # noqa: BLE001 — the loop must survive anything
+            logger.exception("scrape loop iteration failed")
+        _LOOP_SECONDS.observe(time.perf_counter() - t0)
+
+    def start_loop(self) -> None:
+        """Start the background scrape loop (idempotent; a
+        non-positive ``scrape_interval`` disables it)."""
+        if self.scrape_interval <= 0 or self._loop_thread is not None:
+            return
+        self._loop_stop.clear()
+
+        def run():
+            while not self._loop_stop.is_set():
+                self.scrape_once()
+                self._loop_stop.wait(self.scrape_interval)
+
+        self._loop_thread = threading.Thread(
+            target=run, daemon=True, name=f"obs-agg-loop:{self.job_id}")
+        self._loop_thread.start()
+
+    def stop_loop(self) -> None:
+        self._loop_stop.set()
+        t, self._loop_thread = self._loop_thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _scoped(self, seconds: float):
+        sd = getattr(self.store, "scoped_deadline", None)
+        if sd is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return sd(seconds)
+
+    def _job_trace_id(self) -> str | None:
+        """The job's current generation trace_id (published by the
+        launcher — obs/advert.py), briefly cached; None on any miss."""
+        with self._lock:
+            cached = self._trace_cache
+        if cached is not None and time.monotonic() - cached[0] < 5.0:
+            return cached[1]
+        tid = None
+        try:
+            with self._scoped(2.0):
+                rec = advert.current_job_trace(self.store, self.job_id)
+            if rec:
+                tid = rec.get("trace_id")
+        except Exception:  # noqa: BLE001 — store blip must not stop alerting
+            pass
+        with self._lock:
+            self._trace_cache = (time.monotonic(), tid)
+        return tid
 
     def collect(self) -> tuple[str, dict]:
         """(merged exposition text, info dict) — info carries targets,
@@ -202,9 +297,13 @@ class Aggregator:
             # concurrent scrapes: dead targets' adverts outlive them by
             # up to one lease TTL, so with sequential fetches every
             # dead process would add a full timeout to EVERY request —
-            # in parallel the whole fan-out costs at most one timeout
+            # the pool is sized to len(targets) (not a small constant:
+            # >8 targets with several dead ones would degrade back to
+            # wave-of-timeouts behavior) so the whole fan-out costs at
+            # most ONE timeout regardless of how many targets are dead
             with ThreadPoolExecutor(
-                    max_workers=min(8, max(1, len(targets)))) as pool:
+                    max_workers=min(_SCRAPE_POOL_CEILING,
+                                    max(1, len(targets)))) as pool:
                 futures = {name: pool.submit(scrape, name)
                            for name in sorted(targets)}
                 for name, fut in futures.items():
@@ -230,9 +329,40 @@ class Aggregator:
             self._cached = (time.monotonic(), merged, info)
             return merged, info
 
+    def _recovery_summary(self):
+        """``summarize_recovery`` behind a cache + a scoped deadline:
+        /healthz is a health probe — a slow coord store must cost it at
+        most one bounded read per cache window, like ``FleetView``'s
+        inline refresh, instead of an unbounded store scan per request
+        even when ``collect()`` was cache-fresh."""
+        with self._lock:
+            cached = self._recovery_cache
+        if (cached is not None and time.monotonic() - cached[0]
+                < max(self.cache_s, 1.0)):
+            return cached[1]
+        # lazy: summarize_recovery pulls the cluster layer (same
+        # reason dump/collector stay out of obs/__init__)
+        from edl_tpu.cluster.recovery import summarize_recovery
+        with self._scoped(2.0):
+            resizes = summarize_recovery(self.store, self.job_id)
+        with self._lock:
+            self._recovery_cache = (time.monotonic(), resizes)
+        return resizes
+
+    @staticmethod
+    def _metric_sum(parsed: dict, name: str) -> float | None:
+        vals = [v for (n, _l), v in parsed.items() if n == name]
+        return sum(vals) if vals else None
+
+    @staticmethod
+    def _metric_max(parsed: dict, name: str) -> float | None:
+        vals = [v for (n, _l), v in parsed.items() if n == name]
+        return max(vals) if vals else None
+
     def job_summary(self) -> dict:
         """The /healthz body: live pods by component, resize + gateway
-        headline numbers — the one-request job overview."""
+        headline numbers, windowed rates, robustness headlines and the
+        firing-alert roll-up — the one-request job overview."""
         merged, info = self.collect()
         components: dict[str, int] = {}
         for t in info["targets"].values():
@@ -245,39 +375,87 @@ class Aggregator:
             "scrape_errors": info["errors"],
         }
         try:
-            # lazy: summarize_recovery pulls the cluster layer (same
-            # reason dump/collector stay out of obs/__init__)
-            from edl_tpu.cluster.recovery import summarize_recovery
-            resizes = summarize_recovery(self.store, self.job_id)
+            resizes = self._recovery_summary()
             summary["resizes"] = len(resizes)
             summary["last_resize"] = resizes[-1] if resizes else None
         except Exception as e:  # noqa: BLE001 — store blip must not 500 healthz
             summary["resizes_error"] = f"{type(e).__name__}: {e}"
         try:
             parsed = parse_exposition(merged)
-            buckets = _histogram_buckets(parsed, "edl_gateway_request_seconds")
-            if buckets:
-                p50 = quantile_from_buckets(buckets, 0.50)
-                p99 = quantile_from_buckets(buckets, 0.99)
-                summary["gateway"] = {
-                    "requests": buckets.get(math.inf, 0.0),
-                    "p50_s": None if p50 is None else round(p50, 4),
-                    "p99_s": None if p99 is None else round(p99, 4),
-                }
         except ValueError as e:
             summary["merge_error"] = str(e)
+            return summary
+        summary.update(self._gateway_summary(parsed))
+        # PR 6-7 robustness headlines: visible on every probe, not only
+        # to whoever scrapes at the right instant
+        robustness = {
+            "coord_restart_mttr_s": self._metric_max(
+                parsed, "edl_coord_outage_seconds"),
+            "data_leader_mttr_s": self._metric_max(
+                parsed, "edl_data_leader_outage_seconds"),
+            "hang_restarts": self._metric_sum(
+                parsed, "edl_hang_restarts_total") or 0.0,
+            "data_spans_requeued": self._metric_sum(
+                parsed, "edl_data_spans_requeued_total") or 0.0,
+            "data_reader_reattaches": self._metric_sum(
+                parsed, "edl_data_reader_reattaches_total") or 0.0,
+            "coord_retries": self._metric_sum(
+                parsed, "edl_coord_retries_total") or 0.0,
+        }
+        summary["robustness"] = robustness
+        # windowed throughput rates (TSDB history permitting)
+        w = self.quantile_window
+        rates = {}
+        for key, metric in (
+                ("train_steps_per_s", "edl_train_step_seconds_count"),
+                ("gateway_requests_per_s", "edl_gateway_requests_total"),
+                ("data_batches_per_s", "edl_data_batches_acked_total")):
+            r = self.tsdb.rate(metric, w)
+            if r:
+                rates[key] = round(sum(r.values()), 4)
+        if rates:
+            summary["rates"] = rates
+        alerts = self.engine.firing()
+        summary["alerts"] = {"firing": len(alerts),
+                             "names": sorted({a["alert"] for a in alerts})}
         return summary
+
+    def _gateway_summary(self, parsed: dict) -> dict:
+        """Gateway p50/p99 over the trailing quantile window when the
+        TSDB has history; falls back to the lifetime-cumulative buckets
+        — explicitly marked ``"window": "lifetime"``, because a
+        lifetime quantile is meaningless after the first traffic
+        shift."""
+        family = "edl_gateway_request_seconds"
+        win = self.tsdb.window_buckets(family, self.quantile_window)
+        if win and win.get(math.inf, 0.0) > 0:
+            buckets, window = win, f"{self.quantile_window:g}s"
+        else:
+            buckets, window = _histogram_buckets(parsed, family), "lifetime"
+        if not buckets:
+            return {}
+        p50 = quantile_from_buckets(buckets, 0.50)
+        p99 = quantile_from_buckets(buckets, 0.99)
+        return {"gateway": {
+            "requests": buckets.get(math.inf, 0.0),
+            "window": window,
+            "p50_s": None if p50 is None else round(p50, 4),
+            "p99_s": None if p99 is None else round(p99, 4),
+        }}
 
 
 class AggregatorServer:
-    """The aggregator behind HTTP: ``/metrics`` (merged page) and
-    ``/healthz`` (JSON job summary)."""
+    """The aggregator behind HTTP: ``/metrics`` (merged page),
+    ``/healthz`` (JSON job summary) and ``/alerts`` (rule-engine
+    state).  ``start()`` also starts the background scrape loop."""
 
     def __init__(self, store, job_id: str, host: str = "0.0.0.0",
                  port: int = 0, scrape_timeout: float = 3.0,
-                 cache_s: float = 0.5, include_self: bool = True):
+                 cache_s: float = 0.5, include_self: bool = True,
+                 **agg_kwargs):
         agg = Aggregator(store, job_id, scrape_timeout=scrape_timeout,
-                         cache_s=cache_s, include_self=include_self)
+                         cache_s=cache_s, include_self=include_self,
+                         **agg_kwargs)
         self.aggregator = agg
 
         class _Handler(BaseHTTPRequestHandler):
@@ -290,6 +468,10 @@ class AggregatorServer:
                                  "charset=utf-8")
                     elif path == "/healthz":
                         body = (json.dumps(agg.job_summary())
+                                .encode("utf-8"))
+                        ctype = "application/json"
+                    elif path == "/alerts":
+                        body = (json.dumps(agg.engine.to_json())
                                 .encode("utf-8"))
                         ctype = "application/json"
                     else:
@@ -327,9 +509,11 @@ class AggregatorServer:
                                         daemon=True,
                                         name=f"obs-agg:{self.port}")
         self._thread.start()
+        self.aggregator.start_loop()
         return self
 
     def stop(self) -> None:
+        self.aggregator.stop_loop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -348,6 +532,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--scrape_timeout", type=float, default=3.0)
     p.add_argument("--cache_s", type=float, default=0.5,
                    help="merged-page cache window (bounds scrape fan-out)")
+    p.add_argument("--scrape_interval", type=float, default=None,
+                   help="background TSDB scrape loop period "
+                        "(default EDL_TPU_OBS_SCRAPE_INTERVAL=5; <=0 "
+                        "disables history + alerting)")
+    p.add_argument("--retention", type=float, default=None,
+                   help="TSDB retention window in seconds "
+                        "(default EDL_TPU_OBS_RETENTION=600)")
     args = p.parse_args(argv)
 
     from edl_tpu import obs
@@ -360,9 +551,11 @@ def main(argv: list[str] | None = None) -> int:
     server = AggregatorServer(store, args.job_id, host=args.host,
                               port=args.port,
                               scrape_timeout=args.scrape_timeout,
-                              cache_s=args.cache_s).start()
+                              cache_s=args.cache_s,
+                              scrape_interval=args.scrape_interval,
+                              retention_s=args.retention).start()
     print(f"[edl-obs-agg] job {args.job_id}: serving merged /metrics + "
-          f"/healthz on {server.endpoint}", flush=True)
+          f"/healthz + /alerts on {server.endpoint}", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -11,7 +11,8 @@ Two modes:
   events all report the same numbers: they share one read path over one
   write path (recovery.write_*_half).
 - **Merge mode** (``--trace_dir`` [+ ``--merge``]): join every
-  process's JSONL trace file in a shared directory into causally
+  process's JSONL trace file in a shared directory — plus the
+  aggregator's ``incidents-*.jsonl`` alert records — into causally
   ordered per-trace timelines (grouped by the ``trace_id`` the
   distributed context stamped on each event — obs/context.py), and
   optionally export Chrome/Perfetto ``trace_event`` JSON
@@ -109,12 +110,17 @@ def read_trace_file(path: str) -> tuple[list[dict], int]:
 
 def read_trace_dir(trace_dir: str) -> tuple[list[dict], int]:
     """Every ``trace-*.jsonl`` (and rotated ``.jsonl.1``) in the shared
-    directory; events are tagged with their source ``file`` so merged
-    views can attribute each event to a process."""
+    directory, plus the aggregator's ``incidents-*.jsonl`` alert
+    records (:mod:`edl_tpu.obs.rules` writes them trace-event-shaped
+    and stamped with the job's generation trace_id, so a firing alert
+    lands inside the causal timeline of the resize/hang it belongs to);
+    events are tagged with their source ``file`` so merged views can
+    attribute each event to a process."""
     events: list[dict] = []
     skipped = 0
     paths = sorted(glob.glob(os.path.join(trace_dir, "trace-*.jsonl"))
-                   + glob.glob(os.path.join(trace_dir, "trace-*.jsonl.1")))
+                   + glob.glob(os.path.join(trace_dir, "trace-*.jsonl.1"))
+                   + glob.glob(os.path.join(trace_dir, "incidents-*.jsonl")))
     for path in paths:
         try:
             evs, bad = read_trace_file(path)

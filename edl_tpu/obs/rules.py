@@ -1,0 +1,490 @@
+"""Declarative recording + alert rules over the aggregator's TSDB.
+
+Prometheus turned raw expositions into operational signal with two
+small ideas — recording rules (precompute a windowed expression into a
+new series) and alert rules (expression + ``for:`` hold duration +
+severity).  This module is that engine, dependency-free, evaluated by
+the aggregator's scrape loop against :class:`~edl_tpu.obs.tsdb.TSDB`:
+
+- a :class:`Rule` is one declarative spec — ``kind`` picks the windowed
+  expression (``gauge`` / ``rate`` / ``stalled`` / ``quantile`` /
+  ``outlier``), ``op``+``threshold`` the condition, ``for_s`` how long
+  it must hold continuously before the alert FIRES, ``by`` a label to
+  fan the rule out per group (one alert instance per pod/instance);
+- :func:`builtin_rules` ships the signals this repo already emits:
+  trainer hang (no ``edl_train_step_seconds`` progress across live
+  trainer targets), per-pod straggler (windowed step latency vs the
+  fleet median), data-starvation burn (span requeue rate), coord /
+  data-leader MTTR regression (the PR 6–7 outage gauges), gateway p99
+  SLO burn and admission-reject rate, hang-watchdog restarts;
+- operators extend/override with ``EDL_TPU_ALERT_RULES`` — inline JSON
+  or a path to a JSON file; a rule with a builtin's name replaces it;
+  ``EDL_TPU_ALERT_BUILTIN=0`` drops the builtins entirely;
+- every state transition is written through ONE path
+  (:class:`IncidentLog`): a durable JSONL record shaped exactly like a
+  trace event (``ts``/``name``/``component``/``trace_id``) so
+  ``edl-obs-dump --merge`` joins an incident into the causal span
+  timeline of the job trace it carries — the same
+  one-write-path idea as ``cluster/recovery.py``.
+
+The firing set is served at ``/alerts`` and exported as
+``edl_alerts_firing{alert,severity}`` gauges on the merged page, so the
+controller (ROADMAP 2c/5) consumes a typed signal instead of scraping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.obs.tsdb import TSDB
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_FIRING_G = obs_metrics.gauge(
+    "edl_alerts_firing",
+    "Alert instances currently firing, by rule and severity",
+    ("alert", "severity"))
+_EVALS_TOTAL = obs_metrics.counter(
+    "edl_alerts_evals_total", "Rule-set evaluation passes")
+_TRANSITIONS_TOTAL = obs_metrics.counter(
+    "edl_alerts_transitions_total",
+    "Alert state transitions, by rule and new state", ("alert", "to"))
+_INCIDENTS_TOTAL = obs_metrics.counter(
+    "edl_alerts_incidents_total",
+    "Durable incident records written, by state", ("state",))
+_RECORDED_G = obs_metrics.gauge(
+    "edl_alerts_recorded",
+    "Recording-rule outputs, by recorded name and series group",
+    ("rule", "series"))
+
+KINDS = ("gauge", "rate", "stalled", "quantile", "outlier")
+_OPS = {">": lambda v, t: v > t, "<": lambda v, t: v < t,
+        ">=": lambda v, t: v >= t, "<=": lambda v, t: v <= t}
+
+
+@dataclasses.dataclass
+class Rule:
+    """One declarative recording/alert rule (see module docstring).
+
+    ``record`` names a gauge series the evaluated value is published
+    under (``edl_alerts_recorded{rule=<record>,series=<group>}``) —
+    a rule may record, alert, or both."""
+
+    name: str
+    kind: str
+    metric: str
+    op: str = ">"
+    threshold: float = 0.0
+    window: float = 60.0
+    for_s: float = 0.0
+    q: float = 0.99
+    by: str | None = None
+    match: dict = dataclasses.field(default_factory=dict)
+    agg: str = "max"                  # gauge kind: max|min|sum across series
+    # gauge kind: staleness measured from the value's last CHANGE, not
+    # the last scrape — for event-style gauges ("last outage took Ns")
+    # that are re-exported every scrape and would otherwise keep an
+    # alert latched forever after one bad event
+    on_change: bool = False
+    min_series: int = 3               # outlier: fleet size needed for a median
+    severity: str = "warning"
+    labels: dict = dataclasses.field(default_factory=dict)
+    summary: str = ""
+    record: str | None = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"rule {self.name!r}: unknown kind {self.kind!r}"
+                             f" (want one of {KINDS})")
+        if self.op not in _OPS:
+            raise ValueError(f"rule {self.name!r}: unknown op {self.op!r}")
+        if self.agg not in ("max", "min", "sum"):
+            raise ValueError(f"rule {self.name!r}: unknown agg {self.agg!r}")
+
+    # -- evaluation ----------------------------------------------------------
+    def values(self, tsdb: TSDB, now: float) -> dict[str, float] | None:
+        """``{group: value}`` for this rule's windowed expression, or
+        None when the TSDB can't answer yet (insufficient history /
+        no matching series) — unknown, which never fires an alert."""
+        if self.kind == "gauge":
+            latest = tsdb.latest(self.metric, self.match or None,
+                                 max_age_s=self.window, now=now,
+                                 changed=self.on_change)
+            if not latest:
+                return None
+            if self.by:
+                out: dict[str, float] = {}
+                for labels, _ts, v in latest:
+                    g = dict(labels).get(self.by, "")
+                    out[g] = max(out.get(g, v), v)
+                return out
+            vals = [v for _l, _t, v in latest]
+            return {"": {"max": max, "min": min, "sum": sum}[self.agg](vals)}
+        if self.kind == "rate":
+            rates = tsdb.rate(self.metric, self.window, self.match or None,
+                              now=now, by=self.by)
+            return rates or None
+        if self.kind == "stalled":
+            # progress across ALL matching series: one summed group;
+            # rate() already refuses windows it hasn't covered, so a
+            # just-started job reads unknown, not stalled
+            rates = tsdb.rate(self.metric, self.window, self.match or None,
+                              now=now)
+            return rates or None
+        if self.kind == "quantile":
+            v = tsdb.quantile_over_window(self.metric, self.q, self.window,
+                                          self.match or None, now=now)
+            return None if v is None else {"": v}
+        if self.kind == "outlier":
+            by = self.by or "instance"
+            means = tsdb.mean_over_window(self.metric, self.window,
+                                          self.match or None, now=now, by=by)
+            if len(means) < self.min_series:
+                return None
+            ordered = sorted(means.values())
+            median = ordered[len(ordered) // 2]
+            if median <= 0:
+                return None
+            return {g: m / median for g, m in means.items()}
+        raise AssertionError(self.kind)
+
+    def condition(self, value: float) -> bool:
+        return _OPS[self.op](value, self.threshold)
+
+
+def _scale() -> float:
+    """``EDL_TPU_ALERT_SCALE`` multiplies every builtin window/hold so
+    smokes and benches exercise the BUILT-IN ruleset at CI speed
+    instead of swapping in a parallel test-only ruleset."""
+    try:
+        return max(1e-3, float(os.environ.get("EDL_TPU_ALERT_SCALE", 1.0)))
+    except ValueError:
+        return 1.0
+
+
+def builtin_rules() -> list[Rule]:
+    """The shipped ruleset: one rule per robustness signal the repo
+    already emits (doc/observability.md "Alerting & history" has the
+    operator-facing table).  Thresholds with a natural SLO flavor are
+    env-tunable; windows/holds scale with ``EDL_TPU_ALERT_SCALE``."""
+    s = _scale()
+    p99_slo = float(os.environ.get("EDL_TPU_ALERT_GATEWAY_P99_SLO", 2.0))
+    mttr = float(os.environ.get("EDL_TPU_ALERT_MTTR_THRESHOLD", 10.0))
+    requeue = float(os.environ.get("EDL_TPU_ALERT_REQUEUE_RATE", 50.0))
+    return [
+        Rule("trainer-hang", kind="stalled",
+             metric="edl_train_step_seconds_count",
+             match={"component": "trainer"}, op="<=", threshold=0.0,
+             window=60.0 * s, for_s=15.0 * s, severity="critical",
+             summary="no train-step progress across live trainer targets",
+             record="trainer_steps_per_s"),
+        Rule("trainer-straggler", kind="outlier",
+             metric="edl_train_step_seconds",
+             match={"component": "trainer"}, by="instance",
+             op=">", threshold=2.0, window=60.0 * s, for_s=30.0 * s,
+             min_series=3, severity="warning",
+             summary="pod step latency > 2x the fleet median"),
+        Rule("data-starvation", kind="rate",
+             metric="edl_data_spans_requeued_total",
+             op=">", threshold=requeue, window=60.0 * s, for_s=15.0 * s,
+             severity="warning",
+             summary="data-plane span requeue burn: producers are dying "
+                     "or repairs are churning"),
+        # on_change: the outage gauges are re-exported verbatim every
+        # scrape; the alert stays up for one window after the LAST slow
+        # outage was observed, then resolves instead of latching forever
+        Rule("coord-mttr-regression", kind="gauge",
+             metric="edl_coord_outage_seconds",
+             op=">", threshold=mttr, window=300.0 * s, on_change=True,
+             severity="warning",
+             summary="a coord-store outage took longer than the MTTR "
+                     "budget to heal"),
+        Rule("data-leader-mttr-regression", kind="gauge",
+             metric="edl_data_leader_outage_seconds",
+             op=">", threshold=mttr, window=300.0 * s, on_change=True,
+             severity="warning",
+             summary="a data-leader outage took longer than the MTTR "
+                     "budget to heal"),
+        Rule("gateway-p99-slo", kind="quantile",
+             metric="edl_gateway_request_seconds", q=0.99,
+             op=">", threshold=p99_slo, window=120.0 * s, for_s=30.0 * s,
+             severity="critical",
+             summary="gateway p99 over the latency SLO",
+             record="gateway_p99_s"),
+        Rule("gateway-reject-burn", kind="rate",
+             metric="edl_gateway_rejects_total",
+             op=">", threshold=1.0, window=60.0 * s, for_s=15.0 * s,
+             severity="warning",
+             summary="sustained admission rejects: the fleet is saturated"),
+        Rule("hang-restarts", kind="rate",
+             metric="edl_hang_restarts_total",
+             op=">", threshold=0.0, window=300.0 * s,
+             severity="critical",
+             summary="the hang watchdog restarted trainers"),
+    ]
+
+
+_RULE_FIELDS = {f.name for f in dataclasses.fields(Rule)} | {"for"}
+
+
+def rule_from_dict(d: dict) -> Rule:
+    d = dict(d)
+    unknown = set(d) - _RULE_FIELDS
+    if unknown:
+        raise ValueError(f"rule {d.get('name', '?')!r}: unknown keys "
+                         f"{sorted(unknown)}")
+    if "for" in d:
+        d["for_s"] = float(d.pop("for"))
+    return Rule(**d)
+
+
+def load_rules(env: str | None = None) -> list[Rule]:
+    """Builtins (unless ``EDL_TPU_ALERT_BUILTIN=0``) merged with
+    ``EDL_TPU_ALERT_RULES`` — inline JSON (starts with ``[``) or a path
+    to a JSON file holding a list of rule objects.  A configured rule
+    whose name matches a builtin REPLACES it.  A malformed config is
+    logged and skipped — alerting config must never kill the
+    aggregator."""
+    rules: dict[str, Rule] = {}
+    if os.environ.get("EDL_TPU_ALERT_BUILTIN", "1") != "0":
+        rules = {r.name: r for r in builtin_rules()}
+    raw = env if env is not None else os.environ.get("EDL_TPU_ALERT_RULES", "")
+    raw = raw.strip()
+    if raw:
+        try:
+            if not raw.startswith("["):
+                with open(raw, encoding="utf-8") as f:
+                    raw = f.read()
+            for d in json.loads(raw):
+                r = rule_from_dict(d)
+                rules[r.name] = r
+        except (OSError, ValueError, TypeError) as e:
+            logger.error("EDL_TPU_ALERT_RULES ignored: %s", e)
+    return list(rules.values())
+
+
+class IncidentLog:
+    """Durable JSONL incident records, one write path.
+
+    Each record is trace-event-shaped — ``ts``/``name``
+    (``alert/<rule>``)/``component``/``trace_id`` plus the alert fields
+    — appended to ``incidents-<component>-<pid>.jsonl`` under
+    ``EDL_TPU_INCIDENT_DIR`` (default: ``EDL_TPU_TRACE_DIR``), which
+    ``edl-obs-dump --merge`` reads alongside the trace files: an
+    incident stamped with the job's current generation trace_id lands
+    INSIDE that resize/hang trace's causal timeline.  With no directory
+    configured the record goes through the process tracer instead
+    (best-effort either way; alerting must never die on a full disk)."""
+
+    def __init__(self, dir_path: str | None = None,
+                 component: str = "obs-agg", job_id: str = ""):
+        self.dir = (dir_path if dir_path is not None
+                    else os.environ.get("EDL_TPU_INCIDENT_DIR",
+                                        os.environ.get("EDL_TPU_TRACE_DIR")))
+        self.component = component
+        self.job_id = job_id
+        self._lock = threading.Lock()
+        self.path = None
+        if self.dir:
+            self.path = os.path.join(
+                self.dir, f"incidents-{component}-{os.getpid()}.jsonl")
+
+    def write(self, state: str, rule: Rule, group: str, value: float,
+              trace_id: str | None = None, at: float | None = None) -> dict:
+        rec = {"ts": round(time.time() if at is None else at, 6),
+               "name": f"alert/{rule.name}",
+               "component": self.component,
+               "state": state, "severity": rule.severity,
+               "value": round(float(value), 6)}
+        if self.job_id:
+            rec["job"] = self.job_id
+        if rule.by and group:
+            rec[rule.by] = group
+        if rule.summary:
+            rec["summary"] = rule.summary
+        for k, v in rule.labels.items():
+            rec.setdefault(k, v)
+        if trace_id:
+            rec["trace_id"] = trace_id
+        _INCIDENTS_TOTAL.labels(state=state).inc()
+        wrote = False
+        if self.path:
+            try:
+                with self._lock:
+                    os.makedirs(self.dir, exist_ok=True)
+                    with open(self.path, "a", encoding="utf-8") as f:
+                        f.write(json.dumps(rec) + "\n")
+                wrote = True
+            except OSError:
+                logger.exception("incident record write failed")
+        if not wrote:
+            obs_trace.emit(rec["name"],
+                           **{k: v for k, v in rec.items()
+                              if k not in ("ts", "name")})
+        return rec
+
+
+class _AlertState:
+    __slots__ = ("pending_since", "firing_since", "value")
+
+    def __init__(self):
+        self.pending_since: float | None = None
+        self.firing_since: float | None = None
+        self.value = 0.0
+
+
+class RuleEngine:
+    """Evaluate a ruleset against the TSDB once per scrape.
+
+    Per (rule, group) state machine: condition true → *pending*; held
+    continuously for ``for_s`` → *firing* (incident record written,
+    ``edl_alerts_firing`` bumped); condition false or unknown →
+    resolved.  ``trace_provider`` (when set) is consulted at incident
+    time for the job's current generation trace_id, which links the
+    record into that trace's merged timeline."""
+
+    def __init__(self, tsdb: TSDB, rules: list[Rule],
+                 incident_log: IncidentLog | None = None,
+                 trace_provider=None):
+        self.tsdb = tsdb
+        self.rules = list(rules)
+        self.incidents = incident_log
+        self._trace_provider = trace_provider
+        self._lock = threading.Lock()
+        self._state: dict[tuple[str, str], _AlertState] = {}
+
+    def _trace_id(self) -> str | None:
+        if self._trace_provider is None:
+            return None
+        try:
+            return self._trace_provider()
+        except Exception:  # noqa: BLE001 — a store blip must not stop alerting
+            return None
+
+    def _incident(self, state: str, rule: Rule, group: str,
+                  value: float) -> None:
+        if self.incidents is not None:
+            self.incidents.write(state, rule, group, value,
+                                 trace_id=self._trace_id())
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """One pass over every rule; returns the currently-firing list
+        (same shape as :meth:`firing`).
+
+        Incident records are written AFTER the engine lock is released:
+        the write path includes a disk append and (for the trace link)
+        a deadline-scoped coord-store read, and holding the lock across
+        those would stall the ``/alerts``/``/healthz`` handlers exactly
+        when alerts transition — the moment an operator is polling."""
+        now = time.time() if now is None else now
+        _EVALS_TOTAL.inc()
+        transitions: list[tuple[str, Rule, str, float]] = []
+        with self._lock:
+            for rule in self.rules:
+                try:
+                    values = rule.values(self.tsdb, now)
+                except Exception:  # noqa: BLE001 — one bad rule != no alerts
+                    logger.exception("rule %s evaluation failed", rule.name)
+                    continue
+                values = values or {}
+                if rule.record:
+                    for group, v in values.items():
+                        _RECORDED_G.labels(rule=rule.record,
+                                           series=group).set(v)
+                seen = set()
+                for group, v in values.items():
+                    key = (rule.name, group)
+                    seen.add(key)
+                    st = self._state.setdefault(key, _AlertState())
+                    st.value = v
+                    if rule.condition(v):
+                        if st.pending_since is None:
+                            st.pending_since = now
+                            _TRANSITIONS_TOTAL.labels(
+                                alert=rule.name, to="pending").inc()
+                        if (st.firing_since is None
+                                and now - st.pending_since >= rule.for_s):
+                            st.firing_since = now
+                            _TRANSITIONS_TOTAL.labels(
+                                alert=rule.name, to="firing").inc()
+                            transitions.append(("firing", rule, group, v))
+                    else:
+                        self._resolve(rule, group, st, transitions)
+                # groups that vanished (dead instance / no data) resolve
+                for key in [k for k in self._state
+                            if k[0] == rule.name and k not in seen]:
+                    self._resolve(rule, key[1], self._state[key],
+                                  transitions)
+                    del self._state[key]
+                firing_n = sum(1 for (rn, _g), st in self._state.items()
+                               if rn == rule.name
+                               and st.firing_since is not None)
+                _FIRING_G.labels(alert=rule.name,
+                                 severity=rule.severity).set(firing_n)
+            firing = self._firing_locked()
+        for state, rule, group, v in transitions:
+            self._incident(state, rule, group, v)
+        return firing
+
+    def _resolve(self, rule: Rule, group: str, st: _AlertState,
+                 transitions: list) -> None:
+        if st.firing_since is not None:
+            _TRANSITIONS_TOTAL.labels(alert=rule.name, to="resolved").inc()
+            transitions.append(("resolved", rule, group, st.value))
+        st.pending_since = None
+        st.firing_since = None
+
+    # -- read side -----------------------------------------------------------
+    def _rule(self, name: str) -> Rule | None:
+        return next((r for r in self.rules if r.name == name), None)
+
+    def _entry(self, name: str, group: str, st: _AlertState) -> dict:
+        rule = self._rule(name)
+        d = {"alert": name, "value": round(st.value, 6),
+             "severity": rule.severity if rule else "warning",
+             "pending_since": st.pending_since,
+             "firing_since": st.firing_since}
+        if rule is not None:
+            if rule.summary:
+                d["summary"] = rule.summary
+            if rule.labels:
+                d["labels"] = dict(rule.labels)
+            if rule.by and group:
+                d[rule.by] = group
+        return d
+
+    def _firing_locked(self) -> list[dict]:
+        return [self._entry(n, g, st)
+                for (n, g), st in sorted(self._state.items())
+                if st.firing_since is not None]
+
+    def firing(self) -> list[dict]:
+        with self._lock:
+            return self._firing_locked()
+
+    def to_json(self) -> dict:
+        """The ``/alerts`` body."""
+        with self._lock:
+            pending = [self._entry(n, g, st)
+                       for (n, g), st in sorted(self._state.items())
+                       if st.firing_since is None
+                       and st.pending_since is not None]
+            return {
+                "ts": time.time(),
+                "firing": self._firing_locked(),
+                "pending": pending,
+                "rules": [{"name": r.name, "kind": r.kind,
+                           "metric": r.metric, "op": r.op,
+                           "threshold": r.threshold, "window": r.window,
+                           "for": r.for_s, "severity": r.severity}
+                          for r in self.rules],
+                "incidents_path": (self.incidents.path
+                                   if self.incidents else None),
+            }

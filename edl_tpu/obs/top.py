@@ -1,0 +1,184 @@
+"""``edl-obs-top`` (``python -m edl_tpu.obs.top``): one-command live
+terminal view of an elastic job.
+
+``top`` for the fleet: a refreshing component table, windowed
+throughput rates and gateway quantiles, the PR 6–7 robustness
+headlines, and the rule engine's firing alerts — everything the
+aggregator already knows, rendered for a human instead of a scraper.
+
+Two ways in:
+
+- ``--endpoint host:port`` — point at a running ``edl-obs-agg``; top
+  renders its ``/healthz`` + ``/alerts`` JSON (no store access needed);
+- ``--coord_endpoints ... --job_id ...`` — no aggregator running: top
+  embeds one (scrape loop + TSDB + ruleset, no HTTP server) and drives
+  it itself.
+
+``--once`` prints a single frame and exits (scripts/CI); otherwise the
+screen refreshes every ``--interval`` seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}".rstrip("0").rstrip(".") or "0"
+    return str(v)
+
+
+def _fmt_s(v) -> str:
+    """A seconds-valued field: unit only when there is a value."""
+    return "-" if v is None else _fmt_num(v) + "s"
+
+
+def _age(since: float | None, now: float) -> str:
+    if not since:
+        return "-"
+    return f"{max(0.0, now - since):.0f}s"
+
+
+def render_top(health: dict, alerts: dict | None = None,
+               now: float | None = None) -> str:
+    """One frame of the live view; pure text in, text out (tested
+    directly — the refresh loop only adds the clear-screen escape)."""
+    now = time.time() if now is None else now
+    lines: list[str] = []
+    firing = (alerts or {}).get("firing", [])
+    lines.append(
+        f"job {health.get('job_id', '?')}  "
+        f"targets={health.get('live_targets', 0)}  "
+        f"firing={len(firing)}  "
+        f"{time.strftime('%H:%M:%S', time.localtime(now))}")
+    comps = health.get("components", {})
+    if comps:
+        lines.append("  component        live")
+        for name in sorted(comps):
+            lines.append(f"  {name:<16} {comps[name]:>4}")
+    rates = health.get("rates", {})
+    if rates:
+        lines.append("  rates: " + "  ".join(
+            f"{k}={_fmt_num(v)}" for k, v in sorted(rates.items())))
+    gw = health.get("gateway")
+    if gw:
+        lines.append(
+            f"  gateway: p50={_fmt_s(gw.get('p50_s'))} "
+            f"p99={_fmt_s(gw.get('p99_s'))} "
+            f"requests={_fmt_num(gw.get('requests'))} "
+            f"[{gw.get('window', '?')}]")
+    rb = health.get("robustness")
+    if rb:
+        lines.append(
+            f"  robustness: coord_mttr={_fmt_s(rb.get('coord_restart_mttr_s'))} "
+            f"data_leader_mttr={_fmt_s(rb.get('data_leader_mttr_s'))} "
+            f"hang_restarts={_fmt_num(rb.get('hang_restarts'))} "
+            f"spans_requeued={_fmt_num(rb.get('data_spans_requeued'))}")
+    lr = health.get("last_resize")
+    if lr:
+        lines.append(f"  last resize: stage={lr.get('stage')} "
+                     f"total={_fmt_s(lr.get('total'))} "
+                     f"restore={lr.get('restore_source', '-')}")
+    errors = health.get("scrape_errors") or {}
+    if errors:
+        lines.append(f"  scrape errors ({len(errors)}):")
+        for name in sorted(errors)[:5]:
+            lines.append(f"    {name}: {errors[name]}")
+    if firing:
+        lines.append("  ALERTS FIRING:")
+        for a in firing:
+            extra = " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                             if k in ("instance", "reader", "component"))
+            lines.append(
+                f"    [{a.get('severity', '?'):<8}] {a.get('alert')}"
+                f"  value={_fmt_num(a.get('value'))}"
+                f"  for={_age(a.get('firing_since'), now)}"
+                f"{('  ' + extra) if extra else ''}")
+            if a.get("summary"):
+                lines.append(f"        {a['summary']}")
+    else:
+        pending = (alerts or {}).get("pending", [])
+        lines.append(f"  alerts: none firing"
+                     f"{f', {len(pending)} pending' if pending else ''}")
+    return "\n".join(lines)
+
+
+def _fetch_json(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl_tpu.obs.top",
+        description="Live terminal view of an elastic job: component "
+                    "table, windowed rates/quantiles, firing alerts")
+    p.add_argument("--endpoint", default=None,
+                   help="a running edl-obs-agg's host:port (uses its "
+                        "/healthz + /alerts)")
+    p.add_argument("--coord_endpoints", default=None,
+                   help="no aggregator running: embed one over the "
+                        "coord store (requires --job_id)")
+    p.add_argument("--job_id", default=None)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--no_clear", action="store_true",
+                   help="append frames instead of redrawing the screen")
+    args = p.parse_args(argv)
+
+    if args.endpoint is None and not (args.coord_endpoints and args.job_id):
+        p.error("need --endpoint, or --coord_endpoints with --job_id")
+
+    agg = store = None
+    if args.endpoint is None:
+        from edl_tpu.coord.client import connect
+        from edl_tpu.obs.agg import Aggregator
+        store = connect(args.coord_endpoints)
+        # incident_dir="": top is a VIEWER — its embedded rule engine
+        # must never write incident records next to (and duplicating)
+        # the real aggregator's, however EDL_TPU_*_DIR is set
+        agg = Aggregator(store, args.job_id,
+                         scrape_interval=max(args.interval, 0.25),
+                         incident_dir="")
+
+    def frame() -> str:
+        if agg is not None:
+            agg.scrape_once()
+            return render_top(agg.job_summary(), agg.engine.to_json())
+        base = f"http://{args.endpoint}"
+        health = _fetch_json(base + "/healthz", timeout=10)
+        try:
+            alerts = _fetch_json(base + "/alerts", timeout=10)
+        except Exception:  # noqa: BLE001 — pre-alerts aggregator: degrade
+            alerts = None
+        return render_top(health, alerts)
+
+    try:
+        while True:
+            text = frame()
+            if args.once:
+                print(text)
+                return 0
+            sys.stdout.write(text + "\n" if args.no_clear
+                             else _CLEAR + text + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if store is not None:
+            store.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""In-memory ring-buffer time-series store for the obs aggregator.
+
+The aggregator (PR 4) was a point-in-time scraper: every question it
+could answer ("gateway p99", "is training progressing") was computed
+from *lifetime-cumulative* counters, which is meaningless after the
+first traffic shift and blind to anything that happened between two
+manual scrapes.  This module is the smallest store that fixes it — a
+Prometheus-TSDB-shaped ring buffer with none of the dependency:
+
+- one bounded deque of ``(ts, value)`` points per series, keyed exactly
+  by :func:`~edl_tpu.obs.metrics.parse_exposition`'s
+  ``(name, ((label, value), ...))`` keys, fed by
+  :meth:`TSDB.ingest` from the aggregator's background scrape loop;
+- a retention window (seconds) + a per-series point cap, so memory is
+  O(targets x series x window/interval) and a long-running aggregator
+  can never grow without bound; series that stop being scraped (a dead
+  pod's instance labels) are evicted after one retention window;
+- **counter-reset-aware** ``increase()``/``rate()`` (a restarted
+  process's counter restarting from 0 counts as "continue from 0",
+  the PromQL rule — never a negative rate);
+- **windowed histogram quantiles**: per-``le`` bucket *increase* over
+  the window, summed across instances, through
+  :func:`quantile_from_buckets` — "p99 over the last 2 minutes", not
+  "p99 since the job started".
+
+Everything is lock-guarded; readers (rule engine, /healthz,
+``edl-obs-top``) and the scrape loop may run on different threads.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+
+from edl_tpu.obs import metrics as obs_metrics
+
+_SERIES_G = obs_metrics.gauge(
+    "edl_tsdb_series", "Live series held by the aggregator's ring-buffer TSDB")
+_POINTS_G = obs_metrics.gauge(
+    "edl_tsdb_points", "Total points held across all TSDB series")
+_EVICTED_TOTAL = obs_metrics.counter(
+    "edl_tsdb_series_evicted_total",
+    "Series evicted after going one retention window without a sample")
+
+# a series must cover at least this fraction of the asked window before
+# a rate over it is trusted — a just-started job must read as "no data
+# yet", never as "stalled" (the hang rule keys on exactly this)
+MIN_COVERAGE_FRACTION = 0.75
+
+LabelSet = tuple[tuple[str, str], ...]
+SeriesKey = tuple[str, LabelSet]
+
+
+def quantile_from_buckets(buckets: dict[float, float],
+                          q: float) -> float | None:
+    """Prometheus-style quantile estimate from cumulative ``le`` bucket
+    counts (linear interpolation within the winning bucket; the +Inf
+    bucket resolves to the previous finite bound — with no finite
+    bucket below it, 0.0 — the classic histogram_quantile behavior).
+    None when the histogram is empty."""
+    items = sorted(buckets.items())
+    if not items or items[-1][1] <= 0:
+        return None
+    total = items[-1][1]
+    target = q * total
+    prev_le, prev_c = 0.0, 0.0
+    for le, c in items:
+        if c >= target:
+            if le == math.inf:
+                return prev_le
+            span = c - prev_c
+            frac = 0.0 if span <= 0 else (target - prev_c) / span
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_c = le, c
+    return None
+
+
+def _match(labels: LabelSet, matchers: dict[str, str] | None) -> bool:
+    if not matchers:
+        return True
+    d = dict(labels)
+    return all(d.get(k) == v for k, v in matchers.items())
+
+
+def _series_increase(points, start_ts: float) -> tuple[float, float] | None:
+    """(increase, covered_seconds) of one counter series over
+    ``[start_ts, last point]`` — counter-reset aware: a sample below its
+    predecessor restarts the count from zero (PromQL rule), so a
+    process restart mid-window adds its post-restart progress instead
+    of a negative delta.  The last sample at/before ``start_ts`` is the
+    baseline (the increase covers exactly the window, not window minus
+    one scrape).  None when fewer than two samples land in scope."""
+    prev = None
+    base_ts = None
+    last_ts = None
+    inc = 0.0
+    n = 0
+    for ts, v in points:
+        if ts < start_ts:
+            prev, base_ts = v, ts
+            continue
+        if prev is not None:
+            inc += (v - prev) if v >= prev else v
+            n += 1
+        if base_ts is None:
+            base_ts = ts
+        prev = v
+        last_ts = ts
+    if last_ts is None or base_ts is None or n == 0:
+        return None
+    return inc, max(0.0, last_ts - max(base_ts, start_ts - 1e-9))
+
+
+class TSDB:
+    """Bounded per-series ring buffers + windowed reads.
+
+    ``retention_s`` bounds how far back any window can reach;
+    ``max_points`` bounds one series' buffer (ring: oldest dropped);
+    ``max_series`` hard-caps total series (new series past the cap are
+    dropped — a metrics-cardinality bug in one target must not OOM the
+    aggregator)."""
+
+    def __init__(self, retention_s: float = 600.0, max_points: int = 2048,
+                 max_series: int = 200_000):
+        self.retention_s = float(retention_s)
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self._lock = threading.Lock()
+        self._series: dict[SeriesKey, deque] = {}
+        self._last_seen: dict[SeriesKey, float] = {}
+
+    # -- writes --------------------------------------------------------------
+    def ingest(self, parsed: dict, ts: float) -> int:
+        """Append one scrape (a :func:`parse_exposition` dict) at ``ts``;
+        returns the number of points stored.  Prunes expired points on
+        the touched series and evicts series absent for a full
+        retention window."""
+        stored = 0
+        cutoff = ts - self.retention_s
+        with self._lock:
+            for key, value in parsed.items():
+                ring = self._series.get(key)
+                if ring is None:
+                    if len(self._series) >= self.max_series:
+                        continue
+                    ring = self._series[key] = deque(maxlen=self.max_points)
+                while ring and ring[0][0] < cutoff:
+                    ring.popleft()
+                ring.append((ts, float(value)))
+                self._last_seen[key] = ts
+                stored += 1
+            dead = [k for k, seen in self._last_seen.items() if seen < cutoff]
+            for k in dead:
+                self._series.pop(k, None)
+                self._last_seen.pop(k, None)
+            if dead:
+                _EVICTED_TOTAL.inc(len(dead))
+            _SERIES_G.set(len(self._series))
+            _POINTS_G.set(sum(len(r) for r in self._series.values()))
+        return stored
+
+    # -- reads ---------------------------------------------------------------
+    def _snapshot(self, name: str,
+                  matchers: dict | None) -> list[tuple[LabelSet, list]]:
+        with self._lock:
+            return [(labels, list(ring))
+                    for (n, labels), ring in self._series.items()
+                    if n == name and _match(labels, matchers)]
+
+    def series_count(self, name: str, matchers: dict | None = None) -> int:
+        return len(self._snapshot(name, matchers))
+
+    def latest(self, name: str, matchers: dict | None = None,
+               max_age_s: float | None = None, now: float | None = None,
+               changed: bool = False) -> list[tuple[LabelSet, float, float]]:
+        """Freshest ``(labels, ts, value)`` per matching series; with
+        ``max_age_s`` a series whose last sample is older (a dead
+        instance's leftovers) is excluded.  With ``changed``, the age
+        test uses the last time the series' VALUE changed instead of
+        the last scrape — the staleness rule for event-style gauges
+        ("last observed outage duration") that are re-exported
+        verbatim on every scrape and would otherwise never age out."""
+        out = []
+        for labels, pts in self._snapshot(name, matchers):
+            if not pts:
+                continue
+            ts, v = pts[-1]
+            if changed:
+                # first sample of the trailing run of equal values
+                for pt, pv in reversed(pts):
+                    if pv != v:
+                        break
+                    ts = pt
+            if (max_age_s is not None and now is not None
+                    and now - ts > max_age_s):
+                continue
+            out.append((labels, ts, v))
+        return out
+
+    def increase(self, name: str, window: float,
+                 matchers: dict | None = None, now: float | None = None,
+                 by: str | None = None) -> dict[str, tuple[float, float]]:
+        """Counter increase over the trailing ``window``:
+        ``{group: (increase, covered_seconds)}`` — grouped by label
+        ``by`` (series missing it land under ``""``), or one ``""``
+        group summing every matching series.  ``covered_seconds`` is
+        the narrowest per-series history backing the group's number, so
+        callers can refuse to act on a window they haven't seen yet."""
+        if now is None:
+            now = max((pts[-1][0] for _, pts in self._snapshot(name, matchers)
+                       if pts), default=0.0)
+        start = now - window
+        out: dict[str, tuple[float, float]] = {}
+        for labels, pts in self._snapshot(name, matchers):
+            r = _series_increase(pts, start)
+            if r is None:
+                continue
+            group = dict(labels).get(by, "") if by else ""
+            inc, cover = r
+            prev = out.get(group)
+            out[group] = ((inc, cover) if prev is None
+                          else (prev[0] + inc, min(prev[1], cover)))
+        return out
+
+    def rate(self, name: str, window: float, matchers: dict | None = None,
+             now: float | None = None, by: str | None = None,
+             min_coverage: float | None = None
+             ) -> dict[str, float]:
+        """Per-second rate over the window, grouped like
+        :meth:`increase`; groups whose history covers less than
+        ``min_coverage`` (default ``MIN_COVERAGE_FRACTION * window``)
+        are omitted — "unknown", not "zero"."""
+        if min_coverage is None:
+            min_coverage = MIN_COVERAGE_FRACTION * window
+        out = {}
+        for group, (inc, cover) in self.increase(
+                name, window, matchers, now=now, by=by).items():
+            if cover >= min_coverage and cover > 0:
+                out[group] = inc / cover
+        return out
+
+    def window_buckets(self, family: str, window: float,
+                       matchers: dict | None = None,
+                       now: float | None = None) -> dict[float, float]:
+        """Per-``le`` bucket **increase** over the window for histogram
+        ``family``, summed across matching series — the input
+        :func:`quantile_from_buckets` wants for a windowed quantile.
+        Counter resets inside the window are handled per series."""
+        name = family + "_bucket"
+        if now is None:
+            now = max((pts[-1][0] for _, pts in self._snapshot(name, matchers)
+                       if pts), default=0.0)
+        start = now - window
+        out: dict[float, float] = {}
+        for labels, pts in self._snapshot(name, matchers):
+            le = dict(labels).get("le")
+            if le is None:
+                continue
+            r = _series_increase(pts, start)
+            if r is None:
+                continue
+            le_f = float(le)
+            out[le_f] = out.get(le_f, 0.0) + max(0.0, r[0])
+        return out
+
+    def quantile_over_window(self, family: str, q: float, window: float,
+                             matchers: dict | None = None,
+                             now: float | None = None) -> float | None:
+        """Windowed quantile over a merged histogram family; None when
+        the window saw no observations (callers fall back to the
+        lifetime estimate, marked as such)."""
+        buckets = self.window_buckets(family, window, matchers, now=now)
+        if not buckets:
+            return None
+        return quantile_from_buckets(buckets, q)
+
+    def mean_over_window(self, family: str, window: float,
+                         matchers: dict | None = None,
+                         now: float | None = None, by: str | None = None
+                         ) -> dict[str, float]:
+        """Windowed mean of a histogram family (``_sum`` increase /
+        ``_count`` increase), grouped by ``by`` — the straggler rule's
+        per-instance step latency."""
+        sums = self.increase(family + "_sum", window, matchers,
+                             now=now, by=by)
+        counts = self.increase(family + "_count", window, matchers,
+                               now=now, by=by)
+        out = {}
+        for group, (cnt, _cover) in counts.items():
+            if cnt <= 0 or group not in sums:
+                continue
+            out[group] = sums[group][0] / cnt
+        return out

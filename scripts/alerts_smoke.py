@@ -1,0 +1,271 @@
+"""CI smoke: the closed observability loop — TSDB + built-in ruleset +
+incident records — detecting REAL injected failures end to end.
+
+Three "trainer" child processes (each: a /metrics endpoint serving a
+live ``edl_train_step_seconds`` histogram + a TTL-leased coord advert)
+against an in-process coordination server, scraped by a real
+``AggregatorServer`` background loop running the BUILT-IN ruleset
+(windows shrunk via ``EDL_TPU_ALERT_SCALE`` — same rules, CI speed):
+
+1. **straggler** — one child steps 5x slower than the fleet; the
+   ``trainer-straggler`` outlier rule must fire on that child's
+   instance within its window+hold;
+2. **hang** — every child stalls at an agreed instant through the
+   ``EDL_TPU_FAULTS`` delay action (``train_step:delay:...`` — the
+   same injection grammar the chaos smokes use); the ``trainer-hang``
+   rule must fire within ~its declared window+hold, ``/alerts`` must
+   show it, and ``edl_alerts_firing`` must appear on the merged page;
+3. **incident join** — the parent publishes a generation trace
+   (``publish_job_trace``, exactly what the launcher does); the
+   incident JSONL record must carry that trace_id and
+   ``edl-obs-dump --merge`` must land the alert INSIDE that trace's
+   causal timeline next to the generation's span events;
+4. **killed data leader** — a journaled DataService is killed
+   mid-epoch and a successor rebuilds; the reader's resilient client
+   records the observed outage and the built-in
+   ``data-leader-mttr-regression`` rule (threshold shrunk via
+   ``EDL_TPU_ALERT_MTTR_THRESHOLD``) must fire on it.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/alerts_smoke.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_TRACE_DIR = os.environ.setdefault("EDL_TPU_TRACE_DIR",
+                                   tempfile.mkdtemp(prefix="edl-alerts-"))
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+os.environ.setdefault("EDL_TPU_ALERT_SCALE", "0.1")       # 6s hang window
+os.environ.setdefault("EDL_TPU_ALERT_MTTR_THRESHOLD", "0.02")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.obs import advert
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.metrics import Registry
+from edl_tpu.utils import faultinject
+
+coord_ep, job, step_s, stall_at = (sys.argv[1], sys.argv[2],
+                                   float(sys.argv[3]), float(sys.argv[4]))
+reg = Registry()
+steps = reg.histogram("edl_train_step_seconds", "per-step wall time")
+srv = MetricsServer(reg, host="127.0.0.1").start()
+store = CoordClient(coord_ep)
+handle = advert.advertise_metrics(store, job, "trainer", srv.endpoint,
+                                  name=f"trainer-{{os.getpid()}}", ttl=60)
+print("trainer up", srv.endpoint, flush=True)
+while True:
+    if stall_at and time.time() >= stall_at:
+        # the injected stall: the EDL_TPU_FAULTS delay action parks the
+        # step loop exactly where a wedged collective would
+        faultinject.fire("train_step")
+    time.sleep(step_s)
+    steps.observe(step_s)
+"""
+
+
+def _spawn_trainer(coord_ep, job, step_s, stall_at):
+    env = dict(os.environ, EDL_TPU_FAULTS="train_step:delay:600",
+               EDL_TPU_METRICS_PORT="")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD.format(repo=_REPO),
+         coord_ep, job, str(step_s), str(stall_at)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "trainer up" in line:
+            return proc, line.rsplit(" ", 1)[-1].strip()
+        if not line and proc.poll() is not None:
+            raise AssertionError("trainer child died before announcing")
+    raise AssertionError("trainer child never announced")
+
+
+def _get_json(url):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read().decode())
+
+
+def _wait_alert(agg_ep, name, deadline, every=0.2):
+    while time.time() < deadline:
+        alerts = _get_json(f"http://{agg_ep}/alerts")
+        hit = [a for a in alerts["firing"] if a["alert"] == name]
+        if hit:
+            return time.time(), hit[0]
+        time.sleep(every)
+    raise AssertionError(f"alert {name} never fired; last state: "
+                         f"{_get_json(f'http://{agg_ep}/alerts')}")
+
+
+def _data_leader_kill(store):
+    """Kill a journaled data leader mid-epoch; the reader's resilient
+    client rides it out and records the observed outage gauge."""
+    from edl_tpu.data import DistributedReader, PodDataServer
+    from edl_tpu.data.data_server import DataService
+    from edl_tpu.data.journal import DataJournal
+    from edl_tpu.rpc.server import RpcServer
+
+    data_dir = tempfile.mkdtemp(prefix="edl-alerts-data-")
+    for f in range(4):
+        with open(os.path.join(data_dir, f"part-{f}.txt"), "w") as fh:
+            fh.writelines(f"f{f}r{r}\n" for r in range(20))
+    files = sorted(os.path.join(data_dir, f) for f in os.listdir(data_dir))
+
+    def serve(journal):
+        srv = RpcServer("127.0.0.1", 0)
+        srv.register_instance(DataService(journal=journal,
+                                          rebuild_grace=0.5))
+        srv.start()
+        return srv, f"127.0.0.1:{srv.port}"
+
+    journal = DataJournal(store, "alertsmoke-data")
+    srv1, ep1 = serve(journal)
+    endpoint = {"ep": ep1}
+    cache = PodDataServer("alerts-pod")
+    srv2 = None
+    try:
+        reader = DistributedReader("alerts@e0", "alerts-pod",
+                                   lambda: endpoint["ep"], cache,
+                                   batch_size=8, retry_deadline=60.0,
+                                   meta_prefetch=1)
+        reader.create(files)
+        seen = 0
+        for i, (_bid, _payload) in enumerate(iter(reader)):
+            seen += 1
+            if i == 3:
+                srv1.stop()
+                srv2, ep2 = serve(journal)
+                endpoint["ep"] = ep2
+        assert seen > 4, f"reader finished too early ({seen} batches)"
+    finally:
+        cache.stop()
+        for s in (srv1, srv2):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+
+def main() -> None:
+    from edl_tpu import obs
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.obs import context as obs_context
+    from edl_tpu.obs import dump as obs_dump
+    from edl_tpu.obs import rules as obs_rules
+    from edl_tpu.obs import trace as obs_trace
+    from edl_tpu.obs.advert import advertise_installed, publish_job_trace
+    from edl_tpu.obs.agg import AggregatorServer
+    from edl_tpu.obs.metrics import parse_exposition
+
+    obs.install_from_env("parent")
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = CoordClient(coord_ep)
+    job = "alertsmoke"
+
+    rules = {r.name: r for r in obs_rules.builtin_rules()}
+    hang, strag = rules["trainer-hang"], rules["trainer-straggler"]
+    stall_at = time.time() + (strag.window + strag.for_s) * 3 + 20.0
+    # the parent's own /metrics rides along too (the data-leader outage
+    # gauge lands in THIS process's registry)
+    parent_reg = advertise_installed(store, job, "parent")
+    assert parent_reg is not None
+    # the generation trace the incident must join (what the launcher
+    # publishes every time it roots a cluster-generation trace)
+    ctx = obs_context.new_trace(job=job)
+    publish_job_trace(store, job, ctx, stage="gen0")
+    with obs_context.use(ctx):
+        obs_trace.emit("smoke/generation", stage="gen0")
+
+    children = [_spawn_trainer(coord_ep, job, s, stall_at)
+                for s in (0.05, 0.05, 0.25)]
+    agg_srv = None
+    try:
+        agg_srv = AggregatorServer(
+            store, job, host="127.0.0.1", cache_s=0.0,
+            scrape_interval=0.25, incident_dir=_TRACE_DIR).start()
+        agg_ep = agg_srv.endpoint
+
+        # 1 -- straggler: the slow child vs the fleet median
+        t0 = time.time()
+        bound = (strag.window + strag.for_s) * 2 + 15.0
+        fired_at, alert = _wait_alert(agg_ep, "trainer-straggler",
+                                      t0 + bound)
+        slow_ep = children[2][1]
+        assert alert.get("instance") == slow_ep, \
+            f"straggler fired on {alert.get('instance')}, want {slow_ep}"
+        print(f"smoke: trainer-straggler fired on the slow pod "
+              f"({alert['instance']}, ratio {alert['value']:.1f}x) "
+              f"in {fired_at - t0:.1f}s")
+
+        # 2 -- hang: every trainer stalls at stall_at via EDL_TPU_FAULTS
+        wait = stall_at - time.time()
+        assert wait > 0, "stall instant already passed; widen the margin"
+        time.sleep(wait)
+        hang_bound = (hang.window + hang.for_s) * 2 + 10.0
+        fired_at, alert = _wait_alert(agg_ep, "trainer-hang",
+                                      stall_at + hang_bound)
+        detect_s = fired_at - stall_at
+        assert detect_s <= hang_bound, \
+            f"hang detection took {detect_s:.1f}s > {hang_bound:.1f}s"
+        print(f"smoke: trainer-hang fired {detect_s:.1f}s after the "
+              f"injected stall (rule bound "
+              f"{hang.window + hang.for_s:.1f}s + scrape slack)")
+        page = urllib.request.urlopen(
+            f"http://{agg_ep}/metrics", timeout=10).read().decode()
+        parsed = parse_exposition(page)
+        firing = [v for (n, labels), v in parsed.items()
+                  if n == "edl_alerts_firing"
+                  and dict(labels).get("alert") == "trainer-hang"]
+        assert firing and max(firing) >= 1, \
+            "edl_alerts_firing{alert=trainer-hang} missing from merged page"
+
+        # 3 -- the incident record joins the generation trace
+        inc_path = agg_srv.aggregator.engine.incidents.path
+        with open(inc_path, encoding="utf-8") as f:
+            incidents = [json.loads(line) for line in f if line.strip()]
+        hang_inc = [r for r in incidents
+                    if r["name"] == "alert/trainer-hang"
+                    and r["state"] == "firing"]
+        assert hang_inc, f"no hang incident record in {inc_path}"
+        assert hang_inc[0].get("trace_id") == ctx.trace_id, \
+            f"incident trace_id {hang_inc[0].get('trace_id')} != " \
+            f"published generation trace {ctx.trace_id}"
+        events, _skipped = obs_dump.read_trace_dir(_TRACE_DIR)
+        tl = obs_dump.merge_timeline(events, ctx.trace_id)
+        names = [e["name"] for e in tl]
+        assert "smoke/generation" in names and "alert/trainer-hang" in names, \
+            f"merged timeline must join generation span + incident: {names}"
+        print(f"smoke: incident record joined trace {ctx.trace_id[:8]} "
+              f"({len(tl)} events in the merged timeline)")
+
+        # 4 -- killed data leader: outage gauge -> built-in MTTR rule
+        _data_leader_kill(store)
+        fired_at, alert = _wait_alert(
+            agg_ep, "data-leader-mttr-regression", time.time() + 30.0)
+        print(f"smoke: data-leader-mttr-regression fired on an observed "
+              f"{alert['value']:.3f}s leader outage")
+    finally:
+        if agg_srv is not None:
+            agg_srv.stop()
+        for proc, _ in children:
+            proc.kill()
+        parent_reg.stop()
+        store.close()
+        coord.stop()
+    print("alerts smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,16 @@ JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
 # renders one cross-process timeline with valid Perfetto JSON
 JAX_PLATFORMS=cpu python scripts/obs_agg_smoke.py
 
+# alerts smoke: the closed observability loop — three trainer child
+# processes scraped by a real aggregator background loop running the
+# BUILT-IN ruleset (windows scaled): the straggler rule must fire on
+# the slow pod, an EDL_TPU_FAULTS-injected stall must fire trainer-hang
+# within the rule's window+hold, the incident JSONL record must carry
+# the published generation trace_id and land inside that trace's
+# edl-obs-dump --merge timeline, and a killed+rebuilt data leader must
+# fire the data-leader MTTR rule off the reader's observed outage
+JAX_PLATFORMS=cpu python scripts/alerts_smoke.py
+
 # transfer smoke: the streaming data plane's microbench (loopback,
 # small payload, subprocess holders) — pipelined/striped fetch must not
 # regress below the serial baseline, and the MiB/s numbers land in the
@@ -85,6 +95,13 @@ import json, sys
 out = json.loads(sys.stdin.read())
 assert 'error' not in out and not out.get('partial'), out
 assert out.get('value'), out
+# alerting loop (ISSUE 9): detection latency must land near the rule's
+# declared window+hold, and the background scrape loop must cost the
+# step loop ~nothing (<2% target on real hosts; 5% absorbs 1-core CI
+# noise without masking a pathological regression)
+lat, bound = out['alert_detect_latency_s'], out['alert_rule_bound_s']
+assert lat <= bound * 2 + 5, (lat, bound)
+assert out['obs_scrape_overhead_pct'] < 5, out['obs_scrape_overhead_pct']
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve
@@ -93,12 +110,13 @@ edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
 edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
 edl-obs-dump --help >/dev/null 2>&1 || { echo "edl-obs-dump missing"; exit 1; }
 edl-obs-agg --help >/dev/null 2>&1 || { echo "edl-obs-agg missing"; exit 1; }
+edl-obs-top --help >/dev/null 2>&1 || { echo "edl-obs-top missing"; exit 1; }
 edl-gateway --help >/dev/null 2>&1 || { echo "edl-gateway missing"; exit 1; }
 edl-replica --help >/dev/null 2>&1 || { echo "edl-replica missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
 for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
-           edl-obs-dump edl-obs-agg edl-gateway edl-replica; do
+           edl-obs-dump edl-obs-agg edl-obs-top edl-gateway edl-replica; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

@@ -1,9 +1,13 @@
 """Job-level aggregator: coord-store discovery of /metrics endpoints,
 merged exposition that stays byte-parseable when processes export the
-same metric with different label sets, and the /healthz job summary."""
+same metric with different label sets, the /healthz job summary
+(windowed quantiles + robustness headlines), the scrape loop feeding
+the TSDB/rule engine, and /alerts."""
 
 import json
 import math
+import socket
+import time
 import urllib.request
 
 import pytest
@@ -142,6 +146,198 @@ def test_aggregator_tolerates_dead_target(memkv, fleet):
         assert any(name == "edl_t_total" for name, _ in parsed)
     finally:
         reg.stop()
+
+
+def test_merge_stays_parseable_when_help_text_changes_mid_run():
+    # satellite: a target rewriting its HELP string between scrapes (a
+    # redeploy with new wording) must not break parseability or dupe
+    # the family header on either scrape's merged page
+    def page(help_text):
+        return _page(lambda r: r.gauge("edl_flip", help_text).set(1))
+
+    for help_text in ("old wording", "new wording"):
+        merged = merge_expositions(
+            [({"component": "a", "instance": "h:1"}, page("old wording")),
+             ({"component": "b", "instance": "h:2"}, page(help_text))])
+        parse_exposition(merged)
+        assert merged.count("# HELP edl_flip") == 1
+        assert merged.count("# TYPE edl_flip gauge") == 1
+
+
+def test_many_dead_targets_scrape_in_one_timeout(memkv, fleet):
+    # satellite: the fan-out pool is sized to len(targets) — with 20
+    # blackholed targets (connected, never answered) the whole collect
+    # must cost ~ONE scrape timeout, not ceil(20/8) waves of them
+    fleet("trainer", lambda r: r.counter("edl_t_total", "t").inc())
+    blackhole = socket.socket()
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(32)                 # accept queue only: never served
+    ep = f"127.0.0.1:{blackhole.getsockname()[1]}"
+    regs = [advert.advertise_metrics(memkv, "job", "ghost", ep,
+                                     name=f"ghost-{i}", ttl=30)
+            for i in range(20)]
+    try:
+        agg = Aggregator(memkv, "job", scrape_timeout=0.75, cache_s=0.0)
+        t0 = time.monotonic()
+        merged, info = agg.collect()
+        elapsed = time.monotonic() - t0
+        assert len(info["errors"]) == 20
+        assert any(name == "edl_t_total"
+                   for name, _ in parse_exposition(merged))
+        # serial: 15s; min(8, n) pool: ~2.25s; len(n) pool: ~0.75s
+        assert elapsed < 2.0, f"dead-target fan-out took {elapsed:.2f}s"
+    finally:
+        for r in regs:
+            r.stop()
+        blackhole.close()
+
+
+def test_job_summary_caches_recovery_read(memkv, fleet):
+    from edl_tpu.cluster import recovery
+
+    fleet("trainer", lambda r: r.counter("edl_t_total", "t").inc())
+    recovery.write_launcher_half(
+        memkv, "job", "s1", "podA",
+        {"detect": 100.0, "killed": 101.0, "barrier": 101.5, "spawn": 102.0})
+    calls = {"n": 0}
+    real = memkv.get_prefix
+
+    def counting(prefix):
+        if "recovery" in prefix:
+            calls["n"] += 1
+        return real(prefix)
+
+    memkv.get_prefix = counting
+    try:
+        agg = Aggregator(memkv, "job", cache_s=0.0)
+        for _ in range(5):
+            # collect() is cache-cold every time (cache_s=0), but the
+            # recovery read must NOT re-hit the store per health probe
+            assert agg.job_summary()["resizes"] == 1
+        assert calls["n"] == 1
+    finally:
+        memkv.get_prefix = real
+
+
+def test_job_summary_windowed_gateway_quantiles(memkv, fleet):
+    reg_holder = {}
+
+    def build(r):
+        reg_holder["hist"] = r.histogram(
+            "edl_gateway_request_seconds", "lat", buckets=(0.1, 1.0))
+        for _ in range(100):
+            reg_holder["hist"].observe(0.05)
+
+    fleet("gateway", build)
+    agg = Aggregator(memkv, "job", cache_s=0.0, include_self=False,
+                     quantile_window=60.0)
+    # no TSDB history yet: lifetime fallback, explicitly marked
+    s = agg.job_summary()
+    assert s["gateway"]["window"] == "lifetime"
+    assert s["gateway"]["p99_s"] is not None
+    assert s["alerts"] == {"firing": 0, "names": []}
+
+    # two scrapes with ONLY slow traffic in between: the windowed
+    # quantile must see the window's distribution, not the lifetime's
+    agg.scrape_once(now=1000.0)
+    for _ in range(50):
+        reg_holder["hist"].observe(0.5)
+    agg._cached = None                      # force a fresh fan-out
+    agg.scrape_once(now=1010.0)
+    s = agg.job_summary()
+    assert s["gateway"]["window"] == "60s"
+    assert s["gateway"]["requests"] == 50.0          # window, not lifetime
+    assert s["gateway"]["p50_s"] > 0.1               # all-slow window
+
+
+def test_job_summary_robustness_headlines(memkv, fleet):
+    def build(r):
+        r.counter("edl_hang_restarts_total", "hangs").inc(2)
+        r.counter("edl_data_spans_requeued_total", "req",
+                  ("reader",)).labels(reader="r0").inc(37)
+        r.gauge("edl_coord_outage_seconds", "mttr").set(3.25)
+    fleet("launcher", build)
+    agg = Aggregator(memkv, "job", cache_s=0.0, include_self=False)
+    rb = agg.job_summary()["robustness"]
+    assert rb["hang_restarts"] == 2.0
+    assert rb["data_spans_requeued"] == 37.0
+    assert rb["coord_restart_mttr_s"] == 3.25
+    assert rb["data_leader_mttr_s"] is None          # nothing reported it
+
+
+def test_scrape_loop_feeds_rules_and_alerts_endpoint(memkv, fleet):
+    from edl_tpu.obs.rules import Rule
+
+    holder = {}
+
+    def build(r):
+        holder["g"] = r.gauge("edl_smoke_pressure", "p")
+        holder["g"].set(0.0)
+
+    fleet("trainer", build)
+    rules = [Rule("pressure-high", kind="gauge",
+                  metric="edl_smoke_pressure", op=">", threshold=5.0,
+                  window=60.0, severity="critical", summary="too high")]
+    srv = AggregatorServer(memkv, "job", host="127.0.0.1", cache_s=0.0,
+                           include_self=False, scrape_interval=0.1,
+                           rules=rules, incident_dir="").start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/alerts", timeout=10).read().decode())
+        assert body["firing"] == [] and len(body["rules"]) == 1
+        holder["g"].set(9.0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{srv.endpoint}/alerts", timeout=10).read().decode())
+            if body["firing"]:
+                break
+            time.sleep(0.05)
+        assert [a["alert"] for a in body["firing"]] == ["pressure-high"]
+        assert body["firing"][0]["severity"] == "critical"
+        # the /healthz roll-up sees it too
+        health = json.loads(urllib.request.urlopen(
+            f"http://{srv.endpoint}/healthz", timeout=10).read().decode())
+        assert health["alerts"]["names"] == ["pressure-high"]
+    finally:
+        srv.stop()
+
+
+def test_job_trace_publish_roundtrip(memkv):
+    from edl_tpu.obs import context as obs_context
+
+    assert advert.current_job_trace(memkv, "job") is None
+    ctx = obs_context.new_trace()
+    advert.publish_job_trace(memkv, "job", ctx, stage="s1")
+    rec = advert.current_job_trace(memkv, "job")
+    assert rec["trace_id"] == ctx.trace_id and rec["stage"] == "s1"
+    # the aggregator's incident trace provider reads the same record
+    agg = Aggregator(memkv, "job", cache_s=0.0)
+    assert agg._job_trace_id() == ctx.trace_id
+
+
+def test_render_top_frame():
+    from edl_tpu.obs.top import render_top
+
+    health = {"job_id": "rn50", "live_targets": 3,
+              "components": {"trainer": 2, "launcher": 1},
+              "rates": {"train_steps_per_s": 12.5},
+              "gateway": {"p50_s": 0.01, "p99_s": 0.2, "requests": 100.0,
+                          "window": "120s"},
+              "robustness": {"coord_restart_mttr_s": 1.5,
+                             "data_leader_mttr_s": None,
+                             "hang_restarts": 0.0,
+                             "data_spans_requeued": 0.0},
+              "scrape_errors": {}}
+    alerts = {"firing": [{"alert": "trainer-hang", "severity": "critical",
+                          "value": 0.0, "firing_since": time.time() - 5,
+                          "summary": "no step progress"}]}
+    text = render_top(health, alerts)
+    assert "job rn50" in text and "trainer" in text
+    assert "trainer-hang" in text and "critical" in text
+    assert "p99=0.2s" in text
+    quiet = render_top(health, {"firing": [], "pending": []})
+    assert "none firing" in quiet
 
 
 def test_aggregator_server_metrics_and_healthz(memkv, fleet):

@@ -1,0 +1,317 @@
+"""Rule engine: declarative specs, for: hold semantics, the builtin
+ruleset's signals, incident records and their trace join."""
+
+import json
+
+import pytest
+
+from edl_tpu.obs import dump as obs_dump
+from edl_tpu.obs import rules as obs_rules
+from edl_tpu.obs.metrics import REGISTRY, Registry, parse_exposition
+from edl_tpu.obs.rules import (
+    IncidentLog, Rule, RuleEngine, builtin_rules, load_rules, rule_from_dict,
+)
+from edl_tpu.obs.tsdb import TSDB
+
+
+def _feed(t, name, values, labels=(), t0=1000.0, dt=1.0):
+    for i, v in enumerate(values):
+        t.ingest({(name, labels): float(v)}, t0 + i * dt)
+    return t0 + (len(values) - 1) * dt
+
+
+# -- spec parsing ------------------------------------------------------------
+
+def test_rule_from_dict_and_for_alias():
+    r = rule_from_dict({"name": "x", "kind": "rate", "metric": "m_total",
+                        "for": 30, "threshold": 2, "severity": "critical"})
+    assert r.for_s == 30.0 and r.threshold == 2 and r.severity == "critical"
+    with pytest.raises(ValueError, match="unknown keys"):
+        rule_from_dict({"name": "x", "kind": "rate", "metric": "m",
+                        "nope": 1})
+    with pytest.raises(ValueError, match="unknown kind"):
+        Rule("x", kind="magic", metric="m")
+    with pytest.raises(ValueError, match="unknown op"):
+        Rule("x", kind="rate", metric="m", op="!=")
+
+
+def test_load_rules_env_overrides_builtin(monkeypatch):
+    override = [{"name": "trainer-hang", "kind": "stalled",
+                 "metric": "edl_train_step_seconds_count",
+                 "op": "<=", "threshold": 0.0, "window": 5, "for": 1},
+                {"name": "custom", "kind": "gauge", "metric": "edl_g",
+                 "threshold": 9}]
+    monkeypatch.setenv("EDL_TPU_ALERT_RULES", json.dumps(override))
+    rules = {r.name: r for r in load_rules()}
+    assert rules["trainer-hang"].window == 5.0      # builtin replaced
+    assert "custom" in rules
+    assert "gateway-p99-slo" in rules               # other builtins kept
+
+    monkeypatch.setenv("EDL_TPU_ALERT_BUILTIN", "0")
+    only = {r.name for r in load_rules()}
+    assert only == {"trainer-hang", "custom"}
+
+
+def test_load_rules_from_file_and_malformed(tmp_path, monkeypatch):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([{"name": "filerule", "kind": "gauge",
+                              "metric": "edl_g", "threshold": 1}]))
+    monkeypatch.setenv("EDL_TPU_ALERT_RULES", str(p))
+    assert any(r.name == "filerule" for r in load_rules())
+    # malformed config is skipped, never fatal — builtins survive
+    monkeypatch.setenv("EDL_TPU_ALERT_RULES", "[{broken json")
+    assert {r.name for r in load_rules()} == {r.name
+                                              for r in builtin_rules()}
+
+
+def test_alert_scale_shrinks_builtin_windows(monkeypatch):
+    base = {r.name: r for r in builtin_rules()}
+    monkeypatch.setenv("EDL_TPU_ALERT_SCALE", "0.1")
+    scaled = {r.name: r for r in builtin_rules()}
+    assert scaled["trainer-hang"].window == pytest.approx(
+        base["trainer-hang"].window * 0.1)
+    assert scaled["trainer-hang"].for_s == pytest.approx(
+        base["trainer-hang"].for_s * 0.1)
+
+
+# -- state machine: pending -> firing -> resolved ----------------------------
+
+def test_gauge_rule_for_hold_and_resolve():
+    t = TSDB()
+    rule = Rule("hot", kind="gauge", metric="edl_g", op=">", threshold=5.0,
+                window=60.0, for_s=10.0)
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    assert eng.evaluate(1000.0) == []               # pending, not firing
+    pend = eng.to_json()["pending"]
+    assert [a["alert"] for a in pend] == ["hot"]
+    t.ingest({("edl_g", ()): 9.0}, 1009.0)
+    assert eng.evaluate(1009.0) == []               # still inside for:
+    t.ingest({("edl_g", ()): 9.0}, 1011.0)
+    firing = eng.evaluate(1011.0)
+    assert [a["alert"] for a in firing] == ["hot"]
+    assert firing[0]["value"] == 9.0
+    # condition clears -> resolved
+    t.ingest({("edl_g", ()): 1.0}, 1012.0)
+    assert eng.evaluate(1012.0) == []
+
+
+def test_hold_interrupted_resets_pending():
+    t = TSDB()
+    rule = Rule("hot", kind="gauge", metric="edl_g", op=">", threshold=5.0,
+                window=60.0, for_s=10.0)
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    eng.evaluate(1000.0)
+    t.ingest({("edl_g", ()): 1.0}, 1005.0)          # dips below mid-hold
+    eng.evaluate(1005.0)
+    t.ingest({("edl_g", ()): 9.0}, 1008.0)
+    assert eng.evaluate(1008.0) == []               # hold restarted HERE
+    # had the original 1000.0 hold survived the dip, this would fire
+    assert eng.evaluate(1012.0) == []
+    t.ingest({("edl_g", ()): 9.0}, 1018.0)
+    assert eng.evaluate(1018.5) != []               # 1008 + for_s elapsed
+
+
+def test_on_change_gauge_resolves_after_value_stops_changing():
+    # the MTTR builtins: an event-style gauge ("last outage took Ns")
+    # re-exported verbatim every scrape must NOT keep the alert latched
+    # forever — staleness is measured from the value's last CHANGE
+    t = TSDB()
+    rule = Rule("mttr", kind="gauge", metric="edl_outage_s", op=">",
+                threshold=5.0, window=10.0, on_change=True)
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_outage_s", ()): 11.0}, 1000.0)   # slow outage observed
+    assert [a["alert"] for a in eng.evaluate(1000.0)] == ["mttr"]
+    for i in range(1, 20):                           # re-scraped, unchanged
+        t.ingest({("edl_outage_s", ()): 11.0}, 1000.0 + i)
+    assert [a["alert"]
+            for a in eng.evaluate(1009.0)] == ["mttr"]  # inside window
+    assert eng.evaluate(1019.0) == []                # aged out: resolved
+    # a NEW slow outage re-fires
+    t.ingest({("edl_outage_s", ()): 12.0}, 1020.0)
+    assert [a["alert"] for a in eng.evaluate(1020.0)] == ["mttr"]
+    # without on_change the same series would have stayed latched
+    latched = Rule("latched", kind="gauge", metric="edl_outage_s", op=">",
+                   threshold=5.0, window=10.0)
+    eng2 = RuleEngine(t, [latched])
+    assert [a["alert"] for a in eng2.evaluate(1030.0)] == ["latched"]
+
+
+def test_stalled_rule_unknown_on_fresh_job_fires_on_stall():
+    t = TSDB()
+    rule = Rule("hang", kind="stalled", metric="edl_steps_total",
+                op="<=", threshold=0.0, window=8.0, for_s=0.0,
+                match={"component": "trainer"})
+    eng = RuleEngine(t, [rule])
+    lab = (("component", "trainer"),)
+    t.ingest({("edl_steps_total", lab): 5.0}, 1000.0)
+    assert eng.evaluate(1000.0) == []               # no history: unknown
+    now = _feed(t, "edl_steps_total", range(10), labels=lab)
+    assert eng.evaluate(now) == []                  # progressing
+    for i in range(10):                             # counter freezes
+        t.ingest({("edl_steps_total", lab): 9.0}, now + 1 + i)
+    assert [a["alert"] for a in eng.evaluate(now + 10)] == ["hang"]
+
+
+def test_outlier_rule_fires_per_instance():
+    t = TSDB()
+    rule = Rule("straggler", kind="outlier", metric="edl_step_seconds",
+                by="instance", op=">", threshold=2.0, window=10.0,
+                min_series=3)
+    eng = RuleEngine(t, [rule])
+    for i in range(5):
+        page = {}
+        for inst, step in (("a", 0.1), ("b", 0.1), ("c", 0.5)):
+            page[("edl_step_seconds_sum",
+                  (("instance", inst),))] = step * i
+            page[("edl_step_seconds_count",
+                  (("instance", inst),))] = float(i)
+        t.ingest(page, 1000.0 + i)
+    firing = eng.evaluate(1004.0)
+    assert len(firing) == 1
+    assert firing[0]["instance"] == "c"
+    assert firing[0]["value"] == pytest.approx(5.0)  # 0.5 / median 0.1
+
+
+def test_outlier_needs_min_series():
+    t = TSDB()
+    rule = Rule("straggler", kind="outlier", metric="edl_step_seconds",
+                by="instance", threshold=2.0, window=10.0, min_series=3)
+    eng = RuleEngine(t, [rule])
+    for i in range(5):
+        t.ingest({("edl_step_seconds_sum", (("instance", "a"),)): 0.5 * i,
+                  ("edl_step_seconds_count", (("instance", "a"),)): float(i)},
+                 1000.0 + i)
+    assert eng.evaluate(1004.0) == []   # one series is not a fleet
+
+
+def test_quantile_rule():
+    t = TSDB()
+    rule = Rule("slo", kind="quantile", metric="edl_lat_seconds", q=0.99,
+                op=">", threshold=0.5, window=10.0)
+    eng = RuleEngine(t, [rule])
+    fast, slow, inf = ((("le", "0.1"),), (("le", "1.0"),), (("le", "+Inf"),))
+    t.ingest({("edl_lat_seconds_bucket", fast): 100.0,
+              ("edl_lat_seconds_bucket", slow): 100.0,
+              ("edl_lat_seconds_bucket", inf): 100.0}, 1000.0)
+    # window traffic lands entirely in (0.1, 1.0]: windowed p99 ~0.99
+    t.ingest({("edl_lat_seconds_bucket", fast): 100.0,
+              ("edl_lat_seconds_bucket", slow): 200.0,
+              ("edl_lat_seconds_bucket", inf): 200.0}, 1005.0)
+    firing = eng.evaluate(1005.0)
+    assert [a["alert"] for a in firing] == ["slo"]
+    assert firing[0]["value"] > 0.5
+
+
+def test_vanished_group_resolves():
+    t = TSDB(retention_s=5.0)
+    rule = Rule("hot", kind="gauge", metric="edl_g", op=">", threshold=1.0,
+                window=5.0, by="instance")
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_g", (("instance", "a"),)): 9.0}, 1000.0)
+    assert [a.get("instance") for a in eng.evaluate(1000.0)] == ["a"]
+    # instance dies; its series ages out -> the alert resolves
+    t.ingest({("edl_other", ()): 1.0}, 1030.0)
+    assert eng.evaluate(1030.0) == []
+
+
+def test_recording_rule_publishes_gauge():
+    t = TSDB()
+    rule = Rule("steps", kind="rate", metric="edl_steps_total",
+                op=">", threshold=1e9, window=4.0,
+                record="steps_per_s")
+    eng = RuleEngine(t, [rule])
+    now = _feed(t, "edl_steps_total", [0, 10, 20, 30, 40])
+    eng.evaluate(now)
+    g = REGISTRY.get("edl_alerts_recorded")
+    assert g.labels(rule="steps_per_s", series="").value == pytest.approx(10.0)
+
+
+# -- incidents: one write path, trace-joinable -------------------------------
+
+def test_incident_log_written_and_joins_trace(tmp_path):
+    t = TSDB()
+    rule = Rule("hot", kind="gauge", metric="edl_g", op=">", threshold=5.0,
+                window=60.0, severity="critical", summary="too hot")
+    log = IncidentLog(str(tmp_path), component="obs-agg", job_id="j")
+    eng = RuleEngine(t, [rule], incident_log=log,
+                     trace_provider=lambda: "feedc0de" * 4)
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    eng.evaluate(1000.0)
+    t.ingest({("edl_g", ()): 0.0}, 1001.0)
+    eng.evaluate(1001.0)
+
+    with open(log.path, encoding="utf-8") as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["state"] for r in recs] == ["firing", "resolved"]
+    assert recs[0]["name"] == "alert/hot"
+    assert recs[0]["trace_id"] == "feedc0de" * 4
+    assert recs[0]["severity"] == "critical"
+    assert recs[0]["job"] == "j"
+
+    # the dump CLI's merge mode reads incidents next to trace files and
+    # lands the alert inside that trace's causal timeline
+    events, skipped = obs_dump.read_trace_dir(str(tmp_path))
+    assert skipped == 0
+    tl = obs_dump.merge_timeline(events, "feedc0de" * 4)
+    assert [e["name"] for e in tl] == ["alert/hot", "alert/hot"]
+
+
+def test_incident_trace_provider_failure_is_not_fatal(tmp_path):
+    t = TSDB()
+    rule = Rule("hot", kind="gauge", metric="edl_g", op=">", threshold=5.0)
+
+    def boom():
+        raise RuntimeError("store down")
+
+    eng = RuleEngine(t, [rule],
+                     incident_log=IncidentLog(str(tmp_path)),
+                     trace_provider=boom)
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    assert [a["alert"] for a in eng.evaluate(1000.0)] == ["hot"]
+    with open(eng.incidents.path, encoding="utf-8") as f:
+        (rec,) = [json.loads(line) for line in f]
+    assert "trace_id" not in rec
+
+
+def test_firing_gauge_exported():
+    t = TSDB()
+    rule = Rule("gaugetest-hot", kind="gauge", metric="edl_g", op=">",
+                threshold=5.0, severity="warning")
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    eng.evaluate(1000.0)
+    parsed = parse_exposition(REGISTRY.render())
+    assert parsed[("edl_alerts_firing",
+                   (("alert", "gaugetest-hot"),
+                    ("severity", "warning")))] == 1.0
+    t.ingest({("edl_g", ()): 0.0}, 1001.0)
+    eng.evaluate(1001.0)
+    parsed = parse_exposition(REGISTRY.render())
+    assert parsed[("edl_alerts_firing",
+                   (("alert", "gaugetest-hot"),
+                    ("severity", "warning")))] == 0.0
+
+
+def test_bad_rule_does_not_kill_the_pass():
+    t = TSDB()
+    good = Rule("ok", kind="gauge", metric="edl_g", op=">", threshold=5.0)
+    bad = Rule("bad", kind="gauge", metric="edl_g")
+    bad.kind = "exploded"            # corrupt post-construction
+    eng = RuleEngine(t, [bad, good])
+    t.ingest({("edl_g", ()): 9.0}, 1000.0)
+    assert [a["alert"] for a in eng.evaluate(1000.0)] == ["ok"]
+
+
+# -- builtins sanity ---------------------------------------------------------
+
+def test_builtin_ruleset_covers_the_repo_signals():
+    names = {r.name for r in builtin_rules()}
+    assert {"trainer-hang", "trainer-straggler", "data-starvation",
+            "coord-mttr-regression", "data-leader-mttr-regression",
+            "gateway-p99-slo", "gateway-reject-burn",
+            "hang-restarts"} <= names
+    for r in builtin_rules():
+        assert r.kind in obs_rules.KINDS
+        assert r.severity in ("warning", "critical")

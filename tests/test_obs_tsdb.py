@@ -1,0 +1,202 @@
+"""Ring-buffer TSDB: retention, counter-reset-aware rates, windowed
+histogram quantiles, and the edge cases the rule engine leans on."""
+
+import math
+
+import pytest
+
+from edl_tpu.obs.metrics import Registry, parse_exposition
+from edl_tpu.obs.tsdb import TSDB, quantile_from_buckets
+
+
+def _scrape(build):
+    reg = Registry()
+    build(reg)
+    return parse_exposition(reg.render())
+
+
+def _feed_counter(t, values, t0=1000.0, dt=1.0, name="edl_c_total"):
+    for i, v in enumerate(values):
+        t.ingest({(name, ()): float(v)}, t0 + i * dt)
+    return t0 + (len(values) - 1) * dt
+
+
+# -- ingestion / retention ---------------------------------------------------
+
+def test_ingest_latest_and_retention():
+    t = TSDB(retention_s=10.0)
+    _feed_counter(t, range(20), t0=0.0)  # ts 0..19
+    ((labels, ts, v),) = t.latest("edl_c_total")
+    assert (labels, ts, v) == ((), 19.0, 19.0)
+    # points older than retention were pruned on ingest
+    inc = t.increase("edl_c_total", window=100.0, now=19.0)
+    assert inc[""][1] <= 10.0 + 1e-9     # covered at most the retention
+
+    # a series that stops being scraped is evicted after one window
+    t.ingest({("edl_other", ()): 1.0}, 20.0)
+    for i in range(12):
+        t.ingest({("edl_c_total", ()): 30.0 + i}, 21.0 + i)
+    assert t.latest("edl_other") == []
+    assert t.series_count("edl_other") == 0
+
+
+def test_max_points_ring():
+    t = TSDB(retention_s=1e9, max_points=8)
+    _feed_counter(t, range(100), t0=0.0)
+    ((_, ts, v),) = t.latest("edl_c_total")
+    assert v == 99.0
+    inc = t.increase("edl_c_total", window=1e9, now=99.0)
+    assert inc[""][0] == pytest.approx(7.0)  # only the ring's 8 points
+
+
+def test_max_series_cap():
+    t = TSDB(max_series=3)
+    for i in range(10):
+        t.ingest({(f"edl_s{i}", ()): 1.0}, 100.0)
+    assert sum(t.series_count(f"edl_s{i}") for i in range(10)) == 3
+
+
+# -- counter-reset-aware increase/rate ---------------------------------------
+
+def test_increase_simple_and_rate():
+    t = TSDB()
+    now = _feed_counter(t, [0, 10, 20, 30, 40])
+    assert t.increase("edl_c_total", 4.0, now=now)[""][0] == pytest.approx(40)
+    assert t.rate("edl_c_total", 4.0, now=now)[""] == pytest.approx(10.0)
+
+
+def test_increase_counter_reset_between_scrapes():
+    # 0,10,20 then the process restarts: 5,15 — PromQL semantics: the
+    # reset counts from zero, total increase 20 + 5 + 10 = 35
+    t = TSDB()
+    now = _feed_counter(t, [0, 10, 20, 5, 15])
+    assert t.increase("edl_c_total", 4.0, now=now)[""][0] == pytest.approx(35)
+    # and the rate can never go negative
+    assert t.rate("edl_c_total", 4.0, now=now)[""] > 0
+
+
+def test_rate_insufficient_coverage_is_unknown():
+    t = TSDB()
+    t.ingest({("edl_c_total", ()): 5.0}, 1000.0)
+    t.ingest({("edl_c_total", ()): 6.0}, 1001.0)
+    # 1s of history cannot answer a 60s window: unknown, NOT zero —
+    # the hang rule must not fire on a just-started job
+    assert t.rate("edl_c_total", 60.0, now=1001.0) == {}
+    # but a covered window answers
+    assert t.rate("edl_c_total", 1.2, now=1001.0)[""] == pytest.approx(1.0)
+
+
+def test_rate_grouped_by_label():
+    t = TSDB()
+    for i in range(5):
+        t.ingest({("edl_c_total", (("instance", "a"),)): float(i * 2),
+                  ("edl_c_total", (("instance", "b"),)): float(i * 6)},
+                 1000.0 + i)
+    r = t.rate("edl_c_total", 4.0, now=1004.0, by="instance")
+    assert r["a"] == pytest.approx(2.0)
+    assert r["b"] == pytest.approx(6.0)
+    # ungrouped: one summed series
+    total = t.rate("edl_c_total", 4.0, now=1004.0)
+    assert total[""] == pytest.approx(8.0)
+
+
+def test_stalled_counter_rates_zero_not_unknown():
+    t = TSDB()
+    now = _feed_counter(t, [50] * 10)   # scrapes continue, value frozen
+    assert t.rate("edl_c_total", 8.0, now=now)[""] == 0.0
+
+
+# -- windowed histogram quantiles --------------------------------------------
+
+def _hist_scrape(observations, buckets=(0.1, 1.0)):
+    return _scrape(lambda r: [r.histogram("edl_h_seconds", "h",
+                                          buckets=buckets).observe(o)
+                              for o in observations])
+
+
+def test_windowed_quantile_tracks_the_window_not_the_lifetime():
+    t = TSDB()
+    # first era: all fast (0.05s) — baseline scrape at t=0
+    t.ingest(_hist_scrape([0.05] * 100), 1000.0)
+    # second era: all slow (0.5s) land between the next scrapes
+    t.ingest(_hist_scrape([0.05] * 100 + [0.5] * 50), 1010.0)
+    t.ingest(_hist_scrape([0.05] * 100 + [0.5] * 100), 1020.0)
+    # lifetime p50 is still 'fast' (150/200 obs <= 0.1) but the WINDOW
+    # saw only slow traffic
+    q = t.quantile_over_window("edl_h_seconds", 0.50, window=25.0,
+                               now=1020.0)
+    assert q is not None and q > 0.1
+    # empty window: None (caller falls back to lifetime, marked)
+    assert t.quantile_over_window("edl_h_seconds", 0.5, window=25.0,
+                                  now=2000.0) is None
+
+
+def test_window_buckets_sum_across_instances_and_survive_reset():
+    t = TSDB()
+    page_a = {("edl_h_seconds_bucket", (("instance", "a"), ("le", "0.1"))): 4.0,
+              ("edl_h_seconds_bucket", (("instance", "a"), ("le", "+Inf"))): 6.0}
+    page_b = {("edl_h_seconds_bucket", (("instance", "b"), ("le", "0.1"))): 10.0,
+              ("edl_h_seconds_bucket", (("instance", "b"), ("le", "+Inf"))): 10.0}
+    t.ingest({**page_a, **page_b}, 1000.0)
+    grown = {k: v + 2.0 for k, v in page_a.items()}
+    # instance b RESTARTED: cumulative counts fell back to ~0 then grew
+    reset_b = {k: 1.0 for k in page_b}
+    t.ingest({**grown, **reset_b}, 1005.0)
+    w = t.window_buckets("edl_h_seconds", window=4.0, now=1005.0)
+    # a contributed +2 per bucket; b's reset contributes its post-reset
+    # absolute (1.0) — never a negative count
+    assert w[0.1] == pytest.approx(3.0)
+    assert w[math.inf] == pytest.approx(3.0)
+    assert all(v >= 0 for v in w.values())
+
+
+def test_mean_over_window_by_instance():
+    t = TSDB()
+    for i in range(4):
+        t.ingest({
+            ("edl_h_seconds_sum", (("instance", "a"),)): 0.1 * i,
+            ("edl_h_seconds_count", (("instance", "a"),)): float(i),
+            ("edl_h_seconds_sum", (("instance", "b"),)): 0.5 * i,
+            ("edl_h_seconds_count", (("instance", "b"),)): float(i),
+        }, 1000.0 + i)
+    means = t.mean_over_window("edl_h_seconds", 3.0, now=1003.0,
+                               by="instance")
+    assert means["a"] == pytest.approx(0.1)
+    assert means["b"] == pytest.approx(0.5)
+
+
+# -- quantile_from_buckets edge cases (satellite) ----------------------------
+
+def test_quantile_single_bucket_only_inf():
+    # a histogram whose only bucket is +Inf carries no magnitude
+    # information: the estimate collapses to the 0.0 floor, not a crash
+    assert quantile_from_buckets({math.inf: 10.0}, 0.5) == 0.0
+    assert quantile_from_buckets({math.inf: 10.0}, 0.99) == 0.0
+
+
+def test_quantile_all_observations_in_inf_bucket():
+    # every observation beyond the last finite bound: the classic
+    # histogram_quantile answer is that bound
+    b = {0.1: 0.0, 1.0: 0.0, math.inf: 50.0}
+    assert quantile_from_buckets(b, 0.5) == pytest.approx(1.0)
+
+
+def test_quantile_single_finite_bucket():
+    b = {0.5: 7.0, math.inf: 7.0}
+    q = quantile_from_buckets(b, 0.5)
+    assert q is not None and 0.0 <= q <= 0.5
+
+
+def test_quantile_empty_and_zero():
+    assert quantile_from_buckets({}, 0.5) is None
+    assert quantile_from_buckets({0.1: 0.0, math.inf: 0.0}, 0.5) is None
+
+
+def test_windowed_quantile_counter_reset_between_scrapes():
+    t = TSDB()
+    t.ingest(_hist_scrape([0.05] * 40), 1000.0)
+    # restart: fresh histogram, only 10 slow observations since boot
+    t.ingest(_hist_scrape([0.5] * 10), 1010.0)
+    q = t.quantile_over_window("edl_h_seconds", 0.5, window=15.0, now=1010.0)
+    # the reset era contributes its absolute post-reset counts: all slow
+    assert q is not None and q > 0.1
