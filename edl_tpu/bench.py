@@ -193,6 +193,8 @@ def _main_impl(out: dict) -> None:
             peak = _peak_tflops(jax.devices()[0])
             if peak:
                 mfu = tflops_chip / peak
+    # edl-lint: disable=wire-error — optional enrichment: MFU simply
+    # stays absent from the artifact when cost analysis is unavailable
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         pass
 
@@ -473,6 +475,8 @@ def _bench_coord_outage() -> dict:
         for reg in registers:
             try:
                 reg.stop()
+            # edl-lint: disable=wire-error — bench teardown; the
+            # artifact (already measured) must still be emitted
             except Exception:  # noqa: BLE001 — teardown
                 pass
         if store is not None:
@@ -563,6 +567,8 @@ def _bench_data_outage() -> dict:
             if s is not None:
                 try:
                     s.stop()
+                # edl-lint: disable=wire-error — bench teardown; the
+                # artifact (already measured) must still be emitted
                 except Exception:  # noqa: BLE001 — teardown
                     pass
         kv.close()

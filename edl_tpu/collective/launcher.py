@@ -423,6 +423,10 @@ class Launcher:
             return False
         ts, published = info
         threshold = heartbeat.stale_threshold(published)
+        # edl-lint: disable=clock — ts is the TRAINER's wall-clock beat
+        # read from the store; staleness across processes can only be
+        # judged wall-to-wall (monotonic clocks don't compare across
+        # processes).  NTP slew windows are far below the threshold.
         return threshold is not None and time.time() - ts > threshold
 
     def _clear_heartbeat(self) -> None:

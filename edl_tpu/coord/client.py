@@ -11,6 +11,9 @@ from __future__ import annotations
 from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import exceptions, retry
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
 
 
 def _wire_to_rec(w):
@@ -98,7 +101,8 @@ class CoordClient(KVStore):
             return bool(self._rpc.call("ping").get("pong"))
         except exceptions.EdlCoordError:
             raise
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — documented False contract
+            logger.debug("ping handler error on %s: %s", self.endpoint, e)
             return False
 
     def watch_prefix(self, prefix, callback, period: float = 5.0):

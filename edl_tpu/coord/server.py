@@ -166,6 +166,9 @@ def wait_ready(endpoint: str, deadline_s: float = 120.0) -> float:
         try:
             if probe.ping():
                 return time.monotonic() - t0
+        # edl-lint: disable=wire-error — boot-poll: failure IS the
+        # expected state until the server answers; the loop's timeout
+        # raises with the endpoint when it never does
         except Exception:  # noqa: BLE001 — still booting
             pass
         finally:

@@ -85,8 +85,9 @@ class CoordSession:
             except BaseException:
                 try:
                     store.lease_revoke(self._lease_id)
-                except Exception:  # noqa: BLE001 — lease lapses at TTL
-                    pass
+                except Exception as e:  # noqa: BLE001 — lease lapses at TTL
+                    logger.debug("cleanup revoke of lease %d failed (%s); "
+                                 "it lapses at TTL", self._lease_id, e)
                 raise
             self._keys[key] = _Entry(value, exclusive)
         self._thread = threading.Thread(target=self._heartbeat, daemon=True,
@@ -201,7 +202,9 @@ class CoordSession:
         for key, keep in pending:
             try:
                 self._finish_unregister(key, keep)
-            except Exception:  # noqa: BLE001 — retry next beat
+            except Exception as e:  # noqa: BLE001 — retry next beat
+                logger.debug("orphan unregister of %s failed (%s); "
+                             "retrying next beat", key, e)
                 continue
             with self._lock:
                 self._orphans.pop(key, None)
@@ -314,8 +317,9 @@ class CoordSession:
                 # an unrevoked lease TTL-expires on its own anyway
                 with self._scope():
                     self._store.lease_revoke(self.lease_id)
-            except Exception:  # noqa: BLE001 — best effort on shutdown
-                pass
+            except Exception as e:  # noqa: BLE001 — best effort on shutdown
+                logger.debug("shutdown revoke of lease %d failed (%s); "
+                             "it lapses at TTL", self.lease_id, e)
 
     def abandon(self) -> None:
         """Test hook: stop refreshing but keep the lease until TTL

@@ -246,8 +246,11 @@ class DistributedReader:
                 self._leader.call("file_failed", reader=self.name,
                                   pod_id=self.pod_id, file_idx=file_idx,
                                   error=f"{type(e).__name__}: {e}")
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as report_err:  # noqa: BLE001
+                # the original error still propagates below; the leader
+                # learns of the dead grant via requeue-on-expiry instead
+                logger.debug("file_failed report for file %d lost: %s",
+                             file_idx, report_err)
             raise
 
     def _note_position(self, position: int) -> None:

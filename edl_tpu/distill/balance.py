@@ -254,10 +254,19 @@ class BalanceTable:
     def service(self, name: str) -> Service:
         with self._lock:
             svc = self._services.get(name)
-            if svc is None:
-                svc = self._services[name] = Service(
-                    name, self._store, client_ttl=self._client_ttl)
+        if svc is not None:
             return svc
+        # construct OUTSIDE the table lock: Service.__init__ registers
+        # a store watch and runs a get_prefix, so building it under
+        # _lock would stall every register/heartbeat/unregister behind
+        # one slow store round-trip (edl-lint: blocking-under-lock).
+        # Double-checked insert; a losing racer closes its copy.
+        fresh = Service(name, self._store, client_ttl=self._client_ttl)
+        with self._lock:
+            svc = self._services.setdefault(name, fresh)
+        if svc is not fresh:
+            fresh.close()
+        return svc
 
     # -- RPC handlers (wired by DiscoveryServer) -----------------------------
     def register_client(self, client_id: str, service: str,

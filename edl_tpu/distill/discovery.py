@@ -86,8 +86,9 @@ class DiscoveryClient:
             try:
                 self._rpc.call("unregister", client_id=self.client_id,
                                service=self._service)
-            except Exception:  # noqa: BLE001 — best effort
-                pass
+            except Exception as e:  # noqa: BLE001 — best effort
+                logger.debug("unregister of %s failed (%s); the server's "
+                             "client GC expires it", self.client_id, e)
             self._rpc.close()
 
     def servers(self) -> list[str]:

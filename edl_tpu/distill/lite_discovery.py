@@ -100,9 +100,13 @@ class LiteBalanceServer:
                     self._read(key.data)
             if time.monotonic() - last_gc >= self._period:
                 last_gc = time.monotonic()
+                # snapshot, then sweep outside the table lock (each
+                # Service has its own lock; holding ours across the
+                # sweep serializes the select loop against handlers)
                 with self._lock:
-                    for svc in self._services.values():
-                        svc.gc_expired()
+                    services = list(self._services.values())
+                for svc in services:
+                    svc.gc_expired()
 
     def _accept(self) -> None:
         try:
@@ -158,9 +162,19 @@ class LiteBalanceServer:
     def _service(self, name: str) -> Service:
         with self._lock:
             svc = self._services.get(name)
-            if svc is None:
-                svc = self._services[name] = Service(name, self._store)
+        if svc is not None:
             return svc
+        # same contract as BalanceTable.service(): Service.__init__
+        # does store I/O (watch + get_prefix), so it must not run under
+        # the table lock — the single select loop would stall behind a
+        # slow store (edl-lint: blocking-under-lock).  Double-checked
+        # insert; a losing racer closes its copy.
+        fresh = Service(name, self._store)
+        with self._lock:
+            svc = self._services.setdefault(name, fresh)
+        if svc is not fresh:
+            fresh.close()
+        return svc
 
     def _handle(self, conn: _Conn, msg: dict) -> dict:
         m = msg.get("m")
@@ -189,9 +203,13 @@ class LiteBalanceServer:
     def stop(self) -> None:
         self._halt.set()
         self._thread.join(timeout=5.0)
+        # close() stops store watchers (joins their threads): snapshot
+        # under the lock, close outside it — BalanceTable.close() parity
         with self._lock:
-            for svc in self._services.values():
-                svc.close()
+            services = list(self._services.values())
+            self._services = {}
+        for svc in services:
+            svc.close()
         try:
             self._sel.close()
         except OSError:

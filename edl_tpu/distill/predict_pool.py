@@ -88,8 +88,8 @@ class _Worker(threading.Thread):
     def _close_client(self):
         try:
             self.client.close()
-        except Exception:  # noqa: BLE001 — shutdown best-effort
-            pass
+        except Exception as e:  # noqa: BLE001 — shutdown best-effort
+            logger.debug("client close for %s failed: %s", self.endpoint, e)
 
     def stop(self):
         self.stop_event.set()

@@ -32,6 +32,7 @@ from edl_tpu.coord.register import Register
 from edl_tpu.distill.balance import server_key
 from edl_tpu.distill.predict_client import decode_array, encode_array
 from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.exceptions import EdlUnavailableError
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
 
@@ -105,9 +106,12 @@ class TeacherServer:
         req = _Request(arrays, list(fetch), len(next(iter(arrays.values()))))
         with self._enqueue_lock:
             # atomic with stop(): once _stopping is set under this lock,
-            # no request can slip in behind the queue drain
+            # no request can slip in behind the queue drain.  Typed +
+            # retryable so remote students route to another teacher
+            # instead of parsing an EdlInternalError traceback
+            # (edl-lint: wire-error).
             if self._stopping:
-                raise RuntimeError("teacher server stopping")
+                raise EdlUnavailableError("teacher server stopping")
             self._queue.put(req)
         req.done.wait()
         if req.error is not None:

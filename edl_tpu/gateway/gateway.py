@@ -456,8 +456,9 @@ class Gateway:
     def _release(client: RpcClient, request_id: str) -> None:
         try:
             client.call("serve_release", request_id=request_id)
-        except Exception:  # noqa: BLE001 — result TTL evicts anyway
-            pass
+        except Exception as e:  # noqa: BLE001 — result TTL evicts anyway
+            logger.debug("release of %s failed (%s); the replica's "
+                         "result TTL evicts it", request_id[:8], e)
 
 
 class GatewayServer:

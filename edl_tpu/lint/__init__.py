@@ -1,0 +1,16 @@
+"""edl-lint: project-aware static analysis for EDL invariants.
+
+Every check in this package pins a defect class that cost PRs 6-8
+multiple hand-review rounds (see doc/lint.md for the check catalog and
+the historical bug each one encodes).  The analyzer is stdlib-``ast``
+only — zero new dependencies — and runs as a CI gate in front of the
+test tiers: a committed ``lint_baseline.json`` waives pre-existing
+findings individually, so CI fails on any NEW finding and the baseline
+can only ratchet down (a fixed finding turns its waiver stale, which
+also fails until the waiver is removed).
+
+Entry points: the ``edl-lint`` console script (``lint/cli.py``) and
+:func:`edl_tpu.lint.engine.run` for tooling/tests.
+"""
+
+from edl_tpu.lint.engine import Finding, Project, run  # noqa: F401

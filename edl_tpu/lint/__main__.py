@@ -1,0 +1,7 @@
+"""``python -m edl_tpu.lint`` == the ``edl-lint`` console script."""
+
+import sys
+
+from edl_tpu.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,107 @@
+"""The ratcheting baseline: individually-waived pre-existing findings.
+
+A committed ``lint_baseline.json`` holds one waiver key per grand-
+fathered finding.  The CI contract:
+
+- a finding whose key is NOT in the baseline is **new** → fail;
+- a baseline key with no matching finding is **stale** → fail (the
+  defect was fixed; remove the waiver so it can never silently return);
+- hence the baseline only ever shrinks (``--update-baseline`` rewrites
+  it from the current findings — reviewers see the delta as ordinary
+  diff lines).
+
+Keys are line-free: ``path::context::message`` plus an occurrence
+index when the same (path, context, message) triple appears more than
+once — so edits elsewhere in a file never invalidate waivers, while a
+*second* instance of a waived defect in the same function still fails.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from edl_tpu.lint.engine import Finding
+
+BASELINE_NAME = "lint_baseline.json"
+_VERSION = 1
+
+
+def finding_keys(findings: list[Finding]) -> list[tuple[str, Finding]]:
+    """Stable (key, finding) pairs; occurrence index disambiguates
+    repeats of the same (check, path, context, message)."""
+    counts: dict[tuple, int] = {}
+    out: list[tuple[str, Finding]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.check,
+                                             f.message)):
+        ident = (f.check, f.path, f.context, f.message)
+        n = counts.get(ident, 0)
+        counts[ident] = n + 1
+        key = f"{f.path}::{f.context}::{f.message}"
+        if n:
+            key += f"#{n}"
+        out.append((key, f))
+    return out
+
+
+def load(path: Path) -> dict[str, list[str]]:
+    """check-id -> waiver keys; empty when the file doesn't exist."""
+    if not path.is_file():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    waivers = data.get("waivers", {})
+    if not isinstance(waivers, dict):
+        raise ValueError(f"malformed baseline {path}: waivers not a dict")
+    return {check: list(keys) for check, keys in waivers.items()}
+
+
+def save(path: Path, findings: list[Finding],
+         extra: dict[str, list[str]] | None = None) -> dict[str, list[str]]:
+    """Write waivers from ``findings``; ``extra`` carries over waiver
+    lists for checks that did NOT run (partial ``--checks`` updates
+    must never drop the rest of the grandfather list)."""
+    waivers: dict[str, list[str]] = {c: list(k)
+                                     for c, k in (extra or {}).items()}
+    for key, f in finding_keys(findings):
+        waivers.setdefault(f.check, []).append(key)
+    for keys in waivers.values():
+        keys.sort()
+    payload = {
+        "version": _VERSION,
+        "comment": "edl-lint waivers for pre-existing findings. This file "
+                   "only ratchets DOWN: fix a finding, delete its key "
+                   "(or run edl-lint --update-baseline). Never add keys "
+                   "for new code — fix the code or use an inline "
+                   "`# edl-lint: disable=<check>` with a justification.",
+        "waivers": {check: waivers[check] for check in sorted(waivers)},
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n",
+                    encoding="utf-8")
+    return waivers
+
+
+def compare(findings: list[Finding], waivers: dict[str, list[str]]
+            ) -> tuple[list[tuple[str, Finding]], list[tuple[str, str]],
+                       list[tuple[str, Finding]]]:
+    """Split findings against the baseline.
+
+    Returns ``(new, stale, waived)``: new = (key, finding) not waived;
+    stale = (check, key) waived but no longer found; waived = (key,
+    finding) matched by a waiver.
+    """
+    waived_keys = {(check, key) for check, keys in waivers.items()
+                   for key in keys}
+    new: list[tuple[str, Finding]] = []
+    waived: list[tuple[str, Finding]] = []
+    seen: set[tuple[str, str]] = set()
+    for key, f in finding_keys(findings):
+        seen.add((f.check, key))
+        if (f.check, key) in waived_keys:
+            waived.append((key, f))
+        else:
+            new.append((key, f))
+    stale = sorted(waived_keys - seen)
+    return new, stale, waived

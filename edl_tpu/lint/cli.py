@@ -1,0 +1,123 @@
+"""``edl-lint`` — run the checks, gate on the baseline ratchet.
+
+Exit codes: 0 clean (every finding waived), 1 new findings or stale
+waivers, 2 usage errors.  ``--json`` emits one machine-readable object
+(findings + verdict) for tooling; the default text format is
+``file:line · check-id · message`` — clickable in editors and CI logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from edl_tpu.lint import baseline as baseline_mod
+from edl_tpu.lint import engine
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "edl-lint",
+        description="Project-aware static analysis for EDL concurrency, "
+                    "wire, and catalog invariants (see doc/lint.md).")
+    p.add_argument("--root", default=".",
+                   help="repo root to analyze (default: cwd)")
+    p.add_argument("--checks", default="",
+                   help="comma-separated check ids (default: all)")
+    p.add_argument("--list-checks", action="store_true",
+                   help="list check ids and exit")
+    p.add_argument("--baseline", default="",
+                   help=f"baseline path (default: <root>/"
+                        f"{baseline_mod.BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding; no ratchet gating")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(the reviewed ratchet step) and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_checks:
+        for cid in engine.check_ids():
+            print(f"{cid:20s} {engine.CHECK_DOC[cid]}")
+        return 0
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"edl-lint: no such root {root}", file=sys.stderr)
+        return 2
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()] or None
+    try:
+        findings = engine.run(root, checks=checks)
+    except ValueError as e:
+        print(f"edl-lint: {e}", file=sys.stderr)
+        return 2
+
+    bl_path = Path(args.baseline) if args.baseline \
+        else root / baseline_mod.BASELINE_NAME
+    if args.update_baseline:
+        # with --checks, only the selected checks' waivers are rewritten
+        # — the other checks' waivers carry over untouched (a partial
+        # run must never delete the rest of the grandfather list)
+        keep: dict[str, list[str]] = {}
+        if checks and bl_path.is_file():
+            try:
+                prior = baseline_mod.load(bl_path)
+            except ValueError as e:
+                print(f"edl-lint: {e}", file=sys.stderr)
+                return 2
+            keep = {c: k for c, k in prior.items() if c not in set(checks)}
+        waivers = baseline_mod.save(bl_path, findings, extra=keep)
+        n = sum(len(v) for v in waivers.values())
+        print(f"edl-lint: baseline rewritten with {n} waiver(s) "
+              f"-> {bl_path}")
+        return 0
+
+    if args.no_baseline:
+        new = baseline_mod.finding_keys(findings)
+        stale: list[tuple[str, str]] = []
+        waived: list[tuple[str, engine.Finding]] = []
+    else:
+        try:
+            waivers = baseline_mod.load(bl_path)
+        except ValueError as e:
+            print(f"edl-lint: {e}", file=sys.stderr)
+            return 2
+        # only gate checks that actually ran: a --checks subset must
+        # not report every other check's waivers as stale
+        ran = set(checks or engine.check_ids())
+        waivers = {c: k for c, k in waivers.items() if c in ran}
+        new, stale, waived = baseline_mod.compare(findings, waivers)
+
+    if args.as_json:
+        print(json.dumps({
+            "root": str(root),
+            "checks": checks or engine.check_ids(),
+            "new": [dict(f.to_dict(), key=key) for key, f in new],
+            "stale_waivers": [{"check": c, "key": k} for c, k in stale],
+            "waived": [dict(f.to_dict(), key=key) for key, f in waived],
+            "ok": not new and not stale,
+        }, indent=2))
+    else:
+        for _key, f in new:
+            print(f.render())
+        for c, k in stale:
+            print(f"{baseline_mod.BASELINE_NAME} · {c} · stale waiver "
+                  f"(finding fixed — remove it): {k}")
+        print(f"edl-lint: {len(new)} new finding(s), {len(stale)} stale "
+              f"waiver(s), {len(waived)} waived", file=sys.stderr)
+        if new or stale:
+            print("edl-lint: fix the findings (preferred), add an inline "
+                  "`# edl-lint: disable=<check>` with a justification, or "
+                  "run --update-baseline and justify the diff in review.",
+                  file=sys.stderr)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

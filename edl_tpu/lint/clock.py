@@ -1,0 +1,146 @@
+"""Clock discipline and thread hygiene.
+
+**clock** — ``time.time()`` used in arithmetic or comparison is almost
+always a duration or deadline computation, and wall clocks step (NTP
+slew, VM suspend): a TTL or retry deadline computed from ``time.time()``
+can expire instantly or never.  Durations/deadlines belong to
+``time.monotonic()``; ``time.time()`` is for *timestamps* (event
+records, trace spans), where it appears as a bare value, not an
+operand.  The same check flags argless ``datetime.now()`` /
+``utcnow()`` / ``today()`` in replay-sensitive paths (the coord WAL
+and the data journal): replay happens at a different wall time, so a
+"now" captured at write time diverges from one recomputed at replay.
+
+**thread-hygiene** — a ``threading.Thread`` with neither ``daemon=``
+nor a tracked join path outlives (or blocks) interpreter shutdown
+depending on luck.  Every thread must declare its lifecycle: daemon
+(the launcher may die with it) or joined (someone owns its exit).  A
+thread assigned to ``self._x`` counts as tracked when the class also
+calls ``self._x.join(...)`` or sets ``self._x.daemon``; a local ``x``
+must be joined (or daemonized) in the same function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from edl_tpu.lint.engine import Finding, Project, Source, check, dotted
+
+# files where replay reads back what "now" wrote: argless datetime-now
+# is nondeterministic across the replay boundary
+REPLAY_PATHS = ("edl_tpu/coord/wal.py", "edl_tpu/data/journal.py")
+
+_DT_NOW = ("datetime.now", "datetime.utcnow", "datetime.today",
+           "date.today")
+
+
+@check("clock",
+       "time.time() in duration/deadline arithmetic (wall clocks step; "
+       "use monotonic), argless datetime-now in replay-sensitive paths")
+def clock(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name == "time.time":
+                parent = src.parents.get(node)
+                if isinstance(parent, ast.BinOp) and \
+                        isinstance(parent.op, (ast.Add, ast.Sub)):
+                    findings.append(Finding(
+                        check="clock", path=src.rel, line=node.lineno,
+                        message="time.time() in +/- arithmetic: durations"
+                                "/deadlines need time.monotonic() "
+                                "(wall clock steps under NTP/suspend)",
+                        context=src.context_of(node)))
+                elif isinstance(parent, ast.Compare):
+                    findings.append(Finding(
+                        check="clock", path=src.rel, line=node.lineno,
+                        message="time.time() compared against a deadline: "
+                                "use time.monotonic() for deadlines",
+                        context=src.context_of(node)))
+            elif src.rel in REPLAY_PATHS and \
+                    any(name == d or name.endswith("." + d)
+                        for d in _DT_NOW):
+                if not node.args and not node.keywords:
+                    findings.append(Finding(
+                        check="clock", path=src.rel, line=node.lineno,
+                        message=f"argless `{name}()` in a replay-sensitive "
+                                "path: replay re-evaluates at a different "
+                                "wall time — record an explicit timestamp",
+                        context=src.context_of(node)))
+    return findings
+
+
+# -- thread-hygiene ----------------------------------------------------------
+def _is_thread_ctor(call: ast.Call) -> bool:
+    name = dotted(call.func) or ""
+    return name == "threading.Thread" or name == "Thread"
+
+
+def _has_daemon_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg == "daemon" for kw in call.keywords)
+
+
+def _attr_tracked(cls: ast.ClassDef, attr: str) -> bool:
+    """Does the class join ``self.<attr>`` or set its ``.daemon``?"""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and \
+                dotted(node.func.value) == f"self.{attr}":
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if dotted(t) == f"self.{attr}.daemon":
+                    return True
+    return False
+
+
+def _local_tracked(fn: ast.AST, var: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and dotted(node.func.value) == var:
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if dotted(t) == f"{var}.daemon":
+                    return True
+    return False
+
+
+@check("thread-hygiene",
+       "threading.Thread without daemon= or a tracked join path")
+def thread_hygiene(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.sources:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            if _has_daemon_kwarg(node):
+                continue
+            parent = src.parents.get(node)
+            tracked = False
+            if isinstance(parent, ast.Assign):
+                target = parent.targets[0]
+                tname = dotted(target)
+                if tname and tname.startswith("self.") \
+                        and tname.count(".") == 1:
+                    cls = src.enclosing(node, ast.ClassDef)
+                    if isinstance(cls, ast.ClassDef):
+                        tracked = _attr_tracked(cls, tname.split(".", 1)[1])
+                elif tname and "." not in tname:
+                    fn = src.enclosing(node, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                    scope = fn if fn is not None else src.tree
+                    tracked = _local_tracked(scope, tname)
+            if not tracked:
+                findings.append(Finding(
+                    check="thread-hygiene", path=src.rel, line=node.lineno,
+                    message="Thread without daemon= and without a join/"
+                            "daemon path: declare its lifecycle (daemon=, "
+                            "or join it where the owner stops)",
+                    context=src.context_of(node)))
+    return findings

@@ -1,0 +1,526 @@
+"""Concurrency checks: blocking-under-lock and lock-order.
+
+**blocking-under-lock** flags calls from a curated *blocking set* —
+RPC ops (``RpcClient.call*``), coordination-store ops, ``time.sleep``,
+file/socket I/O, ``subprocess``, thread ``.join()`` — that execute
+lexically inside a ``with <lock>:`` block or between ``acquire()`` /
+``release()``.  This is the recurring PR 6-8 hazard: the whole control
+plane is TTL-lease + watch loops, so one slow store call under a
+service lock stalls every heartbeat behind it and turns a blip into a
+spurious stop-resume.  The historical fixes this check pins: snapshot
+off the KV lock (``coord/memory.py``), journal I/O off the service
+lock (``data/data_server.py``), incident writes after lock release
+(``obs/rules.py``).
+
+**lock-order** builds a per-class lock-acquisition graph from nested
+``with`` blocks plus intra-class ``self.method()`` calls (transitive),
+and reports cycles — including the degenerate one, re-acquiring a
+non-reentrant lock already held through a self-call, which deadlocks a
+``threading.Lock`` instantly.
+
+Both checks are lexical and intra-class by design (RacerD-style
+compositional summaries, not whole-program): cheap enough for CI,
+and the codebase's locks are all instance attributes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from edl_tpu.lint.engine import (
+    Finding, Project, Source, check, dotted, name_segments, terminal,
+)
+
+# identifier segments that mark a variable/attribute as a lock
+LOCK_SEGMENTS = {"lock", "rlock", "mutex", "mtx", "cond"}
+
+# fully-qualified callables that block (module.func form)
+FQ_BLOCKING = {
+    "time.sleep", "os.fsync", "os.fdatasync", "os.sync",
+    "socket.create_connection", "urllib.request.urlopen",
+}
+
+# method names that block regardless of receiver: this project's RPC
+# and coordination-store wire surface (a leading underscore on the
+# callee is ignored, so private wrappers like ``self._call`` match)
+METHOD_BLOCKING = {
+    "call", "call_pipelined", "call_streaming", "connect", "connect_wait",
+    "watch_prefix", "get_prefix", "grant_lease", "keepalive",
+    "revoke_lease", "sendall", "recv", "recv_into", "recv_exact",
+    "recv_frame", "send_frame", "fetch_bytes", "push_bytes_pipelined",
+    "fetch_striped", "snapshot_now", "urlopen", "fsync",
+}
+
+# method names that block only on receivers whose name segments
+# intersect the gate set (``.get`` on a store blocks; on a dict it
+# doesn't — the receiver name is the project-aware disambiguator)
+RECEIVER_GATED = {
+    "join": {"thread", "worker", "producer", "consumer", "sweeper",
+             "proc", "process", "pool", "gc", "watcher", "heartbeat",
+             "t", "th"},
+    # NOTE: no "cond" here — Condition.wait() releases the lock it is
+    # built over, so waiting under `with lock:` is the correct idiom
+    "wait": {"event", "evt", "halt", "done", "stopped", "ready",
+             "barrier", "stop", "store", "kv"},
+    "result": {"fut", "future"},
+    "get": {"store", "kv", "coord", "etcd", "queue", "q"},
+    "put": {"store", "kv", "coord", "etcd"},
+    "delete": {"store", "kv", "coord", "etcd"},
+    "cas": {"store", "kv", "coord", "etcd"},
+    "write": {"f", "fh", "fp", "file", "wal", "log", "sock", "socket",
+              "out", "stream"},
+    "flush": {"f", "fh", "fp", "file", "wal", "log", "out", "stream"},
+    "append": {"wal", "log", "journal"},
+}
+
+_NO_DESCEND = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+               ast.ClassDef)
+
+
+def is_lockish(name: str | None) -> bool:
+    return name is not None and bool(name_segments(name) & LOCK_SEGMENTS)
+
+
+def _with_locks(stmt: ast.With) -> list[str]:
+    """Dotted names of lock-like context managers in a ``with``."""
+    out = []
+    for item in stmt.items:
+        name = dotted(item.context_expr)
+        if is_lockish(name):
+            out.append(name)
+    return out
+
+
+def _call_display(call: ast.Call) -> str | None:
+    """Display + match name for a call: ``a.b.call`` or ``.call`` when
+    the receiver is dynamic; None when the callee itself is dynamic."""
+    name = dotted(call.func)
+    if name is not None:
+        return name
+    if isinstance(call.func, ast.Attribute):
+        return f".{call.func.attr}"
+    return None
+
+
+def blocking_reason(call: ast.Call, held: dict[str, ast.AST]) -> str | None:
+    """Why this call is in the blocking set, or None.  ``held`` maps
+    the dotted names of currently-held locks to their acquire sites
+    (used to exempt ``cond.wait()`` under ``with cond:``)."""
+    name = _call_display(call)
+    if name is None:
+        return None
+    if isinstance(call.func, ast.Name):
+        if name == "sleep":
+            return "sleep()"
+        if name == "open":
+            return "open()"
+        return None
+    if name in FQ_BLOCKING:
+        return name
+    if name.startswith("subprocess."):
+        return name
+    meth = name.rsplit(".", 1)[-1].lstrip("_")
+    receiver = name.rsplit(".", 1)[0] if "." in name else ""
+    if meth in METHOD_BLOCKING:
+        return name
+    gate = RECEIVER_GATED.get(meth)
+    if gate and receiver:
+        if receiver in held:
+            return None  # cond.wait() under `with cond:` releases it
+        if name_segments(receiver) & gate:
+            return name
+    return None
+
+
+def _iter_exprs(stmt: ast.stmt):
+    """Every expression node of one statement, *excluding* nested
+    statements' bodies and nested function/class definitions (those
+    don't execute under the enclosing lock at this point)."""
+    block_fields = {"body", "orelse", "finalbody", "handlers", "cases"}
+    todo: list[ast.AST] = []
+    for field, value in ast.iter_fields(stmt):
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                             ast.With, ast.AsyncWith, ast.Try,
+                             ast.Match)) \
+                and field in block_fields:
+            continue
+        if isinstance(value, ast.AST):
+            todo.append(value)
+        elif isinstance(value, list):
+            todo.extend(v for v in value if isinstance(v, ast.AST))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, _NO_DESCEND):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _acq_rel(stmt: ast.stmt) -> tuple[str, str] | None:
+    """('acquire'|'release', lockname) for bare ``x.acquire()`` /
+    ``x.release()`` statements on lock-named receivers."""
+    if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+        return None
+    call = stmt.value
+    if not isinstance(call.func, ast.Attribute) or \
+            call.func.attr not in ("acquire", "release"):
+        return None
+    recv = dotted(call.func.value)
+    if not is_lockish(recv):
+        return None
+    return call.func.attr, recv
+
+
+# -- may-block summaries -----------------------------------------------------
+class _Summaries:
+    """Project-wide compositional *may-block* summaries.
+
+    A call under a lock is flagged not only when it is itself in the
+    blocking set, but also when it reaches one transitively through a
+    resolvable edge: a ``self.method()`` of the same class, a free
+    function of the same module, or a **constructor** of a class whose
+    ``__init__`` may block (the ``Service(...)``-under-table-lock bug:
+    the constructor performs a store watch + get_prefix).  Receiver-
+    typed calls (``obj.method()`` on a non-self object) are not
+    resolved — no type inference, summaries stay compositional.
+    """
+
+    def __init__(self, project: Project):
+        # (src.rel, class_or_"", fn_name) -> representative blocking
+        # reason reached from that function, or None
+        self._fns: dict[tuple[str, str, str], str | None] = {}
+        self._fn_nodes: dict[tuple[str, str, str], ast.AST] = {}
+        self._classes: dict[str, list[tuple[str, str]]] = {}  # name -> keys
+        for src in project.sources:
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls = src.enclosing(node, ast.ClassDef)
+                    cls_name = cls.name if isinstance(cls, ast.ClassDef) \
+                        else ""
+                    key = (src.rel, cls_name, node.name)
+                    self._fn_nodes[key] = node
+                    self._fns[key] = self._direct_reason(node)
+                    if cls_name and node.name == "__init__":
+                        self._classes.setdefault(cls_name, []).append(
+                            (src.rel, cls_name))
+        # fixpoint over resolvable call edges
+        changed = True
+        while changed:
+            changed = False
+            for key, reason in list(self._fns.items()):
+                if reason is not None:
+                    continue
+                node = self._fn_nodes[key]
+                via = self._edge_reason(key, node)
+                if via is not None:
+                    self._fns[key] = via
+                    changed = True
+
+    @staticmethod
+    def _direct_reason(fn: ast.AST) -> str | None:
+        for node in _walk_no_defs(fn):
+            if isinstance(node, ast.Call):
+                reason = blocking_reason(node, {})
+                if reason is not None:
+                    return reason
+        return None
+
+    def _ctor_reason(self, cls_name: str) -> str | None:
+        """Blocking reason of ``ClassName.__init__``; only when the
+        class name resolves unambiguously project-wide."""
+        keys = self._classes.get(cls_name, [])
+        if len(keys) != 1:
+            return None
+        rel, cname = keys[0]
+        return self._fns.get((rel, cname, "__init__"))
+
+    def _edge_reason(self, key: tuple[str, str, str],
+                     fn: ast.AST) -> str | None:
+        rel, cls_name, _ = key
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            via = self._resolve(rel, cls_name, node)
+            if via is not None:
+                return via[1]
+        return None
+
+    def _resolve(self, rel: str, cls_name: str,
+                 call: ast.Call) -> tuple[str, str] | None:
+        """(display, reason) when the call resolves to a may-block
+        function; None otherwise."""
+        name = dotted(call.func)
+        if name is None:
+            return None
+        short = terminal(name)
+        if name.startswith("self.") and name.count(".") == 1 and cls_name:
+            reason = self._fns.get((rel, cls_name, short))
+            if reason is not None:
+                return f"{name}()", reason
+        elif short[:1].isupper():
+            reason = self._ctor_reason(short)
+            if reason is not None:
+                return f"{short}(...)", reason
+        elif "." not in name:
+            reason = self._fns.get((rel, "", name))
+            if reason is not None:
+                return f"{name}()", reason
+        return None
+
+    def blocks(self, src: Source, call: ast.Call) -> tuple[str, str] | None:
+        cls = src.enclosing(call, ast.ClassDef)
+        cls_name = cls.name if isinstance(cls, ast.ClassDef) else ""
+        return self._resolve(src.rel, cls_name, call)
+
+
+def _walk_no_defs(fn: ast.AST):
+    """Walk a function body without entering nested function/class
+    definitions (their bodies execute later, not on this call)."""
+    body = getattr(fn, "body", [])
+    todo: list[ast.AST] = list(body)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, _NO_DESCEND):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+# -- blocking-under-lock -----------------------------------------------------
+@check("blocking-under-lock",
+       "blocking I/O (RPC, store ops, sleep, file writes, joins) "
+       "executed while holding a lock")
+def blocking_under_lock(project: Project) -> list[Finding]:
+    summaries = _Summaries(project)
+    findings: list[Finding] = []
+    for src in project.sources:
+        for fn in [n for n in ast.walk(src.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            _scan_block(src, fn.body, {}, findings, summaries)
+    return findings
+
+
+def _scan_block(src: Source, stmts: list[ast.stmt],
+                held: dict[str, ast.AST], findings: list[Finding],
+                summaries: "_Summaries | None" = None) -> None:
+    held = dict(held)
+    for stmt in stmts:
+        if isinstance(stmt, _NO_DESCEND):
+            continue  # nested def/class bodies run later, not under this lock
+        ar = _acq_rel(stmt)
+        if ar is not None:
+            op, lock = ar
+            if op == "acquire":
+                held[lock] = stmt
+            else:
+                held.pop(lock, None)
+            continue
+        if held:
+            for node in _iter_exprs(stmt):
+                if isinstance(node, ast.Call):
+                    lock = next(reversed(held))
+                    reason = blocking_reason(node, held)
+                    if reason is not None:
+                        findings.append(Finding(
+                            check="blocking-under-lock", path=src.rel,
+                            line=node.lineno,
+                            message=f"`{reason}` called while holding "
+                                    f"`{lock}`",
+                            context=src.context_of(node)))
+                        continue
+                    via = summaries.blocks(src, node) if summaries else None
+                    if via is not None:
+                        display, inner = via
+                        findings.append(Finding(
+                            check="blocking-under-lock", path=src.rel,
+                            line=node.lineno,
+                            message=f"`{display}` may block (reaches "
+                                    f"`{inner}`) while holding `{lock}`",
+                            context=src.context_of(node)))
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = dict(held)
+            # acquisition-site waiver: a disable comment on the `with`
+            # line itself exempts everything scoped by THIS lock (for
+            # locks whose purpose IS scoping I/O — a tracer's file
+            # lock, a single-flight gate); outer locks still apply
+            if not src.disabled(stmt.lineno, "blocking-under-lock"):
+                for lock in _with_locks(stmt):
+                    inner[lock] = stmt
+            _scan_block(src, stmt.body, inner, findings, summaries)
+        elif isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            _scan_block(src, stmt.body, held, findings, summaries)
+            _scan_block(src, stmt.orelse, held, findings, summaries)
+        elif isinstance(stmt, ast.Try):
+            _scan_block(src, stmt.body, held, findings, summaries)
+            for h in stmt.handlers:
+                _scan_block(src, h.body, held, findings, summaries)
+            _scan_block(src, stmt.orelse, held, findings, summaries)
+            _scan_block(src, stmt.finalbody, held, findings, summaries)
+        elif isinstance(stmt, ast.Match):
+            for case in stmt.cases:
+                _scan_block(src, case.body, held, findings, summaries)
+        # nested defs: their bodies run later, outside this lock scope;
+        # blocking_under_lock visits every FunctionDef independently
+    return
+
+
+# -- lock-order --------------------------------------------------------------
+@check("lock-order",
+       "per-class lock-acquisition graph cycles (potential deadlocks), "
+       "including re-acquiring a non-reentrant lock via a self-call")
+def lock_order(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.sources:
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            findings.extend(_class_lock_order(src, cls))
+    return findings
+
+
+def _self_lock(name: str) -> str | None:
+    """Normalize ``self.X`` lock names to ``X``; others -> None (the
+    per-class graph only reasons about this instance's locks)."""
+    if name.startswith("self.") and name.count(".") == 1:
+        return name.split(".", 1)[1]
+    return None
+
+
+def _class_lock_order(src: Source, cls: ast.ClassDef) -> list[Finding]:
+    # lock kinds from `self.X = threading.Lock()/RLock()/Condition()`
+    reentrant: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func) or ""
+            if ctor.rsplit(".", 1)[-1] in ("RLock", "Condition"):
+                for t in node.targets:
+                    name = dotted(t)
+                    if name and name.startswith("self."):
+                        reentrant.add(name.split(".", 1)[1])
+
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    # per-method: (held_tuple, acquired_lock, site) + (held_tuple, callee, site)
+    acquires: dict[str, list[tuple[tuple[str, ...], str, ast.AST]]] = {}
+    calls: dict[str, list[tuple[tuple[str, ...], str, ast.AST]]] = {}
+
+    for mname, m in methods.items():
+        acq: list[tuple[tuple[str, ...], str, ast.AST]] = []
+        cal: list[tuple[tuple[str, ...], str, ast.AST]] = []
+        _order_walk(m.body, (), acq, cal, methods)
+        acquires[mname] = acq
+        calls[mname] = cal
+
+    # transitive closure: every lock a method may acquire
+    closure: dict[str, set[str]] = {
+        m: {lock for _h, lock, _s in acquires[m]} for m in methods}
+    changed = True
+    while changed:
+        changed = False
+        for m in methods:
+            for _held, callee, _site in calls[m]:
+                extra = closure.get(callee, set()) - closure[m]
+                if extra:
+                    closure[m] |= extra
+                    changed = True
+
+    # edges A -> B: B acquired (directly or via a self-call) while A held
+    edges: dict[tuple[str, str], tuple[ast.AST, str]] = {}
+    for m in methods:
+        for held, lock, site in acquires[m]:
+            for a in held:
+                edges.setdefault((a, lock), (site, m))
+        for held, callee, site in calls[m]:
+            for a in held:
+                for b in closure.get(callee, ()):
+                    edges.setdefault((a, b), (site, f"{m} -> self.{callee}()"))
+
+    findings: list[Finding] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+    graph: dict[str, set[str]] = {}
+    for (a, b), _ in edges.items():
+        graph.setdefault(a, set()).add(b)
+    # self-loops: re-acquiring a non-reentrant lock deadlocks instantly
+    for (a, b), (site, via) in sorted(edges.items(),
+                                      key=lambda kv: kv[1][0].lineno):
+        if a == b and a not in reentrant:
+            findings.append(Finding(
+                check="lock-order", path=src.rel, line=site.lineno,
+                message=f"non-reentrant `self.{a}` re-acquired while "
+                        f"already held (via {via})",
+                context=f"{cls.name}.{via.split(' ', 1)[0]}"))
+    # multi-lock cycles
+    for start in sorted(graph):
+        cycle = _find_cycle(graph, start)
+        if cycle is None:
+            continue
+        canon = tuple(sorted(set(cycle)))
+        if len(canon) < 2 or canon in seen_cycles:
+            continue
+        seen_cycles.add(canon)
+        a, b = cycle[0], cycle[1]
+        site, via = edges[(a, b)]
+        findings.append(Finding(
+            check="lock-order", path=src.rel, line=site.lineno,
+            message="lock-order cycle "
+                    + " -> ".join(f"self.{x}" for x in cycle + [cycle[0]])
+                    + " (potential deadlock)",
+            context=cls.name))
+    return findings
+
+
+def _order_walk(stmts, held: tuple[str, ...], acq, cal, methods) -> None:
+    for stmt in stmts:
+        for node in _iter_exprs(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and \
+                    node.func.attr in methods:
+                cal.append((held, node.func.attr, node))
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for name in _with_locks(stmt):
+                lock = _self_lock(name)
+                if lock is not None:
+                    acq.append((inner, lock, stmt))
+                    inner = inner + (lock,)
+            _order_walk(stmt.body, inner, acq, cal, methods)
+        elif isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            _order_walk(stmt.body, held, acq, cal, methods)
+            _order_walk(stmt.orelse, held, acq, cal, methods)
+        elif isinstance(stmt, ast.Try):
+            _order_walk(stmt.body, held, acq, cal, methods)
+            for h in stmt.handlers:
+                _order_walk(h.body, held, acq, cal, methods)
+            _order_walk(stmt.orelse, held, acq, cal, methods)
+            _order_walk(stmt.finalbody, held, acq, cal, methods)
+        elif isinstance(stmt, ast.Match):
+            for case in stmt.cases:
+                _order_walk(case.body, held, acq, cal, methods)
+
+
+def _find_cycle(graph: dict[str, set[str]], start: str) -> list[str] | None:
+    """First cycle reachable from ``start`` (DFS), as the node list."""
+    path: list[str] = []
+    on_path: set[str] = set()
+    visited: set[str] = set()
+
+    def dfs(node: str) -> list[str] | None:
+        if node in on_path:
+            return path[path.index(node):]
+        if node in visited:
+            return None
+        visited.add(node)
+        path.append(node)
+        on_path.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == node:
+                continue  # self-loops reported separately
+            found = dfs(nxt)
+            if found is not None:
+                return found
+        path.pop()
+        on_path.discard(node)
+        return None
+
+    return dfs(start)

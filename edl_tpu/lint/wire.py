@@ -1,0 +1,267 @@
+"""Wire-error hygiene: typed errors on the RPC surface, no silent swallows.
+
+Two rules under one check id (``wire-error``):
+
+1. **Handler raise typing** — any ``raise SomeError(...)`` reachable
+   from a function registered on the EDL1 RPC server (``register(...)``
+   / ``register_instance(...)`` in ``rpc/server.py`` terms) should be a
+   typed ``Edl*`` error from ``utils/exceptions.py``.  Anything else
+   crosses the wire as ``EdlInternalError`` with a full traceback in
+   the detail string — callers can't branch on it, retry policies can't
+   classify it, and the traceback leaks into client logs.  Reachability
+   is the registered function plus same-class ``self.*`` helpers and
+   same-module free functions, transitively (compositional, not
+   whole-program — the same altitude as the lock checks).
+
+2. **Silent swallows** — ``except Exception:`` / bare ``except:``
+   whose body neither logs nor re-raises (just ``pass``/``continue``/
+   constant return).  In the retry/failover paths (rpc/, coord/,
+   data/) a swallowed error becomes a hang: the caller waits on state
+   that the swallowed failure means will never arrive.  Intentional
+   best-effort swallows carry an inline waiver with their
+   justification; everything else must log.
+
+Handler discovery is two-pass: pass 1 walks the whole project for
+``register``/``register_instance`` call sites and resolves what they
+expose (method refs, ``self``, locally-constructed instances,
+instance attributes); pass 2 applies the raise rule to the resolved
+handler set — including classes registered from *another* module
+(e.g. the launcher registering ``StateCacheService``), matched by
+class name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from edl_tpu.lint.engine import Finding, Project, Source, check, dotted
+
+# raises that are fine on the wire: Edl* (typed), plus python-level
+# control flow that never reaches the serializer
+_ALLOWED_NON_EDL = {"StopIteration", "GeneratorExit", "KeyboardInterrupt"}
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+
+
+# -- pass 1: handler discovery ----------------------------------------------
+def _instance_attr_classes(cls: ast.ClassDef) -> dict[str, str]:
+    """``self.X = ClassName(...)`` assignments -> {attr: ClassName}."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor is None:
+                continue
+            for t in node.targets:
+                name = dotted(t)
+                if name and name.startswith("self.") and name.count(".") == 1:
+                    out[name.split(".", 1)[1]] = ctor.rsplit(".", 1)[-1]
+    return out
+
+
+def _local_var_classes(fn: ast.AST) -> dict[str, str]:
+    """``x = ClassName(...)`` local assignments -> {var: ClassName}."""
+    out: dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = ctor.rsplit(".", 1)[-1]
+    return out
+
+
+def collect_handlers(project: Project) -> tuple[set[tuple[str, str, str]],
+                                                set[str]]:
+    """Scan every ``register``/``register_instance`` call site.
+
+    Returns ``(direct, classes)``:
+    - ``direct``: {(src_rel, class_name_or_"", func_name)} for functions
+      registered by reference in the same module;
+    - ``classes``: class NAMES whose instances are registered anywhere
+      (their public methods are wire surface wherever they're defined).
+    """
+    direct: set[tuple[str, str, str]] = set()
+    classes: set[str] = set()
+    for src in project.sources:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr == "register" and len(node.args) >= 2:
+                target = node.args[1]
+                tname = dotted(target)
+                if tname is None:
+                    continue
+                encl_cls = src.enclosing(target, ast.ClassDef)
+                if tname.startswith("self.") and tname.count(".") == 1:
+                    if isinstance(encl_cls, ast.ClassDef):
+                        direct.add((src.rel, encl_cls.name,
+                                    tname.split(".", 1)[1]))
+                elif tname.startswith("self.") and tname.count(".") == 2:
+                    # self.attr.method — resolve attr's class by ctor
+                    _, attr, meth = tname.split(".")
+                    if isinstance(encl_cls, ast.ClassDef):
+                        cls_name = _instance_attr_classes(encl_cls).get(attr)
+                        if cls_name:
+                            direct.add(("*", cls_name, meth))
+                elif "." not in tname:
+                    direct.add((src.rel, "", tname))
+            elif node.func.attr == "register_instance" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id == "self":
+                    encl_cls = src.enclosing(arg, ast.ClassDef)
+                    if isinstance(encl_cls, ast.ClassDef):
+                        classes.add(encl_cls.name)
+                elif isinstance(arg, ast.Call):
+                    ctor = dotted(arg.func)
+                    if ctor:
+                        classes.add(ctor.rsplit(".", 1)[-1])
+                elif isinstance(arg, ast.Name):
+                    fn = src.enclosing(arg, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))
+                    if fn is not None:
+                        cls_name = _local_var_classes(fn).get(arg.id)
+                        if cls_name:
+                            classes.add(cls_name)
+                else:
+                    name = dotted(arg)
+                    if name and name.startswith("self."):
+                        encl_cls = src.enclosing(arg, ast.ClassDef)
+                        if isinstance(encl_cls, ast.ClassDef):
+                            cls_name = _instance_attr_classes(
+                                encl_cls).get(name.split(".", 1)[1])
+                            if cls_name:
+                                classes.add(cls_name)
+    return direct, classes
+
+
+# -- pass 2: raise reachability ---------------------------------------------
+def _raise_findings(src: Source, entry: ast.AST, cls: ast.ClassDef | None,
+                    entry_label: str, seen_sites: set) -> list[Finding]:
+    """Raise-rule findings for one handler entry point, following
+    same-class ``self.*`` and same-module free-function calls."""
+    methods = ({n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+               if cls is not None else {})
+    module_fns = {n.name: n for n in src.tree.body
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    findings: list[Finding] = []
+    todo: list[ast.AST] = [entry]
+    visited: set[ast.AST] = set()
+    while todo:
+        fn = todo.pop()
+        if fn in visited:
+            continue
+        visited.add(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Raise):
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call):
+                    name = dotted(exc.func)
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name is None:
+                    continue  # bare re-raise or dynamic — fine
+                short = name.rsplit(".", 1)[-1]
+                if short.startswith("Edl") or short in _ALLOWED_NON_EDL \
+                        or not short[:1].isupper():
+                    continue
+                site = (src.rel, node.lineno, short)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                findings.append(Finding(
+                    check="wire-error", path=src.rel, line=node.lineno,
+                    message=f"`raise {short}` reachable from RPC handler "
+                            f"`{entry_label}` crosses the wire untyped "
+                            "(becomes EdlInternalError + traceback)",
+                    context=src.context_of(node)))
+            elif isinstance(node, ast.Call):
+                callee = dotted(node.func)
+                if callee is None:
+                    continue
+                if callee.startswith("self.") and callee.count(".") == 1:
+                    m = methods.get(callee.split(".", 1)[1])
+                    if m is not None:
+                        todo.append(m)
+                elif "." not in callee and callee in module_fns:
+                    todo.append(module_fns[callee])
+    return findings
+
+
+def _swallow_findings(src: Source) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if _body_handles(node.body):
+            continue
+        caught = "bare except" if node.type is None else "except Exception"
+        findings.append(Finding(
+            check="wire-error", path=src.rel, line=node.lineno,
+            message=f"`{caught}` swallows silently (no log, no re-raise)",
+            context=src.context_of(node)))
+    return findings
+
+
+def _is_broad(t: ast.expr | None) -> bool:
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(e) for e in t.elts)
+    return False
+
+
+def _body_handles(body: list[ast.stmt]) -> bool:
+    """True when the handler body does anything beyond swallowing:
+    logs, re-raises, or runs real recovery statements."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None or isinstance(stmt.value, ast.Constant)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # stray docstring/ellipsis
+        return True  # raise, log call, assignment, cleanup — handled
+    return False
+
+
+@check("wire-error",
+       "untyped raises reachable from RPC handlers, and broad excepts "
+       "that swallow errors silently")
+def wire_error(project: Project) -> list[Finding]:
+    direct, classes = collect_handlers(project)
+    findings: list[Finding] = []
+    seen_sites: set = set()
+    for src in project.sources:
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            for m in methods:
+                registered = (
+                    (src.rel, cls.name, m.name) in direct
+                    or ("*", cls.name, m.name) in direct
+                    or (cls.name in classes and not m.name.startswith("_")))
+                if registered:
+                    findings.extend(_raise_findings(
+                        src, m, cls, f"{cls.name}.{m.name}", seen_sites))
+        for name in [n for n in src.tree.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            if (src.rel, "", name.name) in direct:
+                findings.extend(_raise_findings(
+                    src, name, None, name.name, seen_sites))
+        findings.extend(_swallow_findings(src))
+    return findings

@@ -433,7 +433,9 @@ def _fetch_meta(meta_holders, pools) -> str | None:
             continue
         try:
             raw = pool.call("cache_meta", owner=owner)
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            logger.debug("cache_meta from %s for %s failed (%s); trying "
+                         "the next holder", pod[:8], owner[:8], e)
             continue
         if raw:
             return bytes(raw).decode()

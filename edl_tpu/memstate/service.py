@@ -328,8 +328,9 @@ class StateCacheService:
                     listing = pool.call("cache_manifest").get(owner)
                     if listing and listing["step"] == step:
                         theirs = listing["shards"]
-                except Exception:  # noqa: BLE001 — treat as cold target
-                    pass
+                except Exception as e:  # noqa: BLE001 — treat as cold target
+                    logger.debug("manifest probe of %s failed (%s); "
+                                 "shipping the full set", target[:8], e)
                 todo = {k: v for k, v in shards.items()
                         if k not in theirs
                         or theirs[k].get("crc") != manifest[k]["crc"]}

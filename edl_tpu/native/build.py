@@ -53,6 +53,8 @@ def ensure_built() -> ctypes.CDLL | None:
     """Compile (if stale) and dlopen the native library; None if the
     toolchain or sources are unavailable."""
     global _lib, _failed
+    # edl-lint: disable=blocking-under-lock — once-only build gate:
+    # serializing the compile subprocess is this lock's whole purpose
     with _lock:
         if _lib is not None or _failed:
             return _lib
@@ -116,6 +118,8 @@ def ensure_coordd() -> str | None:
     out = os.path.join(_ROOT, "build", "coordd")
     if not os.path.exists(src):
         return None
+    # edl-lint: disable=blocking-under-lock — same build gate: one
+    # compile at a time is the point
     with _lock:
         try:
             if (not os.path.exists(out)

@@ -202,6 +202,13 @@ class Aggregator:
                                                job_id),
             trace_provider=self._job_trace_id)
         self._lock = threading.Lock()
+        # single-flight gate for the scrape fan-out: collect() holds it
+        # across the network I/O so concurrent callers coalesce onto one
+        # scrape, while _lock only ever guards in-memory cache state
+        # (edl-lint blocking-under-lock found the fan-out running under
+        # _lock itself — every /healthz and trace lookup stalled behind
+        # a full scrape timeout)
+        self._collect_gate = threading.Lock()
         self._cached: tuple[float, str, dict] | None = None
         # summarize_recovery hits the coord store; /healthz must not
         # stall on a slow store even when collect() is cache-fresh
@@ -267,19 +274,36 @@ class Aggregator:
                 rec = advert.current_job_trace(self.store, self.job_id)
             if rec:
                 tid = rec.get("trace_id")
-        except Exception:  # noqa: BLE001 — store blip must not stop alerting
-            pass
+        except Exception as e:  # noqa: BLE001 — store blip must not stop alerting
+            logger.debug("job-trace lookup failed: %s", e)
         with self._lock:
             self._trace_cache = (time.monotonic(), tid)
         return tid
 
+    def _cache_fresh(self) -> tuple[str, dict] | None:
+        with self._lock:
+            cached = self._cached
+        if cached is not None and time.monotonic() - cached[0] < self.cache_s:
+            return cached[1], cached[2]
+        return None
+
     def collect(self) -> tuple[str, dict]:
         """(merged exposition text, info dict) — info carries targets,
-        per-target errors, and scrape counts for /healthz."""
-        with self._lock:
-            if (self._cached is not None
-                    and time.monotonic() - self._cached[0] < self.cache_s):
-                return self._cached[1], self._cached[2]
+        per-target errors, and scrape counts for /healthz.
+
+        The network fan-out runs under ``_collect_gate`` only (single
+        flight: a caller that waited re-checks the cache the winner
+        refreshed), never under ``_lock`` — so /healthz and trace
+        lookups can't stall behind a scrape timeout."""
+        fresh = self._cache_fresh()
+        if fresh is not None:
+            return fresh
+        # edl-lint: disable=blocking-under-lock — single-flight gate:
+        # scoping the fan-out I/O is this lock's whole purpose
+        with self._collect_gate:
+            fresh = self._cache_fresh()
+            if fresh is not None:
+                return fresh  # the previous holder scraped for us
             t0 = time.perf_counter()
             targets = advert.list_metrics_targets(self.store, self.job_id)
             _TARGETS_G.set(len(targets))
@@ -326,7 +350,8 @@ class Aggregator:
             merged = merge_expositions(pages)
             info = {"targets": targets, "scraped": scraped, "errors": errors}
             _COLLECT_SECONDS.observe(time.perf_counter() - t0)
-            self._cached = (time.monotonic(), merged, info)
+            with self._lock:
+                self._cached = (time.monotonic(), merged, info)
             return merged, info
 
     def _recovery_summary(self):

@@ -317,6 +317,10 @@ class IncidentLog:
         wrote = False
         if self.path:
             try:
+                # edl-lint: disable=blocking-under-lock — the incident
+                # log's file lock: serializing the append is its whole
+                # purpose (RuleEngine already writes records OUTSIDE
+                # its own evaluation lock — the PR 8 review fix)
                 with self._lock:
                     os.makedirs(self.dir, exist_ok=True)
                     with open(self.path, "a", encoding="utf-8") as f:
@@ -365,7 +369,8 @@ class RuleEngine:
             return None
         try:
             return self._trace_provider()
-        except Exception:  # noqa: BLE001 — a store blip must not stop alerting
+        except Exception as e:  # noqa: BLE001 — a store blip must not stop alerting
+            logger.debug("incident trace lookup failed: %s", e)
             return None
 
     def _incident(self, state: str, rule: Rule, group: str,

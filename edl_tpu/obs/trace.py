@@ -117,6 +117,9 @@ class Tracer:
             if ctx.parent_id is not None:
                 rec.setdefault("parent_id", ctx.parent_id)
         line = json.dumps(rec) + "\n"
+        # edl-lint: disable=blocking-under-lock — the tracer's file
+        # lock: serializing the JSONL append is its whole purpose, and
+        # nothing but emit()/rotate contends on it
         with self._lock:
             if self._f is None:
                 _DROPPED_TOTAL.labels(reason="rotate").inc()
@@ -210,6 +213,8 @@ def install(tracer: NullTracer | Tracer) -> NullTracer | Tracer:
 def configure(path: str, component: str = "") -> Tracer:
     """Install a process-wide tracer writing to ``path``."""
     global _tracer
+    # edl-lint: disable=blocking-under-lock — once-only install gate:
+    # opening the trace file under it is the point
     with _lock:
         if isinstance(_tracer, Tracer):
             _tracer.close()

@@ -219,8 +219,9 @@ class ReplicaServer:
             return self._drained.wait(timeout)
         try:
             self._register.update(json.dumps(self._payload()).encode())
-        except Exception:  # noqa: BLE001 — advert refresh is best-effort
-            pass
+        except Exception as e:  # noqa: BLE001 — advert refresh is best-effort
+            logger.debug("draining-advert refresh failed (%s); the lease "
+                         "expires the stale advert", e)
         ok = self._engine.drain(timeout)
         self._halt.set()
         self._register.stop()

@@ -55,6 +55,8 @@ def _enable_cpu_collectives() -> None:
         try:
             jax.config.update(*update)
             return
+        # edl-lint: disable=wire-error — version probe over candidate
+        # knob names; total failure is warned right below the loop
         except Exception:  # noqa: BLE001 — knob absent in this version
             continue
     logger.warning("no CPU collectives knob in this jax; multi-process "
@@ -107,8 +109,9 @@ def initialize_from_env(tenv: TrainerEnv | None = None) -> TrainerEnv:
                     "retrying", attempt, retries, e)
                 try:
                     jax.distributed.shutdown()
-                except Exception:  # noqa: BLE001 — partial init state
-                    pass
+                except Exception as down_err:  # noqa: BLE001 — partial init
+                    logger.debug("shutdown of partial distributed init "
+                                 "failed: %s", down_err)
                 import time
                 time.sleep(2.0 * attempt)
         _initialized = True

@@ -683,6 +683,8 @@ class ElasticTrainer:
         for h in _logging.getLogger().handlers:
             try:
                 h.flush()
+            # edl-lint: disable=wire-error — last-gasp flush before
+            # os._exit; logging about a failed log flush cannot work
             except Exception:  # noqa: BLE001
                 pass
         _sys.stdout.flush()
@@ -808,6 +810,8 @@ def _map_params_like(opt_state, params, fn):
                 return False
             return [getattr(l, "shape", None)
                     for l in jax.tree.leaves(x)] == pshapes
+        # edl-lint: disable=wire-error — structural probe: False is
+        # the answer for "not params-shaped", not a swallowed error
         except Exception:  # noqa: BLE001 — non-pytree nodes
             return False
 

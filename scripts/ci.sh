@@ -9,6 +9,15 @@ cd "$(dirname "$0")/.."
 python -m pip install -e ".[image,test]" \
     || python -m pip install -e . --no-deps --no-build-isolation
 
+# static-analysis gate (edl-lint, doc/lint.md): project-aware AST
+# checks for the defect classes PRs 6-8 kept re-finding by hand —
+# blocking I/O under service locks, lock-order cycles, untyped errors
+# on the RPC wire, wall-clock deadlines, untracked threads, knob- and
+# metric-catalog drift.  Fails on any NEW finding or any STALE waiver
+# against the committed lint_baseline.json (the baseline only ratchets
+# down); runs before the test tiers because it is seconds, not minutes
+python -m edl_tpu.lint --root .
+
 # fast tier: everything but the multi-process e2e tests
 python -m pytest tests/ -q -m "not slow"
 
@@ -105,6 +114,7 @@ assert out['obs_scrape_overhead_pct'] < 5, out['obs_scrape_overhead_pct']
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve
+edl-lint --help >/dev/null 2>&1 || { echo "edl-lint missing"; exit 1; }
 edl-coord --help >/dev/null 2>&1 || { echo "edl-coord missing"; exit 1; }
 edl-launch --help >/dev/null 2>&1 || { echo "edl-launch missing"; exit 1; }
 edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1; }
@@ -116,7 +126,8 @@ edl-replica --help >/dev/null 2>&1 || { echo "edl-replica missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
 for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
-           edl-obs-dump edl-obs-agg edl-obs-top edl-gateway edl-replica; do
+           edl-obs-dump edl-obs-agg edl-obs-top edl-gateway edl-replica \
+           edl-lint; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

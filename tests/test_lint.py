@@ -1,0 +1,683 @@
+"""edl-lint: fixture-driven check tests + baseline ratchet + repo smoke.
+
+One minimal positive and negative fixture per check (the contract
+doc/lint.md promises), the ratchet semantics (new finding fails, waived
+finding passes, fixed finding flags the stale waiver), and a smoke run
+over the real package asserting zero non-baselined findings — the same
+gate scripts/ci.sh runs.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from edl_tpu.lint import baseline as baseline_mod
+from edl_tpu.lint import engine
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path: Path, files: dict[str, str],
+                 docs: dict[str, str] | None = None) -> Path:
+    """Write a throwaway mini-package under ``tmp_path/edl_tpu``."""
+    for rel, text in files.items():
+        p = tmp_path / "edl_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    for rel, text in (docs or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    return tmp_path
+
+
+def run_checks(root: Path, *checks: str) -> list[engine.Finding]:
+    return engine.run(root, checks=list(checks))
+
+
+# -- blocking-under-lock -----------------------------------------------------
+def test_blocking_under_lock_positive(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def bad_rpc(self, client):
+                with self._lock:
+                    client.call("op")
+
+            def bad_acquire_span(self, client):
+                self._lock.acquire()
+                client.call("op")
+                self._lock.release()
+    """})
+    found = run_checks(root, "blocking-under-lock")
+    msgs = [f.message for f in found]
+    assert len(found) == 3, msgs
+    assert any("time.sleep" in m for m in msgs)
+    assert sum("client.call" in m for m in msgs) == 2
+
+
+def test_blocking_under_lock_negative(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def snapshot_then_call(self, client):
+                with self._lock:
+                    payload = dict(x=1)
+                time.sleep(0.1)          # outside: fine
+                client.call("op", **payload)
+
+            def after_release(self, client):
+                self._lock.acquire()
+                self._lock.release()
+                client.call("op")
+
+            def cond_wait_is_fine(self):
+                with self._cond:
+                    self._cond.wait(0.1)  # releases the lock: idiomatic
+
+            def nested_def_runs_later(self):
+                with self._lock:
+                    def gen():
+                        time.sleep(1.0)   # executes OUTSIDE the lock
+                    return gen
+    """})
+    assert run_checks(root, "blocking-under-lock") == []
+
+
+def test_blocking_under_lock_transitive_ctor(tmp_path):
+    # the BalanceTable.service() bug shape: a constructor that does
+    # store I/O, called while holding the table lock
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class Watcher:
+            def __init__(self, store):
+                self._recs = store.get_prefix("/x")
+
+        class Table:
+            def __init__(self, store):
+                self._lock = threading.Lock()
+                self._store = store
+                self._w = None
+
+            def bad(self):
+                with self._lock:
+                    self._w = Watcher(self._store)
+
+            def helper(self):
+                self._store.put("/k", b"v")
+
+            def bad_self_call(self):
+                with self._lock:
+                    self.helper()
+    """})
+    found = run_checks(root, "blocking-under-lock")
+    assert len(found) == 2, [f.message for f in found]
+    assert any("Watcher(...)" in f.message and "get_prefix" in f.message
+               for f in found)
+    assert any("self.helper()" in f.message and "put" in f.message
+               for f in found)
+
+
+def test_blocking_under_lock_inline_waiver(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class FileLog:
+            def __init__(self, f):
+                self._lock = threading.Lock()
+                self._f = f
+
+            def emit(self, line):
+                # edl-lint: disable=blocking-under-lock — file lock:
+                # serializing this write is the lock's purpose
+                with self._lock:
+                    self._f.write(line)
+    """})
+    assert run_checks(root, "blocking-under-lock") == []
+
+
+# -- lock-order --------------------------------------------------------------
+def test_lock_order_cycle_positive(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """})
+    found = run_checks(root, "lock-order")
+    assert len(found) == 1, [f.message for f in found]
+    assert "cycle" in found[0].message
+
+
+def test_lock_order_reacquire_via_self_call(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class Bad:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+
+        class FineRLock:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """})
+    found = run_checks(root, "lock-order")
+    assert len(found) == 1, [f.message for f in found]
+    assert "non-reentrant" in found[0].message
+    assert found[0].context.startswith("Bad.")
+
+
+def test_lock_order_consistent_negative(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def g(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 2
+    """})
+    assert run_checks(root, "lock-order") == []
+
+
+# -- wire-error --------------------------------------------------------------
+def test_wire_error_handler_raise_positive(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        class Service:
+            def __init__(self, server):
+                server.register("op", self._op)
+
+            def _op(self):
+                self._validate()
+
+            def _validate(self):
+                raise ValueError("untyped across the wire")
+    """})
+    found = [f for f in run_checks(root, "wire-error")
+             if "raise" in f.message]
+    assert len(found) == 1
+    assert "ValueError" in found[0].message
+    assert "Service._op" in found[0].message
+
+
+def test_wire_error_register_instance_cross_module(tmp_path):
+    # class registered in ANOTHER module: its public methods are wire
+    # surface; private helpers only through reachability
+    root = make_project(tmp_path, {
+        "cache.py": """
+            class CacheService:
+                def cache_get(self, key):
+                    raise KeyError(key)
+
+                def _internal(self):
+                    raise RuntimeError("not wire surface by itself")
+        """,
+        "wiring.py": """
+            from edl_tpu.cache import CacheService
+
+            def wire(server, store):
+                svc = CacheService()
+                server.register_instance(svc)
+        """})
+    found = [f for f in run_checks(root, "wire-error")
+             if "raise" in f.message]
+    assert len(found) == 1, [f.message for f in found]
+    assert "KeyError" in found[0].message
+
+
+def test_wire_error_typed_raise_negative(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        from edl_tpu.utils.exceptions import EdlDataError
+
+        class Service:
+            def __init__(self, server):
+                server.register("op", self._op)
+
+            def _op(self):
+                raise EdlDataError("typed: crosses the wire as itself")
+    """})
+    assert [f for f in run_checks(root, "wire-error")
+            if "raise" in f.message] == []
+
+
+def test_wire_error_swallow(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import logging
+
+        def swallows():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def logs():
+            try:
+                risky()
+            except Exception:
+                logging.getLogger(__name__).warning("risky failed")
+
+        def reraises():
+            try:
+                risky()
+            except Exception:
+                raise
+
+        def narrow_is_fine():
+            try:
+                risky()
+            except OSError:
+                pass
+    """})
+    found = [f for f in run_checks(root, "wire-error")
+             if "swallows" in f.message]
+    assert len(found) == 1
+    assert found[0].context == "swallows"
+
+
+# -- clock -------------------------------------------------------------------
+def test_clock_positive(tmp_path):
+    root = make_project(tmp_path, {
+        "svc.py": """
+            import time
+
+            def bad_deadline(t0):
+                return time.time() - t0
+
+            def bad_compare(deadline):
+                return time.time() > deadline
+        """,
+        "coord/wal.py": """
+            from datetime import datetime
+
+            def bad_now():
+                return datetime.now()
+        """})
+    found = run_checks(root, "clock")
+    assert len(found) == 3, [f.message for f in found]
+    assert any("replay" in f.message for f in found)
+
+
+def test_clock_negative(tmp_path):
+    root = make_project(tmp_path, {
+        "svc.py": """
+            import time
+            from datetime import datetime, timezone
+
+            def timestamp_is_fine():
+                return {"ts": time.time()}
+
+            def monotonic_is_fine(t0):
+                return time.monotonic() - t0
+        """,
+        "coord/wal.py": """
+            from datetime import datetime, timezone
+
+            def tz_aware_is_fine():
+                return datetime.now(timezone.utc)
+        """})
+    assert run_checks(root, "clock") == []
+
+
+# -- thread-hygiene ----------------------------------------------------------
+def test_thread_hygiene_positive(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn).start()
+    """})
+    found = run_checks(root, "thread-hygiene")
+    assert len(found) == 1
+    assert "daemon" in found[0].message
+
+
+def test_thread_hygiene_negative(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import threading
+
+        class S:
+            def start(self, fn):
+                self._t = threading.Thread(target=fn)   # joined in stop()
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=5.0)
+
+        def daemonized(fn):
+            threading.Thread(target=fn, daemon=True).start()
+
+        def local_joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """})
+    assert run_checks(root, "thread-hygiene") == []
+
+
+# -- knob-drift --------------------------------------------------------------
+_KNOB_DOCS = {"README.md": "# x\n", "doc/usage.md": """
+    `EDL_TPU_DOCUMENTED` is a knob.  The `EDL_TPU_FAMILY_*` knobs are
+    a documented family.  `EDL_TPU_GONE` no longer exists.
+"""}
+
+
+def test_knob_drift(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        import os
+
+        A = os.environ.get("EDL_TPU_DOCUMENTED", "")
+        B = os.environ.get("EDL_TPU_UNDOCUMENTED", "")
+        C = os.environ.get("EDL_TPU_FAMILY_MEMBER", "")
+    """}, docs=_KNOB_DOCS)
+    found = run_checks(root, "knob-drift")
+    assert len(found) == 2, [f.message for f in found]
+    undoc = [f for f in found if "EDL_TPU_UNDOCUMENTED" in f.message]
+    stale = [f for f in found if "EDL_TPU_GONE" in f.message]
+    assert undoc and undoc[0].path == "edl_tpu/svc.py"
+    assert stale and stale[0].path == "doc/usage.md"
+
+
+def test_knob_drift_docstring_mention_not_a_read(tmp_path):
+    root = make_project(tmp_path, {"svc.py": '''
+        """This docstring explains `EDL_TPU_ONLY_IN_DOCSTRING` history."""
+        import os
+        A = os.environ.get("EDL_TPU_DOCUMENTED", "")
+    '''}, docs=_KNOB_DOCS)
+    found = run_checks(root, "knob-drift")
+    assert [f for f in found if "ONLY_IN_DOCSTRING" in f.message] == []
+
+
+# -- metric-drift ------------------------------------------------------------
+def test_metric_drift(tmp_path):
+    root = make_project(tmp_path, {"svc.py": """
+        from edl_tpu.obs import metrics as obs_metrics
+
+        _A = obs_metrics.counter("edl_documented_total", "doc'd")
+        _B = obs_metrics.gauge("edl_undocumented_bytes", "not doc'd")
+        _H = obs_metrics.histogram("edl_latency_seconds", "doc'd by suffix")
+    """}, docs={"doc/observability.md": """
+        | `edl_documented_total` | counter |
+        | `edl_latency_seconds_bucket` | histogram series |
+        | `edl_vanished_total` | counter |
+    """})
+    found = run_checks(root, "metric-drift")
+    assert len(found) == 2, [f.message for f in found]
+    assert any("edl_undocumented_bytes" in f.message
+               and f.path == "edl_tpu/svc.py" for f in found)
+    assert any("edl_vanished_total" in f.message
+               and f.path == "doc/observability.md" for f in found)
+
+
+# -- baseline ratchet --------------------------------------------------------
+_RATCHET_SRC = """
+    import threading, time
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0)
+"""
+
+
+def test_baseline_waives_and_ratchets(tmp_path):
+    root = make_project(tmp_path, {"svc.py": _RATCHET_SRC})
+    found = run_checks(root, "blocking-under-lock")
+    assert len(found) == 1
+    bl = tmp_path / "lint_baseline.json"
+    baseline_mod.save(bl, found)
+
+    # waived finding passes
+    new, stale, waived = baseline_mod.compare(
+        run_checks(root, "blocking-under-lock"), baseline_mod.load(bl))
+    assert not new and not stale and len(waived) == 1
+
+    # a SECOND instance of the same defect in the same function is NEW
+    # (occurrence index), even though the first is waived
+    (tmp_path / "edl_tpu" / "svc.py").write_text(textwrap.dedent("""
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+                    time.sleep(2.0)
+    """), encoding="utf-8")
+    new, stale, waived = baseline_mod.compare(
+        run_checks(root, "blocking-under-lock"), baseline_mod.load(bl))
+    assert len(new) == 1 and len(waived) == 1 and not stale
+    assert new[0][0].endswith("#1")
+
+    # fixing the defect turns the waiver STALE — the ratchet only
+    # tightens: the key must be removed, it can't silently linger
+    (tmp_path / "edl_tpu" / "svc.py").write_text(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                return None
+    """), encoding="utf-8")
+    new, stale, waived = baseline_mod.compare(
+        run_checks(root, "blocking-under-lock"), baseline_mod.load(bl))
+    assert not new and not waived and len(stale) == 1
+
+
+def test_baseline_keys_are_line_free(tmp_path):
+    root = make_project(tmp_path, {"svc.py": _RATCHET_SRC})
+    bl = tmp_path / "lint_baseline.json"
+    baseline_mod.save(bl, run_checks(root, "blocking-under-lock"))
+    # shift the finding by 30 lines: the waiver must still match
+    src = (tmp_path / "edl_tpu" / "svc.py").read_text(encoding="utf-8")
+    (tmp_path / "edl_tpu" / "svc.py").write_text(
+        "# pad\n" * 30 + src, encoding="utf-8")
+    new, stale, waived = baseline_mod.compare(
+        run_checks(root, "blocking-under-lock"), baseline_mod.load(bl))
+    assert not new and not stale and len(waived) == 1
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from edl_tpu.lint.cli import main
+
+    root = make_project(tmp_path, {"svc.py": _RATCHET_SRC})
+    # no baseline file: the finding is new -> fail
+    assert main(["--root", str(root),
+                 "--checks", "blocking-under-lock"]) == 1
+    assert main(["--root", str(root), "--checks", "blocking-under-lock",
+                 "--update-baseline"]) == 0
+    assert main(["--root", str(root),
+                 "--checks", "blocking-under-lock"]) == 0
+    capsys.readouterr()  # drop text output; --json shape checked next
+    assert main(["--root", str(root), "--checks", "blocking-under-lock",
+                 "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True and len(payload["waived"]) == 1
+    assert main(["--root", str(root), "--checks", "bogus-check"]) == 2
+
+
+def test_cli_reintroduced_fixed_pattern_fails(tmp_path):
+    """The acceptance drill: a known-fixed blocking-under-lock shape
+    (a coord put inside a generation/service lock) re-introduced into a
+    clean tree makes the gate exit non-zero."""
+    from edl_tpu.lint.cli import main
+
+    root = make_project(tmp_path, {"data_server.py": """
+        import threading
+
+        class DataService:
+            def __init__(self, store):
+                self._gen_lock = threading.Lock()
+                self._store = store
+
+            def report(self, key, val):
+                with self._gen_lock:
+                    self._store.put(key, val)
+    """})
+    (root / "lint_baseline.json").write_text(
+        json.dumps({"version": 1, "waivers": {}}), encoding="utf-8")
+    assert main(["--root", str(root),
+                 "--checks", "blocking-under-lock"]) == 1
+
+
+def test_blocking_under_lock_inside_match(tmp_path):
+    # review regression: match-case bodies are lock-scoped too
+    root = make_project(tmp_path, {"svc.py": """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dispatch(self, cmd, client):
+                match cmd:
+                    case "bad":
+                        with self._lock:
+                            client.call("op")
+                    case "nested":
+                        with self._lock:
+                            match cmd:
+                                case _:
+                                    time.sleep(1.0)
+    """})
+    found = run_checks(root, "blocking-under-lock")
+    assert len(found) == 2, [f.message for f in found]
+
+
+def test_partial_update_baseline_preserves_other_checks(tmp_path):
+    """Review regression: `--checks X --update-baseline` must not drop
+    the other checks' waivers from the grandfather list."""
+    from edl_tpu.lint.cli import main
+
+    root = make_project(tmp_path, {"svc.py": """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+        def swallows():
+            try:
+                bad()
+            except Exception:
+                pass
+    """}, docs={"doc/observability.md": "# empty catalog\n"})
+    assert main(["--root", str(root), "--update-baseline"]) == 0
+    bl = baseline_mod.load(root / baseline_mod.BASELINE_NAME)
+    assert set(bl) == {"blocking-under-lock", "wire-error"}
+    # partial rewrite of ONE check keeps the other's waivers
+    assert main(["--root", str(root), "--checks", "wire-error",
+                 "--update-baseline"]) == 0
+    bl2 = baseline_mod.load(root / baseline_mod.BASELINE_NAME)
+    assert bl2 == bl
+    # and the full gate still passes afterwards
+    assert main(["--root", str(root)]) == 0
+
+
+def test_check_registration_without_doc():
+    from edl_tpu.lint.engine import CHECKS, CHECK_DOC, check
+
+    @check("dummy-docless")
+    def dummy(project):
+        return []
+
+    try:
+        assert CHECK_DOC["dummy-docless"] == "dummy-docless"
+        assert engine.check_ids()[-1] == "dummy-docless"
+    finally:
+        CHECKS.pop("dummy-docless", None)
+        CHECK_DOC.pop("dummy-docless", None)
+
+
+# -- smoke over the real repo ------------------------------------------------
+def test_repo_lint_clean_against_baseline():
+    """The CI gate, as a test: zero non-baselined findings and zero
+    stale waivers over the real package with the committed baseline."""
+    findings = engine.run(REPO_ROOT)
+    waivers = baseline_mod.load(REPO_ROOT / baseline_mod.BASELINE_NAME)
+    new, stale, _waived = baseline_mod.compare(findings, waivers)
+    assert not new, "new lint findings:\n" + "\n".join(
+        f.render() for _k, f in new)
+    assert not stale, f"stale waivers (fixed findings — remove): {stale}"
+
+
+def test_repo_knob_and_metric_catalogs_green():
+    """Satellite contract: the drift checks pass with NO waivers."""
+    findings = engine.run(REPO_ROOT, checks=["knob-drift", "metric-drift"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_list_checks_names_all_seven():
+    ids = engine.check_ids()
+    assert ids == ["blocking-under-lock", "lock-order", "wire-error",
+                   "clock", "thread-hygiene", "knob-drift", "metric-drift"]
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))
