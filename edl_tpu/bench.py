@@ -336,6 +336,18 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- data delivery: streamed vs per-batch input throughput (ISSUE 11) ----
+    # 2 producers + 1 consumer over loopback: framed get_batch_stream
+    # groups + multi-worker prefetch vs the legacy per-batch RPC, the
+    # consumed-vs-delivered stall split, and the rebalance price of a
+    # producer lost mid-epoch — every run exactly-once audited
+    if os.environ.get("EDL_TPU_BENCH_DELIVERY", "1") != "0":
+        try:
+            out.update(_bench_data_delivery())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     # -- alerting loop: detection latency + scrape-loop overhead (ISSUE 9) ---
     # stall a synthetic trainer target and measure how long the
     # aggregator's built-in trainer-hang rule takes to fire, plus what
@@ -530,12 +542,14 @@ def _bench_data_outage() -> dict:
     killed = threading.Event()
     srv2 = None
     try:
-        # meta_prefetch=1: every batch costs one leader round trip, so
-        # the first post-kill batch really measures reattach + rebuild
+        # meta_prefetch=1 + prefetch_depth=1: every batch costs one
+        # leader round trip and nothing buffers ahead, so the first
+        # post-kill batch really measures reattach + rebuild (a deeper
+        # prefetch would serve buffered batches and read MTTR ~0)
         reader = DistributedReader("bench@e0", "bench-pod",
                                    lambda: endpoint["ep"], cache,
                                    batch_size=8, retry_deadline=60.0,
-                                   meta_prefetch=1)
+                                   meta_prefetch=1, prefetch_depth=1)
         reader.create(files)
         it = iter(reader)
         kill_after = (n_files * per_file) // (8 * 3)  # ~1/3 of the epoch
@@ -572,6 +586,173 @@ def _bench_data_outage() -> dict:
                 except Exception:  # noqa: BLE001 — teardown
                     pass
         kv.close()
+
+
+def _bench_data_delivery() -> dict:
+    """Streamed batch-delivery microbench (ISSUE 11): 2 producer pods
+    + 1 consumer over loopback, one full epoch drained four ways.
+    Reported:
+
+    - ``data_delivery_samples_s`` — records/s the consumer drains over
+      the STREAMED path (framed ``get_batch_stream`` groups + the
+      multi-worker prefetcher);
+    - ``data_delivery_rpc_samples_s`` — the same epoch over the legacy
+      one-batch-per-RPC path (what every old peer demotes to);
+    - ``data_delivery_consumed_samples_s`` — streamed delivery feeding
+      a consumer that "trains" for a fixed per-batch step time — the
+      delivered-vs-consumed split, with
+      ``data_delivery_consumed_stall_s`` saying how long the consumer
+      actually waited on input (~0 = the prefetcher kept ahead);
+    - ``data_delivery_pod_loss_samples_s`` — a streamed epoch with one
+      producer's server stopped mid-epoch: the rebalance (dead-fetch
+      timeouts, nack, requeue, re-production) priced in records/s;
+    - every run is audited exactly-once (a drop or duplicate fails the
+      section rather than reporting a corrupt-throughput number).
+
+    Loopback RTT is ~0, which would hide exactly the cost the streamed
+    transport removes (a request round trip per batch), so the batch
+    FETCH ops carry an injected per-dispatch wire delay
+    (``EDL_TPU_BENCH_DELIVERY_RTT_MS``, via the utils/faultinject
+    harness) modeling a real pod network; every path pays the same
+    per-dispatch price — per-batch pays it per batch, streamed per
+    group — which is the structural difference being measured.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from edl_tpu.data import DistributedReader, PodDataServer
+    from edl_tpu.data import distribute_reader as dr_mod
+    from edl_tpu.utils import faultinject
+
+    n_files = int(os.environ.get("EDL_TPU_BENCH_DELIVERY_FILES", 6))
+    per_file = int(os.environ.get("EDL_TPU_BENCH_DELIVERY_RECORDS", 240))
+    rec_bytes = int(os.environ.get("EDL_TPU_BENCH_DELIVERY_BYTES", 256))
+    bs = int(os.environ.get("EDL_TPU_BENCH_DELIVERY_BS", 8))
+    reps = max(1, int(os.environ.get("EDL_TPU_BENCH_DELIVERY_REPS", 1)))
+    step_s = float(os.environ.get("EDL_TPU_BENCH_DELIVERY_STEP_MS", 2)) / 1e3
+    rtt_s = float(os.environ.get("EDL_TPU_BENCH_DELIVERY_RTT_MS", 2)) / 1e3
+
+    data_dir = tempfile.mkdtemp(prefix="edl-bench-delivery-")
+    pad = "x" * rec_bytes
+    for f in range(n_files):
+        with open(os.path.join(data_dir, f"part-{f}.txt"), "w") as fh:
+            fh.writelines(f"f{f}r{r}:{pad}\n" for r in range(per_file))
+    files = sorted(os.path.join(data_dir, f) for f in os.listdir(data_dir))
+    total = n_files * per_file
+
+    def run_epoch(gen: str, stream: bool, legacy: bool = False,
+                  kill: bool = False, consume_s: float = 0.0,
+                  use_files: "list[str] | None" = None,
+                  ) -> tuple[float, float]:
+        """Drain one epoch; returns (records/s, consumer stall s).
+        ``legacy=True`` shapes the consumer like the pre-ISSUE-11
+        reader: one fetch worker, one batch per round trip, 4-meta
+        lookahead — the honest "before" of the before/after."""
+        epoch_files = files if use_files is None else use_files
+        epoch_total = len(epoch_files) * per_file
+        leader = PodDataServer("bench-consumer", is_leader=True)
+        producers: list = []  # (pod_server, reader, thread)
+        stall0 = dr_mod._PREFETCH_STALL.value
+        spans: list = []
+        try:
+            for pid in ("bench-prod-a", "bench-prod-b"):
+                srv = PodDataServer(pid)
+                rd = DistributedReader(gen, pid, leader.endpoint, srv,
+                                       batch_size=bs, stream=stream)
+                rd.create(epoch_files)
+                th = threading.Thread(target=rd._produce, daemon=True,
+                                      name=f"bench-produce:{pid}")
+                th.start()
+                producers.append((srv, rd, th))
+            # the consumer is consume-ONLY (its producer thread exits
+            # at once): every batch crosses the wire, so the number
+            # prices the DELIVERY pipeline, not local cache pops
+            tuning = (dict(fetch_workers=1, meta_prefetch=4,
+                           prefetch_depth=4) if legacy else
+                      dict(meta_prefetch=16, prefetch_depth=48))
+            consumer = DistributedReader(gen, "bench-consumer",
+                                         leader.endpoint, leader,
+                                         batch_size=bs, stream=stream,
+                                         **tuning)
+            consumer.create(epoch_files)
+            consumer._stop_produce.set()
+            got = 0
+            killed = False
+            t0 = time.perf_counter()
+            for _bid, payload in consumer:
+                spans.extend(payload["spans"])
+                got += len(payload["records"])
+                if consume_s:
+                    time.sleep(consume_s)  # the simulated train step
+                if kill and not killed and got >= epoch_total // 3:
+                    srv_a, rd_a, _th_a = producers[0]
+                    rd_a._stop_produce.set()
+                    srv_a.stop()  # its batch cache goes dark mid-epoch
+                    killed = True
+            dt = time.perf_counter() - t0
+            counts: dict = {}
+            for f, b, e in spans:
+                for r in range(b, e):
+                    counts[(f, r)] = counts.get((f, r), 0) + 1
+            dup = sum(1 for c in counts.values() if c > 1)
+            if len(counts) != epoch_total or dup:
+                raise RuntimeError(
+                    f"delivery audit failed ({gen}): {len(counts)} "
+                    f"distinct records != {epoch_total}, {dup} duplicated")
+            return epoch_total / dt, dr_mod._PREFETCH_STALL.value - stall0
+        finally:
+            for _srv, rd, _th in producers:
+                rd._stop_produce.set()
+            for _srv, rd, th in producers:
+                th.join(timeout=10)
+                rd.close(deadline=2.0)
+            for srv, _rd, _th in producers:
+                try:
+                    srv.stop()
+                # edl-lint: disable=wire-error — bench teardown; the
+                # artifact (already measured) must still be emitted
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+            leader.stop()
+
+    stream_rate = stall = rpc_rate = 0.0
+    try:
+        if rtt_s > 0:
+            faultinject.configure(
+                f"client:get_batch_data:delay:{rtt_s};"
+                f"client:get_batch_stream:delay:{rtt_s}")
+        for rep in range(reps):
+            rate, s = run_epoch(f"deliver-stream-r{rep}@e0", stream=True)
+            if rate > stream_rate:
+                stream_rate, stall = rate, s
+            rpc_rate = max(rpc_rate,
+                           run_epoch(f"deliver-rpc-r{rep}@e0", stream=False,
+                                     legacy=True)[0])
+        consumed_rate, consumed_stall = run_epoch(
+            "deliver-consumed@e0", stream=True, consume_s=step_s)
+        # a quarter-size epoch: the rebalance price (dead-fetch
+        # timeouts, nack, requeue, re-production) dominates its wall
+        # time, and the full-epoch runs above already price steady state
+        loss_rate, _ = run_epoch("deliver-loss@e0", stream=True, kill=True,
+                                 use_files=files[:max(2, n_files // 3)])
+    finally:
+        # restore whatever fault spec the process came with
+        seed = os.environ.get("EDL_TPU_FAULTS_SEED")
+        faultinject.configure(os.environ.get("EDL_TPU_FAULTS"),
+                              int(seed) if seed else None)
+        shutil.rmtree(data_dir, ignore_errors=True)
+    return {
+        "data_delivery_samples_s": round(stream_rate, 1),
+        "data_delivery_rpc_samples_s": round(rpc_rate, 1),
+        "data_delivery_stream_ratio": round(
+            stream_rate / max(rpc_rate, 1e-9), 2),
+        "data_delivery_stall_s": round(stall, 3),
+        "data_delivery_consumed_samples_s": round(consumed_rate, 1),
+        "data_delivery_consumed_stall_s": round(consumed_stall, 3),
+        "data_delivery_pod_loss_samples_s": round(loss_rate, 1),
+        "data_delivery_records": total,
+    }
 
 
 def _bench_alerts() -> dict:

@@ -24,7 +24,7 @@ has-next agreement instead of being dropped.
 from edl_tpu.data.dataset import FileSplitter, RecordioSplitter, TxtFileSplitter
 from edl_tpu.data.data_server import DataService, PodDataServer
 from edl_tpu.data.distribute_reader import DistributedReader
-from edl_tpu.data.elastic_input import ElasticInput
+from edl_tpu.data.elastic_input import ElasticInput, device_put_stream
 from edl_tpu.data.journal import DataJournal
 from edl_tpu.data.registry import load_readers, register_reader, wait_dist_readers
 from edl_tpu.data.resilient import ResilientDataClient
@@ -32,4 +32,5 @@ from edl_tpu.data.resilient import ResilientDataClient
 __all__ = ["FileSplitter", "TxtFileSplitter", "RecordioSplitter",
            "DataService", "PodDataServer", "DistributedReader",
            "ElasticInput", "DataJournal", "ResilientDataClient",
+           "device_put_stream",
            "register_reader", "load_readers", "wait_dist_readers"]

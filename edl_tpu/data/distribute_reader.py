@@ -31,23 +31,77 @@ consumer loops therefore only ever see three terminal outcomes:
 end-of-data (``EdlStopIteration``), a generation-fatal producer error
 (``EdlDataError``), or a leader unreachable past the whole retry
 budget.
+
+**Streamed, prefetched delivery** (ISSUE 11): the consumer is a
+pipeline, not a loop.  The iterator thread keeps up to
+``EDL_TPU_DATA_PREFETCH_DEPTH`` batch metas dispatched to
+``EDL_TPU_DATA_PREFETCH_WORKERS`` fetch workers; each worker fetches a
+whole group of batches from one producer over a shared
+:class:`~edl_tpu.rpc.client.RpcChannelPool` with a single
+``get_batch_stream`` request (one q-numbered raw frame per batch)
+instead of one ``get_batch_data`` round trip per batch.  An old peer
+without the streaming handler demotes — probed once per endpoint — to
+the per-batch path; a malformed stream (gap, duplicate, short or
+mismatched frame) surfaces as a typed ``EdlStreamError`` and the
+unreceived batches re-fetch through the leader's requeue-repair path,
+never dropped and never double-acked.  Acks are issued on YIELD (not
+on fetch), so the exactly-once contract and every reattach invariant
+above are untouched by the prefetch depth; ``close(deadline)`` drains
+the workers under the same budget that bounds the producer.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Callable, Iterator
+
+import msgpack
 
 from edl_tpu.cluster.state import DataCheckpoint
 from edl_tpu.data.data_server import PodDataServer, in_spans, merge_span
 from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
 from edl_tpu.data.resilient import ResilientDataClient
-from edl_tpu.rpc.client import RpcClient
-from edl_tpu.utils.exceptions import EdlError, EdlStopIteration, EdlTableError
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.rpc.client import RpcChannelPool
+from edl_tpu.utils import constants
+from edl_tpu.utils.exceptions import (
+    EdlCoordError,
+    EdlError,
+    EdlInternalError,
+    EdlStopIteration,
+    EdlStreamError,
+    EdlTableError,
+)
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# delivery-path split: the streamed-vs-legacy mix is the first thing to
+# look at when input throughput regresses (an all-"rpc" reading means
+# every peer demoted — old fleet, or EDL_TPU_DATA_PREFETCH_STREAM=0)
+_DELIVERED = obs_metrics.counter(
+    "edl_data_delivery_batches_total",
+    "Batches delivered to this consumer, by transport path (stream = "
+    "framed multi-batch push, rpc = per-batch request/reply, local = "
+    "own-cache pop)", ("path",))
+_STREAM_ERRORS = obs_metrics.counter(
+    "edl_data_delivery_stream_errors_total",
+    "Streamed fetches aborted by a typed stream-protocol error (gap, "
+    "duplicate, short or mismatched frame); the unreceived batches "
+    "re-fetch through the requeue-repair path")
+_DEMOTIONS = obs_metrics.counter(
+    "edl_data_delivery_stream_demotions_total",
+    "Producer endpoints demoted to the legacy per-batch fetch path "
+    "(old peer without the get_batch_stream handler)")
+_PREFETCH_DEPTH = obs_metrics.gauge(
+    "edl_data_prefetch_queue_depth",
+    "Batches fetched or in flight ahead of this consumer's loop")
+_PREFETCH_STALL = obs_metrics.counter(
+    "edl_data_prefetch_stall_seconds_total",
+    "Seconds the consumer loop spent waiting on the prefetch queue "
+    "(input-bound time; ~0 while the prefetcher keeps ahead)")
 
 
 class DistributedReader:
@@ -57,8 +111,12 @@ class DistributedReader:
                  batch_size: int = 32,
                  splitter: FileSplitter | None = None,
                  checkpoint: DataCheckpoint | None = None,
-                 meta_prefetch: int = 4, mark_on_yield: bool = True,
-                 retry_deadline: float | None = None):
+                 meta_prefetch: int | None = None,
+                 mark_on_yield: bool = True,
+                 retry_deadline: float | None = None,
+                 fetch_workers: int | None = None,
+                 prefetch_depth: int | None = None,
+                 stream: bool | None = None):
         self.name = reader_name
         self.pod_id = pod_id
         self._leader = ResilientDataClient(
@@ -69,7 +127,18 @@ class DistributedReader:
         self._bs = batch_size
         self._splitter = splitter or TxtFileSplitter()
         self.checkpoint = checkpoint or DataCheckpoint(reader_name)
-        self._prefetch = meta_prefetch
+        # every prefetch knob defaults from its EDL_TPU_DATA_PREFETCH_*
+        # env constant, so the launcher/ElasticInput path picks up
+        # operator tuning without any code change
+        self._prefetch = (constants.DATA_PREFETCH_META
+                          if meta_prefetch is None else meta_prefetch)
+        self._n_workers = max(1, constants.DATA_PREFETCH_WORKERS
+                              if fetch_workers is None else fetch_workers)
+        self._depth = max(self._prefetch,
+                          constants.DATA_PREFETCH_DEPTH
+                          if prefetch_depth is None else prefetch_depth)
+        self._stream = (bool(constants.DATA_PREFETCH_STREAM)
+                        if stream is None else bool(stream))
         # mark_on_yield=False defers checkpoint marking to the caller
         # (elastic_input marks per record as batches are actually fed to
         # the train step, so a mid-epoch save never claims records that
@@ -82,7 +151,18 @@ class DistributedReader:
         self._produce_exc: BaseException | None = None
         self._stop_produce = threading.Event()
         self._producer: threading.Thread | None = None
-        self._peer_clients: dict[str, RpcClient] = {}
+        # one channel pool per producer endpoint, SHARED by the fetch
+        # workers: per-connection locking means a dead producer costs
+        # the workers one timeout in parallel, not N in series
+        self._peer_pools: dict[str, RpcChannelPool] = {}
+        self._pools_lock = threading.Lock()
+        # endpoints demoted to per-batch fetch (old peer without the
+        # streaming handler): probed at most once per endpoint for the
+        # reader's life, surviving pool churn
+        self._demoted: set[str] = set()
+        self._task_q: "queue.Queue" = queue.Queue()
+        self._done_q: "queue.Queue" = queue.Queue()
+        self._fetch_workers: list[threading.Thread] = []
         self._closed = False
         # -- reattach state (all guarded by _state_lock): what this
         # reader would need to re-establish itself on a successor leader
@@ -285,57 +365,98 @@ class DistributedReader:
         self._producer = threading.Thread(target=self._produce, daemon=True,
                                           name=f"produce:{self.name}")
         self._producer.start()
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._fetch_worker, daemon=True,
+                                 name=f"fetch:{self.name}:{i}")
+            self._fetch_workers.append(t)
+            t.start()
         ack_ids: list[str] = []
+        nacks: dict[bool, list[str]] = {True: [], False: []}
         req_id = 0
+        pending = 0  # metas dispatched to workers, result not yet popped
+        eof = False
         try:
             while True:
-                try:
-                    # req_id makes the hand-out replay-safe: a RETRY of
-                    # this call (same id) whose first response was lost
-                    # gets the SAME metas back instead of stranding
-                    # them in our server-side inflight
-                    req_id += 1
-                    metas = self._leader.call(
-                        "get_batch_meta", reader=self.name,
-                        pod_id=self.pod_id, n=self._prefetch,
-                        ack_ids=ack_ids, req_id=req_id)["metas"]
-                except EdlStopIteration:
-                    break
-                with self._state_lock:
-                    self._held.difference_update(ack_ids)
-                ack_ids = []
-                if not metas:
-                    if self._produce_exc is not None:
-                        raise self._produce_exc
-                    time.sleep(0.05)
-                    continue
-                with self._state_lock:
-                    self._held.update(m[2] for m in metas)
-                nacks: dict[bool, list[str]] = {True: [], False: []}
-                for producer_pod, endpoint, batch_id, spans in metas:
-                    payload, failure = self._fetch(producer_pod, endpoint,
-                                                   batch_id)
-                    if payload is None:
-                        # "dead" (unreachable) kills the producer's work;
-                        # "miss" (evicted by a live producer) re-produces
-                        # just this batch's spans
-                        nacks[failure == "dead"].append(batch_id)
-                        continue
-                    self._claim(payload["spans"])
-                    if self._mark_on_yield:
-                        for file_idx, begin, end in payload["spans"]:
-                            self.checkpoint.mark_processed(file_idx, begin, end)
-                    ack_ids.append(batch_id)
-                    yield batch_id, payload
+                # flush nacks BEFORE asking for more work: the leader
+                # must requeue lost batches before it can run dry.
+                # "dead" (unreachable) kills the producer's work; "miss"
+                # (evicted or stream-mangled by a live producer)
+                # re-produces just those batches' spans
                 for dead, ids in nacks.items():
                     if ids:
-                        logger.warning("nacking %d batches (producer_dead=%s)",
-                                       len(ids), dead)
+                        logger.warning("nacking %d batches "
+                                       "(producer_dead=%s)", len(ids), dead)
                         self._leader.call("nack_batches", reader=self.name,
                                           pod_id=self.pod_id, batch_ids=ids,
                                           producer_dead=dead)
                         with self._state_lock:
                             self._held.difference_update(ids)
+                nacks = {True: [], False: []}
+                got_metas = False
+                # top up in prefetch-sized chunks (not per pop): one
+                # leader round trip hands out — and acks — up to
+                # meta_prefetch batches, so leader traffic amortizes to
+                # 1/meta_prefetch per batch however deep the pipeline
+                room = self._depth - pending
+                if not eof and (room >= self._prefetch or pending == 0):
+                    try:
+                        # req_id makes the hand-out replay-safe: a RETRY
+                        # of this call (same id) whose first response
+                        # was lost gets the SAME metas back instead of
+                        # stranding them in our server-side inflight
+                        req_id += 1
+                        metas = self._leader.call(
+                            "get_batch_meta", reader=self.name,
+                            pod_id=self.pod_id,
+                            n=min(self._prefetch, room),
+                            ack_ids=ack_ids, req_id=req_id)["metas"]
+                    except EdlStopIteration:
+                        # the leader only answers this once OUR held set
+                        # is empty and the generation is drained — the
+                        # acks on this very call landed before the raise
+                        eof = True
+                        metas = []
+                    with self._state_lock:
+                        self._held.difference_update(ack_ids)
+                    ack_ids = []
+                    if metas:
+                        with self._state_lock:
+                            self._held.update(m[2] for m in metas)
+                        pending += len(metas)
+                        got_metas = True
+                        self._dispatch(metas)
+                _PREFETCH_DEPTH.set(pending)
+                if pending == 0:
+                    if eof:
+                        break
+                    if self._produce_exc is not None:
+                        raise self._produce_exc
+                    if not got_metas:
+                        time.sleep(0.05)
+                    continue
+                # pop ONE completed fetch; the bounded wait keeps the
+                # meta top-up (and produce_exc checks) responsive while
+                # fetches are in flight
+                t0 = time.perf_counter()
+                try:
+                    bid, payload, failure = self._done_q.get(timeout=0.5)
+                except queue.Empty:
+                    _PREFETCH_STALL.inc(time.perf_counter() - t0)
+                    continue
+                _PREFETCH_STALL.inc(time.perf_counter() - t0)
+                pending -= 1
+                if payload is None:
+                    nacks[failure == "dead"].append(bid)
+                    continue
+                self._claim(payload["spans"])
+                if self._mark_on_yield:
+                    for file_idx, begin, end in payload["spans"]:
+                        self.checkpoint.mark_processed(file_idx, begin, end)
+                # ack rides the NEXT get_batch_meta call — issued on
+                # yield, never on fetch, so a crash between fetch and
+                # train leaves the batch reclaimable on reattach
+                ack_ids.append(bid)
+                yield bid, payload
             if self._produce_exc is not None:
                 raise self._produce_exc
         finally:
@@ -347,13 +468,16 @@ class DistributedReader:
         The stop flag is set *and* the leader client's in-flight retry
         loops are capped by the deadline before the producer join, so a
         producer thread blocked in a leader call unwinds instead of
-        outliving the join; a thread that still won't die (e.g. wedged
-        in a kernel recv) is logged — never silently leaked."""
+        outliving the join; the fetch workers drain under the same
+        budget.  A thread that still won't die (e.g. wedged in a kernel
+        recv) is logged — never silently leaked."""
         if self._closed:
             return
         self._closed = True
         self._stop_produce.set()
         self._leader.close_after(deadline)
+        for _ in self._fetch_workers:
+            self._task_q.put(None)
         producer = self._producer
         if producer is not None and producer.is_alive():
             producer.join(timeout=deadline)
@@ -363,37 +487,224 @@ class DistributedReader:
                     "in-flight leader call after the %.1fs close deadline; "
                     "abandoning it (daemon thread, call timeout capped)",
                     self.name, deadline)
-        for c in self._peer_clients.values():
-            c.close()
+        end = time.monotonic() + deadline
+        for t in self._fetch_workers:
+            t.join(timeout=max(0.0, end - time.monotonic()))
+        stuck = [t for t in self._fetch_workers if t.is_alive()]
+        if stuck:
+            # closing a pool blocks on its per-channel locks, and a
+            # wedged worker may hold one — leave those pools to the
+            # daemon threads rather than wedging close() itself
+            logger.warning(
+                "reader %s: %d fetch workers still blocked mid-fetch "
+                "after the %.1fs close deadline; abandoning them (daemon "
+                "threads; their channel pools stay open)",
+                self.name, len(stuck), deadline)
+        else:
+            for pool in self._peer_pools.values():
+                pool.close()
         self._leader.close()
 
-    def _fetch(self, producer_pod: str, endpoint: str, batch_id: str,
-               ) -> tuple[dict | None, str | None]:
-        """(payload, None) on success; (None, "miss") when a LIVE
-        producer answered but no longer has the batch (cache eviction);
-        (None, "dead") when the producer is unreachable."""
+    # -- the fetch pipeline --------------------------------------------------
+    def _pool(self, endpoint: str) -> RpcChannelPool:
+        with self._pools_lock:
+            pool = self._peer_pools.get(endpoint)
+            if pool is None:  # construction is lazy: no connect here
+                pool = self._peer_pools[endpoint] = RpcChannelPool(
+                    endpoint, timeout=10.0)
+            return pool
+
+    def _dispatch(self, metas: list) -> None:
+        """Group fresh metas by producer endpoint (request order kept
+        within a group) and hand them to the fetch workers; group size
+        is capped by ``EDL_TPU_DATA_STREAM_BATCH`` so one stream never
+        monopolizes a worker (or a channel) for a whole depth's
+        worth of batches."""
+        groups: dict[tuple[str, str], list] = {}
+        for m in metas:
+            groups.setdefault((m[0], m[1]), []).append(m)
+        cap = max(1, constants.DATA_STREAM_BATCH)
+        for (pod, ep), group in groups.items():
+            for i in range(0, len(group), cap):
+                self._task_q.put((pod, ep, group[i:i + cap]))
+
+    def _fetch_worker(self) -> None:
+        while True:
+            task = self._task_q.get()
+            if task is None:
+                return
+            producer_pod, endpoint, metas = task
+            try:
+                results = self._fetch_group(producer_pod, endpoint, metas)
+            except Exception as e:  # noqa: BLE001 — a worker survives
+                # backstop for bugs, not for transport verdicts: report
+                # "miss" (requeue just these spans), never "dead" — an
+                # unexpected local error must not kill a live
+                # producer's whole work set (that double-produces its
+                # files)
+                logger.warning("fetch worker: group fetch from %s failed "
+                               "unexpectedly: %s", endpoint, e)
+                results = [(m[2], None, "miss") for m in metas]
+            for item in results:
+                self._done_q.put(item)
+
+    def _fetch_group(self, producer_pod: str, endpoint: str, metas: list,
+                     ) -> list[tuple[str, dict | None, str | None]]:
+        """Fetch one producer's batch group; per batch: ``(batch_id,
+        payload, None)`` on success, ``(batch_id, None, "miss")`` when a
+        LIVE producer answered without the batch (cache eviction, or a
+        stream-protocol error mangled its frames), ``(batch_id, None,
+        "dead")`` when the producer is unreachable."""
         if producer_pod == self.pod_id:
-            local = self._server.pop_batch(batch_id)
-            if local is not None:
-                return local, None
-            return None, "miss"  # own cache evicted it; we are alive
-        client = self._peer_clients.get(endpoint)
-        if client is None:
-            client = self._peer_clients[endpoint] = RpcClient(endpoint,
-                                                              timeout=10.0)
-        # a transient stall (peer busy compiling, GC pause) must not be
-        # read as death — declaring a LIVE producer dead re-produces its
-        # files and double-trains records; so retry before concluding
+            out = []
+            for _pod, _ep, bid, _spans in metas:
+                local = self._server.pop_batch(bid)
+                if local is not None:
+                    _DELIVERED.labels(path="local").inc()
+                # a local miss means our own cache evicted it; we are
+                # alive, so it repairs rather than killing our work
+                out.append((bid, local, None if local is not None
+                            else "miss"))
+            return out
+        pool = self._pool(endpoint)
+        out = []
+        leftover = [m[2] for m in metas]
+        if self._stream and endpoint not in self._demoted:
+            got, verdict = self._fetch_streamed(pool, leftover)
+            leftover = []
+            for _pod, _ep, bid, _spans in metas:
+                if bid in got:
+                    payload = got[bid]
+                    if payload is None:
+                        out.append((bid, None, "miss"))
+                    else:
+                        _DELIVERED.labels(path="stream").inc()
+                        out.append((bid, payload, None))
+                elif verdict == "stream":
+                    # the producer answered but its stream desynced:
+                    # treat the unreceived batches like evictions — the
+                    # leader requeues exactly their spans for
+                    # re-production (never dropped, never double-acked)
+                    out.append((bid, None, "miss"))
+                else:
+                    leftover.append(bid)  # demoted / transport: retry
+        # per-batch path: old peers (probe-once demotion), forced
+        # legacy mode, and the remainder of a transport-failed stream.
+        # One batch concluding "dead" concludes the whole group — the
+        # batches share one endpoint, and a full retry cycle is the
+        # same evidence for all of them (paying it per batch would
+        # serialize N retry cycles against one dead producer)
+        dead = False
+        for bid in leftover:
+            if dead:
+                out.append((bid, None, "dead"))
+                continue
+            payload, failure = self._fetch_one(pool, bid)
+            dead = failure == "dead"
+            out.append((bid, payload, failure))
+        return out
+
+    def _fetch_streamed(self, pool: RpcChannelPool, batch_ids: list[str],
+                        ) -> tuple[dict, str | None]:
+        """One ``get_batch_stream`` request for the whole group.
+        Returns ``(received, verdict)`` where ``received`` maps batch
+        id -> payload (None = producer-side miss) and ``verdict`` is
+        None (complete), ``"demote"`` (old peer — the endpoint joins
+        ``_demoted`` and is never probed again), ``"stream"`` (typed
+        protocol error; the channel is already torn down), or
+        ``"transport"``."""
+        got: dict[str, dict | None] = {}
+        idx = 0
+        try:
+            for frame in pool.call_streaming("get_batch_stream",
+                                             batch_ids=batch_ids):
+                if idx >= len(batch_ids):
+                    raise EdlStreamError(
+                        f"get_batch_stream from {pool.endpoint}: frame "
+                        f"{idx} past the {len(batch_ids)} requested "
+                        f"batches")
+                if isinstance(frame, (bytes, bytearray, memoryview)):
+                    # raw-frame variant: the payload envelope was packed
+                    # into one blob server-side (zero-copy formats)
+                    try:
+                        rec = msgpack.unpackb(frame, raw=False,
+                                              strict_map_key=False)
+                    except Exception as e:
+                        raise EdlStreamError(
+                            f"get_batch_stream from {pool.endpoint}: "
+                            f"undecodable frame {idx}: {e}") from e
+                else:
+                    rec = frame
+                if not isinstance(rec, dict) \
+                        or rec.get("batch_id") != batch_ids[idx]:
+                    raise EdlStreamError(
+                        f"get_batch_stream from {pool.endpoint}: frame "
+                        f"{idx} answers batch "
+                        f"{rec.get('batch_id') if isinstance(rec, dict) else rec!r}, "
+                        f"expected {batch_ids[idx]!r}")
+                got[batch_ids[idx]] = rec.get("payload")
+                idx += 1
+            if idx != len(batch_ids):
+                raise EdlStreamError(
+                    f"get_batch_stream from {pool.endpoint} ended after "
+                    f"{idx} of {len(batch_ids)} batches")
+            return got, None
+        except EdlStreamError as e:
+            _STREAM_ERRORS.inc()
+            logger.warning("streamed fetch from %s failed (%s); the "
+                           "unreceived batches re-fetch via requeue",
+                           pool.endpoint, e)
+            return got, "stream"
+        except EdlInternalError as e:
+            if "no such method" in str(e):
+                # probe-once demotion, the memstate-restore pattern: an
+                # old peer is asked for the stream once per endpoint
+                # (concurrent workers already mid-probe may each pay
+                # one, bounded by the worker count)
+                self._demoted.add(pool.endpoint)
+                _DEMOTIONS.inc()
+                logger.info("producer %s has no streamed delivery; "
+                            "demoting this pool to per-batch fetch",
+                            pool.endpoint)
+                return got, "demote"
+            _STREAM_ERRORS.inc()
+            logger.warning("streamed fetch from %s raised %s; the "
+                           "unreceived batches re-fetch via requeue",
+                           pool.endpoint, e)
+            return got, "stream"
+        except EdlCoordError as e:
+            logger.warning("streamed fetch from %s transport failure: %s",
+                           pool.endpoint, e)
+            return got, "transport"
+        except EdlError as e:
+            # any other typed error crossed the wire: the producer
+            # ANSWERED — it is alive, so the unreceived batches repair
+            # as misses rather than condemning its whole work set
+            _STREAM_ERRORS.inc()
+            logger.warning("streamed fetch from %s raised a typed error "
+                           "(%s); the unreceived batches re-fetch via "
+                           "requeue", pool.endpoint, e)
+            return got, "stream"
+
+    def _fetch_one(self, pool: RpcChannelPool, batch_id: str,
+                   ) -> tuple[dict | None, str | None]:
+        """Legacy per-batch request/reply fetch (one round trip).  A
+        transient stall (peer busy compiling, GC pause) must not be
+        read as death — declaring a LIVE producer dead re-produces its
+        files and double-trains records; so retry before concluding."""
         for attempt in range(3):
             try:
-                return client.call("get_batch_data",
-                                   batch_id=batch_id)["payload"], None
+                payload = pool.call("get_batch_data",
+                                    batch_id=batch_id)["payload"]
+                _DELIVERED.labels(path="rpc").inc()
+                return payload, None
             except EdlTableError as e:  # server answered: batch evicted
-                logger.warning("fetch %s from %s: %s", batch_id, endpoint, e)
+                logger.warning("fetch %s from %s: %s", batch_id,
+                               pool.endpoint, e)
                 return None, "miss"
             except EdlError as e:  # transport failure
                 logger.warning("fetch %s from %s failed (try %d/3): %s",
-                               batch_id, endpoint, attempt + 1, e)
+                               batch_id, pool.endpoint, attempt + 1, e)
                 if attempt < 2 and not self._closed:
                     time.sleep(1.0 * (attempt + 1))
         return None, "dead"

@@ -32,6 +32,7 @@ batches** safe to feed a jitted multi-host train step:
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterator
 
 import numpy as np
@@ -42,6 +43,7 @@ from edl_tpu.data import registry
 from edl_tpu.data.data_server import PodDataServer
 from edl_tpu.data.dataset import FileSplitter
 from edl_tpu.data.distribute_reader import DistributedReader
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import constants
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logger import get_logger
@@ -54,6 +56,12 @@ Assemble = Callable[[list], dict]
 # batches carry their consumed record spans under this key; the trainer
 # pops it and marks the DataCheckpoint when the batch is actually trained
 SPANS_KEY = constants.DATA_SPANS_KEY
+
+_H2D_WAIT = obs_metrics.counter(
+    "edl_data_h2d_wait_seconds_total",
+    "Seconds the consumer waited on the staged device transfer in "
+    "device_put_stream (H2D not hidden behind compute; ~0 when the "
+    "overlap works)")
 
 
 def _allgather_flag(flag: int) -> np.ndarray:
@@ -100,10 +108,61 @@ def sync_checkpoint(checkpoint: DataCheckpoint) -> None:
                             for b, e in per_file[fi]]
 
 
+def device_put_stream(batches: "Iterator[dict]", put: Callable[[dict], object],
+                      ) -> "Iterator[tuple[object, list]]":
+    """Double-buffered device staging: run ``put`` (``jax.device_put``,
+    ``shard_host_batch``, ...) on batch k+1 in a background thread
+    while the caller consumes batch k, so H2D of the next batch
+    overlaps decode/compute on the current one — the
+    dispatch-pipelining trick doc/perf.md's bench applies, now on the
+    data-service input path.
+
+    Yields ``(device_batch, spans)`` with the ``SPANS_KEY`` metadata
+    split out BEFORE the put: record spans must stay host-side, and
+    they must be marked by the CONSUMER at train time, never by the
+    staging thread (a prefetching stage marking spans would let a
+    mid-epoch checkpoint claim records one batch ahead of what
+    actually trained).  Depth is fixed at one batch so the collective
+    order of the source iterator's internals (the has-next agreement)
+    stays identical on every process — the same contract as the
+    trainer's ``_sharded_stream``."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def split(batch):
+        spans = None
+        if isinstance(batch, dict) and SPANS_KEY in batch:
+            batch = dict(batch)
+            spans = batch.pop(SPANS_KEY)
+        return batch, spans
+
+    def staged_result(staged):
+        t0 = time.perf_counter()
+        out = staged[0].result()
+        _H2D_WAIT.inc(time.perf_counter() - t0)
+        return out, staged[1]
+
+    with ThreadPoolExecutor(1, thread_name_prefix="h2d-stage") as pool:
+        staged = None
+        for batch in batches:
+            host, spans = split(batch)
+            nxt = (pool.submit(put, host), spans)
+            if staged is not None:
+                yield staged_result(staged)
+            staged = nxt
+        if staged is not None:
+            yield staged_result(staged)
+
+
 class ElasticInput:
     """Lives for the whole trainer process; ``epoch()`` yields one
     epoch's batches.  ``assemble`` builds host-batch arrays from raw
-    records; short/empty batches are zero-padded and masked."""
+    records; short/empty batches are zero-padded and masked.
+
+    The underlying :class:`DistributedReader` reads its prefetch
+    tuning (fetch workers, queue bound, metas per leader round trip,
+    streamed vs per-batch transport) from the
+    ``EDL_TPU_DATA_PREFETCH_*`` env knobs, so the launcher path picks
+    up operator tuning with no code change here."""
 
     def __init__(self, store, job_id: str, pod_id: str, reader_base: str,
                  files: list[str], batch_size: int, splitter: FileSplitter,
@@ -134,11 +193,18 @@ class ElasticInput:
         return resolve
 
     def epoch(self, epoch: int, checkpoint: DataCheckpoint,
+              device_put: "Callable[[dict], object] | None" = None,
               ) -> Iterator[dict]:
         """Yield masked host batches for one epoch.  The generation key
         is ``base@e<epoch>@<stage>`` — a new cluster stage (elastic
         resize) or epoch makes a fresh generation, seeded from
-        ``checkpoint`` (the restored mid-epoch spans on resume)."""
+        ``checkpoint`` (the restored mid-epoch spans on resume).
+
+        With ``device_put`` set, batches ride :func:`device_put_stream`
+        and the iterator yields ``(device_batch, spans)`` pairs instead
+        of raw host dicts: batch k+1's H2D overlaps the caller's
+        consumption of batch k (callers that already stage — the
+        trainer's ``_sharded_stream`` — leave it None)."""
         cluster = Cluster.load_from_store(self._store, self._job_id)
         if cluster is None:
             raise EdlDataError("no cluster in store; is the launcher up?")
@@ -155,7 +221,11 @@ class ElasticInput:
                 self.server, batch_size=self._bs, splitter=self._splitter,
                 checkpoint=checkpoint, mark_on_yield=False)
             reader.create(self._files)
-            yield from self._batches(reader)
+            if device_put is None:
+                yield from self._batches(reader)
+            else:
+                yield from device_put_stream(self._batches(reader),
+                                             device_put)
         finally:
             if reader is not None:
                 reader.close()

@@ -186,6 +186,26 @@ DATA_BACKOFF_MAX = _f("EDL_TPU_DATA_BACKOFF_MAX", 2.0)
 # reattach on their first post-failover call, normally within ~1 s)
 DATA_REBUILD_GRACE = _f("EDL_TPU_DATA_REBUILD_GRACE", 5.0)
 
+# -- streamed batch delivery + consumer prefetch (data/distribute_reader) --
+# fetch worker threads per consumer: batch fetches from distinct
+# producers run concurrently, so one dead producer costs the workers
+# ONE fetch timeout in parallel instead of N in series
+DATA_PREFETCH_WORKERS = int(_f("EDL_TPU_DATA_PREFETCH_WORKERS", 2))
+# bound on batches fetched-or-in-flight ahead of the consumer loop —
+# the prefetch backpressure: new metas are requested only below it, so
+# a fast producer can never run the consumer's RAM (or the producers'
+# caches) away from it
+DATA_PREFETCH_DEPTH = int(_f("EDL_TPU_DATA_PREFETCH_DEPTH", 16))
+# batch metas requested per leader round trip (DistributedReader's
+# meta_prefetch default)
+DATA_PREFETCH_META = int(_f("EDL_TPU_DATA_PREFETCH_META", 4))
+# 0 forces the legacy one-batch-per-RPC fetch everywhere (the demotion
+# path old peers get automatically); 1 streams framed batch groups
+DATA_PREFETCH_STREAM = int(_f("EDL_TPU_DATA_PREFETCH_STREAM", 1))
+# max batch payloads pushed per get_batch_stream request: caps how long
+# one stream occupies a channel (and how much one EdlStreamError costs)
+DATA_STREAM_BATCH = int(_f("EDL_TPU_DATA_STREAM_BATCH", 8))
+
 # -- elastic serving gateway (edl_tpu/gateway, serving/replica) -----------
 # how often a replica refreshes its leased advert with live load stats
 # (free slots, queue depth, prefill stall) and republishes engine gauges

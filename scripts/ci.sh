@@ -91,6 +91,13 @@ JAX_PLATFORMS=cpu python scripts/alerts_smoke.py
 # CI log so throughput trends are visible per run
 JAX_PLATFORMS=cpu python scripts/transfer_smoke.py
 
+# data throughput smoke: streamed batch delivery (framed
+# get_batch_stream groups + multi-worker prefetch) must not lose to
+# the legacy per-batch request/reply consumer under a modeled wire
+# RTT, and every epoch in the section — including the one that stops
+# a producer mid-epoch — must audit exactly-once
+JAX_PLATFORMS=cpu python scripts/data_throughput_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
 # now exits 0 even on failure (partial-artifact contract), so CI must
@@ -98,12 +105,15 @@ JAX_PLATFORMS=cpu python scripts/transfer_smoke.py
 EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
 EDL_TPU_BENCH_WIDTH=8 EDL_TPU_BENCH_PIPELINE=0 EDL_TPU_BENCH_LM=0 \
 EDL_TPU_BENCH_MEMSTATE_MB=8 EDL_TPU_BENCH_TRANSFER_MB=8 \
+EDL_TPU_BENCH_DELIVERY_FILES=2 EDL_TPU_BENCH_DELIVERY_RECORDS=96 \
 JAX_PLATFORMS=cpu python bench.py | tail -1 \
     | python -c "
 import json, sys
 out = json.loads(sys.stdin.read())
 assert 'error' not in out and not out.get('partial'), out
 assert out.get('value'), out
+# streamed data delivery (ISSUE 11) must land in the artifact
+assert out.get('data_delivery_samples_s'), out
 # alerting loop (ISSUE 9): detection latency must land near the rule's
 # declared window+hold, and the background scrape loop must cost the
 # step loop ~nothing (<2% target on real hosts; 5% absorbs 1-core CI

@@ -27,6 +27,11 @@ The full data-plane fault-tolerance story against REAL processes:
    the injected faults and the failover must be visible as retries in
    metrics, with ZERO reader failures.
 
+Since ISSUE 11 the readers deliver over the STREAMED path by default
+(framed ``get_batch_stream`` groups + multi-worker prefetch), so the
+SIGKILLs here land mid-stream and mid-prefetch — this smoke is the
+chaos audit of that pipeline, not just of the per-batch fallback.
+
 Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
 """
 

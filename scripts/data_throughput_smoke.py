@@ -1,0 +1,63 @@
+"""CI smoke for streamed batch delivery: run the data-delivery
+microbench (2 producer pods + 1 consumer over loopback — the same code
+path as ``bench.py``'s delivery section) and gate it two ways:
+
+- **throughput**: the streamed pipeline (framed ``get_batch_stream``
+  groups + multi-worker prefetch) must not lose to the legacy
+  per-batch request/reply consumer.  The fetch ops carry a small
+  injected per-dispatch wire delay (see ``_bench_data_delivery``) —
+  loopback RTT is ~0 and would hide exactly the round-trip-per-batch
+  cost the streamed transport removes; with it, the comparison is
+  structural: the same work with ~8x fewer request round trips cannot
+  be slower, so a loss here means the streamed path quietly demoted or
+  the prefetcher collapsed — what this stage exists to catch.
+- **exactly-once**: every run in the section (including the one that
+  stops a producer's server mid-epoch) audits its raw span log — a
+  drop or a duplicate fails the bench section itself, and this smoke
+  re-asserts the counts on the artifact.
+
+The absolute records/s land in the CI log for trend-eyeballing.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# small-but-real epoch: ~180 batches, best-of-2 to damp CI noise
+os.environ.setdefault("EDL_TPU_BENCH_DELIVERY_FILES", "6")
+os.environ.setdefault("EDL_TPU_BENCH_DELIVERY_RECORDS", "240")
+os.environ.setdefault("EDL_TPU_BENCH_DELIVERY_REPS", "2")
+
+from edl_tpu.bench import _bench_data_delivery  # noqa: E402
+
+
+def main() -> int:
+    r = _bench_data_delivery()
+    print(json.dumps(r))
+    streamed = r["data_delivery_samples_s"]
+    per_batch = r["data_delivery_rpc_samples_s"]
+    print(f"data throughput smoke: streamed={streamed} rec/s, "
+          f"per-batch={per_batch} rec/s "
+          f"({r['data_delivery_stream_ratio']:.2f}x), consumed="
+          f"{r['data_delivery_consumed_samples_s']} rec/s "
+          f"(stall {r['data_delivery_consumed_stall_s']}s), "
+          f"pod-loss={r['data_delivery_pod_loss_samples_s']} rec/s")
+    if streamed < per_batch:
+        print("FAIL: streamed delivery slower than the per-batch "
+              "request/reply baseline", file=sys.stderr)
+        return 1
+    # the bench audits every epoch internally (and raises on failure);
+    # assert the artifact agrees so a silent audit regression cannot
+    # pass this stage
+    if r.get("data_delivery_records", 0) <= 0:
+        print("FAIL: delivery bench reported no audited records",
+              file=sys.stderr)
+        return 1
+    print("data throughput smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
