@@ -314,6 +314,16 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- live resize: delta-reshard vs stop-resume MTTR (ISSUE 12) -----------
+    # the same grow-by-one measured on both paths: surviving processes
+    # resharding in place must not lose to kill-and-respawn
+    if os.environ.get("EDL_TPU_BENCH_RESIZE", "1") != "0":
+        try:
+            out.update(_bench_resize())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     # -- coord outage: control-plane recovery time (ISSUE 6) -----------------
     # SIGKILL + restart a WAL-backed coord server with live adverts on
     # it: how long until the store answers again and every advert is
@@ -419,6 +429,117 @@ srv.start()
 print(srv.port, flush=True)
 sys.stdin.read()  # serve until the parent closes our stdin
 """
+
+
+def _bench_resize() -> dict:
+    """Live-resize microbench (ISSUE 12): the same grow-by-one (2 pods
+    + 1 joiner, real launchers + real CPU/gloo jax trainers) measured
+    twice — once on the paper's stop-resume path and once with
+    EDL_TPU_RESIZE_DELTA=1, where the surviving trainer processes
+    reshard in place and move only changed-owner shards.  Reported:
+
+    - ``resize_stop_resume_mttr_s`` — detect -> first post-respawn step
+      (process kill + spawn + jax import + restore + recompile);
+    - ``resize_delta_mttr_s`` — detect -> first post-reshard step (the
+      processes never die; the delta path must not lose to
+      stop-resume, gated in ci.sh's bench smoke).
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    from edl_tpu.cluster.recovery import summarize_recovery
+    from edl_tpu.coord.client import connect
+    from edl_tpu.coord.server import spawn_subprocess, wait_ready
+    from edl_tpu.utils.network import find_free_ports
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    train = os.path.join(repo, "examples", "collective", "train_linear.py")
+    tmp = tempfile.mkdtemp(prefix="edl-bench-resize-")
+    port = find_free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    env_base = {
+        "EDL_TPU_TTL": "1", "EDL_TPU_GENERATOR_PERIOD": "0.2",
+        "EDL_TPU_WATCHER_PERIOD": "0.2", "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+        "EDL_TPU_BARRIER_TIMEOUT": "60",
+        "EDL_TPU_RESIZE_BARRIER_TIMEOUT": "40",
+        "EDL_TPU_PREEMPT_CHECK_STEPS": "2",
+        "EDL_TPU_PREEMPT_CHECK_SECONDS": "1",
+        "EDL_TPU_DEMO_STEP_SLEEP": "0.25", "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    coord = spawn_subprocess(port, os.path.join(tmp, "coord"),
+                             env=dict(os.environ, EDL_TPU_TTL="1"))
+
+    def kill_tree(proc):
+        import psutil
+        try:
+            victims = psutil.Process(proc.pid).children(recursive=True)
+            victims.append(psutil.Process(proc.pid))
+        except psutil.NoSuchProcess:
+            return
+        for p in victims:
+            try:
+                p.kill()
+            except psutil.NoSuchProcess:
+                pass
+
+    def launcher(job, name, delta):
+        env = dict(os.environ)
+        env.update(env_base)
+        env["EDL_TPU_RESIZE_DELTA"] = "1" if delta else "0"
+        log = open(os.path.join(tmp, f"{name}.log"), "wb")
+        return subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.collective.launch",
+             "--job_id", job, "--coord_endpoints", ep,
+             "--nodes_range", "1:3", "--nproc_per_node", "1",
+             "--checkpoint_dir", os.path.join(tmp, f"ckpt-{job}"),
+             "--log_dir", os.path.join(tmp, f"log-{name}"), train,
+             "--", "--epochs", "200", "--steps_per_epoch", "4"],
+            env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
+
+    def one_run(job, delta, mode) -> float:
+        """Warm a 2-pod world, join a third pod, return the completed
+        resize record's detect->first-step total for ``mode``."""
+        store = connect(ep)
+        procs = [launcher(job, f"{job}-a", delta),
+                 launcher(job, f"{job}-b", delta)]
+        try:
+            ckpt = os.path.join(tmp, f"ckpt-{job}")
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                if any(d.isdigit() for d in
+                       (os.listdir(ckpt) if os.path.isdir(ckpt) else [])):
+                    break
+                if any(p.poll() is not None for p in procs):
+                    raise RuntimeError(f"{job}: launcher died in warmup")
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(f"{job}: no warmup checkpoint")
+            procs.append(launcher(job, f"{job}-c", delta))
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                recs = [s for s in summarize_recovery(store, job)
+                        if s.get("resize_mode") == mode and "total" in s]
+                if recs:
+                    return float(recs[-1]["total"])
+                time.sleep(0.3)
+            raise RuntimeError(f"{job}: no completed {mode} resize record")
+        finally:
+            for p in procs:
+                kill_tree(p)
+            store.close()
+
+    try:
+        wait_ready(ep)
+        sr = one_run("bench-resize-sr", delta=False, mode="stop_resume")
+        dl = one_run("bench-resize-dl", delta=True, mode="delta")
+        return {"resize_stop_resume_mttr_s": round(sr, 3),
+                "resize_delta_mttr_s": round(dl, 3)}
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait(timeout=30)
 
 
 def _bench_coord_outage() -> dict:

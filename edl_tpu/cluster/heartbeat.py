@@ -126,12 +126,23 @@ def write_stage_flag(store, job_id: str, name: str, stage: str,
 
 def read_stage_flag(store, job_id: str, name: str, stage: str
                     ) -> float | None:
+    info = read_stage_flag_info(store, job_id, name, stage)
+    return None if info is None else info[0]
+
+
+def read_stage_flag_info(store, job_id: str, name: str, stage: str
+                         ) -> tuple[float, str] | None:
+    """``(timestamp, flagging_pod_id)`` — the pod identity matters to
+    the delta-resize preemption flow: the DEPARTING pod's trainers
+    exit after the coordinated checkpoint while survivors reshard in
+    place, so each trainer must know whose preemption this is."""
     rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
                               f"{name}/{stage}"))
     if rec is None or not rec.value:
         return None
     try:
-        return float(rec.value.decode().split()[0])
+        parts = rec.value.decode().split()
+        return float(parts[0]), parts[1] if len(parts) > 1 else ""
     except (ValueError, IndexError):
         return None
 

@@ -27,9 +27,30 @@ from edl_tpu.cluster import heartbeat
 
 
 def flag_preempt(store, job_id: str, stage: str, pod_id: str) -> float:
-    """Record 'pod ``pod_id`` is being preempted at stage ``stage``'."""
-    return heartbeat.write_stage_flag(store, job_id, "preempt", stage,
-                                      pod_id)
+    """Record 'pod ``pod_id`` is being preempted at stage ``stage``'.
+
+    Two records: the legacy single-slot stage flag (what trainers poll
+    for the sighting, last-writer-wins) AND a per-pod marker — with
+    SIMULTANEOUS multi-pod preemptions the single slot names only one
+    pod, and a delta-resize survivor check based on it alone would
+    keep an overwritten departing pod alive (`is_pod_preempted`)."""
+    from edl_tpu.cluster import paths
+    from edl_tpu.utils import constants
+    t = heartbeat.write_stage_flag(store, job_id, "preempt", stage, pod_id)
+    store.put(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                        f"preempt_pod/{stage}/{pod_id}"),
+              repr(t).encode())
+    return t
+
+
+def is_pod_preempted(store, job_id: str, stage: str, pod_id: str) -> bool:
+    """True iff ``pod_id`` itself has a pending preemption at ``stage``
+    — robust to several pods being preempted in the same stage."""
+    from edl_tpu.cluster import paths
+    from edl_tpu.utils import constants
+    rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                              f"preempt_pod/{stage}/{pod_id}"))
+    return rec is not None and bool(rec.value)
 
 
 def get_preempt(store, job_id: str, stage: str) -> float | None:

@@ -21,11 +21,20 @@ from edl_tpu.utils import constants
 # phase name -> (begin timestamp key, end timestamp key), per record half.
 # summarize_recovery, the per-phase histogram, and the trace events are
 # all derived from these tables and the same ``times`` dicts, so the
-# store record and the trace agree by construction.
+# store record and the trace agree by construction.  A stop-resume
+# record carries detect/killed/barrier/spawn; a delta-resize record
+# (``resize_mode=delta`` — surviving trainers resharded in place,
+# collective/launcher.py) carries detect/flagged/barrier/reshard_done
+# instead, and a fallback record has BOTH flagged and killed (the delta
+# attempt is inside detect_to_kill).  Phases whose keys are absent are
+# simply skipped, so the two shapes share one write path.
 LAUNCHER_PHASES = (
     ("detect_to_kill", "detect", "killed"),
     ("kill_to_barrier", "killed", "barrier"),
     ("barrier_to_spawn", "barrier", "spawn"),
+    ("detect_to_flag", "detect", "flagged"),
+    ("flag_to_barrier", "flagged", "barrier"),
+    ("barrier_to_reshard", "barrier", "reshard_done"),
 )
 TRAINER_PHASES = (
     ("restored_to_first_step", "restored", "first_step"),
@@ -41,7 +50,9 @@ def _observe_phases(stage: str, times: dict, phases) -> None:
     tracer = obs_trace.get_tracer()
     for phase, begin, end in phases:
         if begin in times and end in times:
-            dur = times[end] - times[begin]
+            # clamp: a delta-resize FALLBACK kills trainers after its
+            # barrier, so kill_to_barrier would come out negative there
+            dur = max(0.0, times[end] - times[begin])
             RESIZE_PHASE_SECONDS.labels(phase=phase).observe(dur)
             tracer.emit(f"resize/{phase}", at=times[begin], dur=dur,
                         stage=stage)
@@ -111,27 +122,39 @@ def summarize_recovery(store, job_id: str,
         # earliest detector is the canonical launcher record; the last
         # trainer to finish its first step closes the resize
         lt = min(launchers.values(), key=lambda t: t["detect"])
+        mode = lt.get("resize_mode",
+                      "delta" if "reshard_done" in lt else "stop_resume")
         entry = {
             "stage": stage,
+            "resize_mode": mode,
             "detect_at": round(lt["detect"], 3),
-            "detect_to_kill": round(lt["killed"] - lt["detect"], 3),
-            "kill_to_barrier": round(lt["barrier"] - lt["killed"], 3),
-            "barrier_to_spawn": round(lt["spawn"] - lt["barrier"], 3),
         }
+        for phase, begin, end in LAUNCHER_PHASES:
+            if begin in lt and end in lt:
+                entry[phase] = round(max(0.0, lt[end] - lt[begin]), 3)
+        # the handoff into the trainer half: respawn for stop-resume,
+        # the in-place reshard ack for delta
+        hand = lt.get("spawn", lt.get("reshard_done"))
         if trainers:
             tt = max(trainers.values(), key=lambda t: t["first_step"])
+            if hand is not None:
+                entry["spawn_to_restored"] = round(
+                    max(0.0, tt["restored"] - hand), 3)
             entry.update({
-                "spawn_to_restored": round(tt["restored"] - lt["spawn"], 3),
                 "restored_to_first_step": round(
                     tt["first_step"] - tt["restored"], 3),
                 "total": round(tt["first_step"] - lt["detect"], 3),
             })
-            # "peer" only when EVERY pod restored from the cache — one
-            # storage fallback means the resize still paid storage
+            # "peer"/"delta" only when EVERY pod restored from the
+            # cache — one storage fallback means the resize still paid
+            # storage
             sources = {t.get("restore_source") for t in trainers.values()}
             if sources != {None}:
-                entry["restore_source"] = (
-                    "peer" if sources == {"peer"} else "storage")
+                if sources <= {"peer", "delta"}:
+                    entry["restore_source"] = (
+                        "delta" if "delta" in sources else "peer")
+                else:
+                    entry["restore_source"] = "storage"
             if kill_time is not None:
                 entry["kill_to_detect"] = round(lt["detect"] - kill_time, 3)
                 entry["total_from_kill"] = round(

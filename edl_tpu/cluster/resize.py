@@ -1,0 +1,123 @@
+"""Delta-resize handshake records: the launcher↔trainer channel that
+lets surviving trainer processes reshard in place instead of dying.
+
+Four record kinds under the ``reshard`` table (all plain puts — the
+records are stage-scoped, so a superseding resize simply writes under a
+new stage and stale records age out with the job):
+
+- ``flag/<old_stage>`` — written by any launcher the moment its watcher
+  sees a membership change with the delta path eligible.  Trainers of
+  the OLD world poll it at the preempt cadence; ``mode=grow`` asks them
+  to pause at an agreed step and commit a checkpoint first (every old
+  pod survives, so the save is complete); ``mode=shrink`` tells
+  crashed-collective survivors what is happening (no save — they roll
+  back to the last committed step, exactly like stop-resume).
+- ``go/<old_stage>`` — written post-barrier with the definitive target
+  stage.  Trainers re-form the collective world toward exactly this
+  stage's cluster record; a barrier that lands on a different stage
+  than the flag guessed is healed here.
+- ``worldsvc/<stage>`` — the jax coordination service endpoint for a
+  stage's world, bound and published by the LEADER POD'S LAUNCHER
+  (train/distributed.host_world_service): the launcher outlives every
+  trainer exit, so the rendezvous service can never die under peers
+  whose error-poll threads would terminate their processes.  Gating
+  world formation on this record is what lets each formation use a
+  FRESH port: nobody ever connects to a stale service (whose error
+  broadcast would kill them — doc/robustness.md "delta resize"
+  failure matrix).
+- ``done/<new_stage>/<pod_id>`` — written by the pod's rank-0 trainer
+  once its reshard restore completed; the launcher's wait for these is
+  the reshard barrier, and its expiry is the fallback trigger.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.utils import constants
+
+
+def _key(job_id: str, name: str) -> str:
+    return paths.key(job_id, constants.ETCD_RESHARD, name)
+
+
+# -- resize flag (detect-time, old-stage scoped) ---------------------------
+def flag_resize(store, job_id: str, old_stage: str, mode: str,
+                new_stage: str, pod_id: str) -> None:
+    """``mode``: ``"grow"`` (all old pods survive — pause-save first)
+    or ``"shrink"`` (members departed — roll back to the committed
+    step).  First write wins in spirit; every launcher writes the same
+    content, so last-writer is equivalent."""
+    store.put(_key(job_id, f"flag/{old_stage}"),
+              json.dumps({"mode": mode, "new_stage": new_stage,
+                          "pod": pod_id, "ts": time.time()}).encode())
+
+
+def read_resize_flag(store, job_id: str, old_stage: str) -> dict | None:
+    rec = store.get(_key(job_id, f"flag/{old_stage}"))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return json.loads(rec.value.decode())
+    except ValueError:
+        return None
+
+
+# -- go record (post-barrier, definitive target) ---------------------------
+def write_go(store, job_id: str, old_stage: str, new_stage: str,
+             mode: str) -> None:
+    store.put(_key(job_id, f"go/{old_stage}"),
+              json.dumps({"new_stage": new_stage, "mode": mode,
+                          "ts": time.time()}).encode())
+
+
+def read_go(store, job_id: str, old_stage: str) -> dict | None:
+    rec = store.get(_key(job_id, f"go/{old_stage}"))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return json.loads(rec.value.decode())
+    except ValueError:
+        return None
+
+
+# -- world-service record (per-stage jax coordinator endpoint) -------------
+def publish_world_service(store, job_id: str, stage: str,
+                          endpoint: str, world: int) -> None:
+    store.put(_key(job_id, f"worldsvc/{stage}"),
+              json.dumps({"endpoint": endpoint, "world": int(world),
+                          "ts": time.time()}).encode())
+
+
+def read_world_service(store, job_id: str, stage: str) -> dict | None:
+    rec = store.get(_key(job_id, f"worldsvc/{stage}"))
+    if rec is None or not rec.value:
+        return None
+    try:
+        return json.loads(rec.value.decode())
+    except ValueError:
+        return None
+
+
+# -- reshard-done records (per-pod completion acks) ------------------------
+def write_done(store, job_id: str, stage: str, pod_id: str,
+               stats: dict | None = None) -> None:
+    rec = {"ts": time.time()}
+    rec.update(stats or {})
+    store.put(_key(job_id, f"done/{stage}/{pod_id}"),
+              json.dumps(rec).encode())
+
+
+def load_done(store, job_id: str, stage: str) -> dict[str, dict]:
+    """``{pod_id: stats}`` for every pod that finished its reshard."""
+    prefix = _key(job_id, f"done/{stage}/")
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, dict] = {}
+    for rec in recs:
+        try:
+            out[rec.key[len(prefix):]] = json.loads(rec.value.decode())
+        except ValueError:
+            continue
+    return out

@@ -39,7 +39,8 @@ from edl_tpu.utils.logger import get_logger
 logger = get_logger(__name__)
 
 _RESIZES_TOTAL = obs_metrics.counter(
-    "edl_resizes_total", "Membership changes handled (stop-resume)")
+    "edl_resizes_total", "Membership changes handled, by resize path",
+    ("mode",))
 _HANG_RESTARTS_TOTAL = obs_metrics.counter(
     "edl_hang_restarts_total", "Trainer hang-watchdog restart incidents")
 
@@ -80,6 +81,10 @@ class Launcher:
         self._preempt_event = threading.Event()
         self._preempt_stage: str | None = None  # stage the flag was written for
         self._preempt_deadline: float | None = None
+        # delta resize: jax coordination services this launcher hosts
+        # (leader pod only; one per trainer-world formation, leaked for
+        # the launcher's life — train/distributed.host_world_service)
+        self._world_services: list = []
 
     def request_preempt(self) -> None:
         """SIGTERM entry (signal-handler safe: a flag and a deadline,
@@ -181,12 +186,18 @@ class Launcher:
             self._sync_pod_from(cluster)
             watcher = ClusterWatcher(self._store, job_id, cluster, self._period)
             watcher.start()
-            self._procs = train_process.start_trainers(
-                self._job_env, self._pod, cluster, self._script,
-                self._script_args, self._log_dir(),
-                extra_env=self._trainer_trace_env())
+            if not self._procs:
+                # a delta resize keeps the surviving trainer processes;
+                # every other path (initial launch, stop-resume, hang
+                # restart, fallback) arrives here with an empty list
+                self._host_world_service(cluster)
+                self._procs = train_process.start_trainers(
+                    self._job_env, self._pod, cluster, self._script,
+                    self._script_args, self._log_dir(),
+                    extra_env=self._trainer_trace_env())
             if resize_times is not None:
-                resize_times["spawn"] = time.time()
+                if "reshard_done" not in resize_times:
+                    resize_times["spawn"] = time.time()
                 # hang restarts reuse the stage; suffix the record key so
                 # the original resize record of this stage survives (the
                 # trainer half only lands for true resizes)
@@ -199,11 +210,9 @@ class Launcher:
                 watcher.stop()
             if verdict is not None:
                 return verdict
-            # membership changed: stop-resume.  Timestamp every phase —
-            # elastic recovery time is the framework's north-star metric
+            # membership changed.  Timestamp every phase — elastic
+            # recovery time is the framework's north-star metric
             # (BASELINE.md "not published: must be measured")
-            logger.info("membership changed; re-barrier + restart trainers")
-            _RESIZES_TOTAL.inc()
             resize_times = {"detect": time.time()}
             # a fresh distributed trace for this resize epoch: every
             # phase event below, the recovery-record trace events, and
@@ -220,24 +229,66 @@ class Launcher:
                 resize_times["_hang_suffix"] = \
                     f"+hang{int(self._hang_incident)}"
                 self._hang_incident = None
-            self._shutdown_trainers()
-            # a pre-resize beat must not look stale to the new stage
-            self._clear_heartbeat()
-            resize_times["killed"] = time.time()
-            old_pods = set(cluster.pod_ids())
+            old_ranking = cluster.pod_ids()
+            old_pods = set(old_ranking)
+            old_stage = cluster.stage
+            # the descale check runs BEFORE any delta flagging: a pod
+            # scaled out by the controller must never promise the old
+            # world a collective pause it cannot participate in
             if self._descaled(old_pods):
                 logger.info("scaled out of the cluster by the controller's "
                             "desired-size record; exiting cleanly")
+                self._shutdown_trainers()
                 return Status.DESCALED
+            # delta path (EDL_TPU_RESIZE_DELTA): keep surviving trainers
+            # alive — flag them to pause/reshard instead of killing them
+            delta = ("_hang_suffix" not in resize_times
+                     and self._delta_eligible(cluster, watcher.latest))
+            if delta:
+                from edl_tpu.cluster import resize as resize_rec
+                latest = watcher.latest
+                mode = ("grow" if old_pods <= set(latest.pod_ids())
+                        else "shrink")
+                logger.info("membership changed; attempting delta resize "
+                            "(%s) — trainers stay alive", mode)
+                try:
+                    resize_rec.flag_resize(self._store, job_id, old_stage,
+                                           mode, latest.stage,
+                                           self._pod.pod_id)
+                    resize_times["flagged"] = time.time()
+                except Exception:  # noqa: BLE001 — fall back below
+                    logger.exception("resize flag write failed")
+                    delta = False
+            if not delta:
+                logger.info("membership changed; re-barrier + restart "
+                            "trainers (stop-resume)")
+                self._shutdown_trainers()
+                # a pre-resize beat must not look stale to the new stage
+                self._clear_heartbeat()
+                resize_times["killed"] = time.time()
             cluster = pod_client.barrier(self._store, job_id, self._pod.pod_id,
                                          timeout=self._resize_barrier_timeout)
             resize_times["barrier"] = time.time()
             # release departed pods' data-service work (their files and
             # unconsumed batches requeue minus already-consumed spans);
-            # restarted trainers then join fresh reader generations keyed
-            # by the new stage, seeded from the restored DataCheckpoint
+            # trainers then join fresh reader generations keyed by the
+            # new stage, seeded from the restored DataCheckpoint
             for dead in old_pods - set(cluster.pod_ids()):
                 self._data_service.mark_pod_dead(dead)
+            if delta:
+                if self._delta_commit(old_stage, old_ranking, cluster,
+                                      resize_times):
+                    _RESIZES_TOTAL.labels(mode="delta").inc()
+                    resize_times["resize_mode"] = "delta"
+                    continue  # same procs supervise the new stage
+                # fallback: the proven stop-resume path, same stage
+                logger.warning("delta resize failed; falling back to "
+                               "stop-resume")
+                self._shutdown_trainers()
+                self._clear_heartbeat()
+                resize_times["killed"] = time.time()
+            _RESIZES_TOTAL.labels(mode="stop_resume").inc()
+            resize_times["resize_mode"] = "stop_resume"
 
     def _supervise(self, watcher: ClusterWatcher, cluster: Cluster
                    ) -> Status | None:
@@ -381,6 +432,7 @@ class Launcher:
                     constants.HANG_MAX_RESTARTS)
                 self._shutdown_trainers()
                 self._clear_heartbeat()
+                self._host_world_service(cluster)
                 self._procs = train_process.start_trainers(
                     self._job_env, self._pod, cluster, self._script,
                     self._script_args, self._log_dir(),
@@ -466,6 +518,121 @@ class Launcher:
         return (desired is not None and desired < len(old_pods)
                 and self._pod.rank >= desired)
 
+    def _host_world_service(self, cluster: Cluster) -> None:
+        """When delta resize is on, trainers form their jax world
+        against a launcher-hosted rendezvous service (store-gated, one
+        fresh port per formation — see train/distributed.py).  Hosted
+        by the LEADER pod's launcher, created anew for every trainer
+        spawn or reshard: a coordination service remembers task
+        incarnations, so respawned trainers can never rejoin an old
+        one.  Old services are kept referenced, never shut down (a
+        shutdown would abort any process with a pending error poll)."""
+        if not constants.RESIZE_DELTA or cluster.world_size <= 1:
+            return
+        if not cluster.pods or cluster.pods[0].pod_id != self._pod.pod_id:
+            return
+        from edl_tpu.train.distributed import host_world_service
+        try:
+            self._world_services.append(host_world_service(
+                self._store, self._job_env.job_id, cluster.stage,
+                cluster.world_size, self._pod.addr))
+        except Exception:  # noqa: BLE001 — trainers fall back on timeout
+            logger.exception("world-service hosting failed; trainers "
+                             "will time out into stop-resume")
+
+    def _delta_eligible(self, cluster: Cluster, latest: Cluster | None
+                        ) -> bool:
+        """Per-pod go/no-go for the delta path at detect time.  The
+        decision is deliberately LOCAL: a pod that opts out just kills
+        and respawns its trainers, which join the same re-formed world
+        as everyone else's surviving processes — divergent choices
+        cannot split the job."""
+        from edl_tpu import memstate
+        from edl_tpu.memstate.reshard import FALLBACKS
+        if not constants.RESIZE_DELTA or not memstate.enabled():
+            return False
+        if self._preempt_event.is_set():
+            return False  # preemption has its own checkpoint-exit flow
+        if not self._procs or \
+                train_process.watch_procs(self._procs) != Status.RUNNING:
+            FALLBACKS.labels(reason="trainer_dead").inc()
+            return False
+        if latest is None or latest.get_pod(self._pod.pod_id) is None:
+            return False  # this pod is leaving: nothing to keep alive
+        # the old world's jax coordinator lives in the rank-0 pod's
+        # trainer; its death already doomed every survivor's process
+        # (the coordination client's poll thread terminates them — see
+        # train/distributed.py), so only stop-resume can recover
+        old_leader = cluster.pods[0].pod_id if cluster.pods else None
+        if old_leader is not None and latest.get_pod(old_leader) is None:
+            FALLBACKS.labels(reason="leader_left").inc()
+            return False
+        return True
+
+    def _delta_commit(self, old_stage: str, old_ranking: list[str],
+                      cluster: Cluster, times: dict) -> bool:
+        """Post-barrier half of the delta resize: the min-delta check,
+        the go record (the trainers' definitive target), then the
+        reshard barrier — wait for this pod's trainers to ack the new
+        stage or fail.  True = the same processes now train the new
+        world; False = caller falls back to stop-resume."""
+        from edl_tpu.cluster import resize as resize_rec
+        from edl_tpu.memstate import reshard as ms_reshard
+        job_id = self._job_env.job_id
+        if constants.RESIZE_MIN_DELTA > 0:
+            try:
+                shard_map = ms_reshard.collect_shard_map(self._store, job_id)
+                plan = ms_reshard.reshard_plan(old_ranking,
+                                               cluster.pod_ids(), shard_map)
+                if plan.total_bytes and \
+                        plan.kept_fraction < constants.RESIZE_MIN_DELTA:
+                    logger.warning(
+                        "delta resize aborted: only %.0f%% of %d cached "
+                        "bytes stay local (< min %.0f%%)",
+                        plan.kept_fraction * 100, plan.total_bytes,
+                        constants.RESIZE_MIN_DELTA * 100)
+                    ms_reshard.FALLBACKS.labels(reason="min_delta").inc()
+                    return False
+            except Exception:  # noqa: BLE001 — the plan is advisory
+                logger.exception("reshard plan failed; proceeding delta")
+        mode = ("grow" if set(old_ranking) <= set(cluster.pod_ids())
+                else "shrink")
+        # the new stage's rendezvous service must exist before any
+        # trainer acts on the go record (leader-gated internally)
+        self._host_world_service(cluster)
+        try:
+            resize_rec.write_go(self._store, job_id, old_stage,
+                                cluster.stage, mode)
+        except Exception:  # noqa: BLE001
+            logger.exception("reshard go write failed")
+            ms_reshard.FALLBACKS.labels(reason="go_write").inc()
+            return False
+        deadline = time.monotonic() + constants.RESIZE_RESHARD_TIMEOUT + 10.0
+        while time.monotonic() < deadline:
+            if train_process.watch_procs(self._procs) != Status.RUNNING:
+                logger.warning("trainer exited mid-reshard")
+                ms_reshard.FALLBACKS.labels(reason="trainer_exit").inc()
+                return False
+            try:
+                done = resize_rec.load_done(self._store, job_id,
+                                            cluster.stage)
+            except Exception:  # noqa: BLE001 — store blip: keep polling
+                logger.exception("reshard done poll failed")
+                done = {}
+            if self._pod.pod_id in done:
+                times["reshard_done"] = time.time()
+                stats = done[self._pod.pod_id]
+                logger.info("delta resize complete: stage %s in %.2fs "
+                            "(restore source=%s)", cluster.stage[:8],
+                            stats.get("seconds", -1.0),
+                            stats.get("source", "?"))
+                return True
+            time.sleep(min(0.2, self._period))
+        logger.warning("reshard barrier timed out after %.0fs",
+                       constants.RESIZE_RESHARD_TIMEOUT)
+        ms_reshard.FALLBACKS.labels(reason="timeout").inc()
+        return False
+
     def _sync_pod_from(self, cluster: Cluster) -> None:
         me = cluster.get_pod(self._pod.pod_id)
         assert me is not None, "barrier returned a cluster without this pod"
@@ -489,10 +656,14 @@ class Launcher:
                                          self._stage_ctx, stage=stage)
 
     def _trainer_trace_env(self) -> dict[str, str]:
-        """Env for spawned trainers: the current stage's trace context,
-        so the whole trainer process (restore spans, first-step record)
-        joins this resize epoch's trace."""
-        return {obs_context.ENV_VAR: self._stage_ctx.to_env()}
+        """Env for spawned trainers: the current stage's trace context
+        (so the whole trainer process joins this resize epoch's trace)
+        plus the spawn timestamp — a resizable-world trainer refuses
+        any worldsvc record older than its own spawn, so a same-stage
+        respawn can never rendezvous with the previous formation's
+        leaked service (train/distributed._form_resizable_world)."""
+        return {obs_context.ENV_VAR: self._stage_ctx.to_env(),
+                "EDL_TPU_SPAWN_TS": repr(time.time())}
 
     def _write_recovery(self, stage: str, times: dict) -> None:
         """Launcher half of the resize timing record (the trainer adds

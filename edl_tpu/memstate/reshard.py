@@ -1,0 +1,179 @@
+"""Placement diff for delta resize: which cached shards must MOVE when
+the pod set changes, and from where.
+
+The stop-resume path re-fetches every pod's whole share on every
+membership change even though most shard bytes already sit on surviving
+hosts (the Gemini observation memstate/placement.py borrowed).  This
+module is the pure half of the fix: diff the old-mesh vs new-mesh shard
+placements and plan a move for ONLY the shards whose owner changed —
+the runtime then serves unchanged-owner shards from local RAM
+(memstate/restore.py's ``local=`` source) and moves the rest over the
+PR-5 streaming plane.
+
+Ownership model: a shard's *owner* is the pod whose trainers produced
+it (the manifest's owner — where its bytes live).  Rank assignment is
+STABLE across resizes (collective/generator.py keeps survivors in
+order and appends joiners), so a surviving owner keeps its shards and
+nothing moves for it; only departed owners' shards need a new home.
+The source for a moved shard is the departed owner's ring replica
+(placement.replica_for over the OLD pod set — where the replication
+protocol actually put the copy), when that replica survives.
+
+Everything here is a pure function of its inputs — the launcher uses
+it for the go/no-go min-delta decision and the ``edl_reshard_*``
+accounting, tests pin it directly, and the byte-exact movement at
+restore time falls out of the same manifests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from edl_tpu.memstate import placement
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+BYTES_MOVED = obs_metrics.counter(
+    "edl_reshard_bytes_moved_total",
+    "Delta-resize bytes planned to move between pods (changed owner)")
+BYTES_KEPT = obs_metrics.counter(
+    "edl_reshard_bytes_kept_total",
+    "Delta-resize bytes that stayed on their surviving owner")
+SHARDS_MOVED = obs_metrics.counter(
+    "edl_reshard_shards_moved_total", "Delta-resize shards planned to move")
+SHARDS_TOTAL = obs_metrics.counter(
+    "edl_reshard_shards_total",
+    "Cached shards examined by delta-resize placement diffs")
+FALLBACKS = obs_metrics.counter(
+    "edl_reshard_fallbacks_total",
+    "Delta resizes that fell back to stop-resume, by reason", ("reason",))
+
+
+@dataclass
+class Move:
+    """One shard that changed owner: fetch from ``src`` (the surviving
+    ring replica of the departed owner; None = no surviving copy, the
+    restore must stripe from whoever advertises it or fall back to
+    storage) for the pod now seated at the departed owner's rank."""
+
+    key: str
+    nbytes: int
+    old_owner: str
+    new_owner: str
+    src: str | None
+
+
+@dataclass
+class ReshardPlan:
+    ranking: list[str] = field(default_factory=list)  # canonical new ranks
+    moves: list[Move] = field(default_factory=list)
+    kept: list[str] = field(default_factory=list)     # unchanged-owner keys
+    moved_bytes: int = 0
+    kept_bytes: int = 0
+    shards_total: int = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return self.moved_bytes + self.kept_bytes
+
+    @property
+    def kept_fraction(self) -> float:
+        """Fraction of cached bytes that do NOT move — the locality the
+        delta path exists to exploit (1.0 on pure grow)."""
+        total = self.total_bytes
+        return 1.0 if total == 0 else self.kept_bytes / total
+
+
+def stable_ranking(old_pods, new_pods) -> list[str]:
+    """Canonical rank order for the new pod set: survivors keep their
+    OLD relative order (the generator's contract — a surviving pod's
+    mesh seat is stable), joiners append in sorted order.  Pure
+    function of the two sets: permuting either input's enumeration
+    order never changes the answer, which is what makes every pod's
+    independently computed plan identical."""
+    old = list(dict.fromkeys(old_pods))          # de-dup, keep order
+    new = set(new_pods)
+    survivors = [p for p in old if p in new]
+    joiners = sorted(p for p in new if p not in set(old))
+    return survivors + joiners
+
+
+def reshard_plan(old_pods, new_pods, shards: dict) -> ReshardPlan:
+    """Diff old-mesh vs new-mesh shard placement.
+
+    ``old_pods``: the old cluster's pod ids in rank order (enumeration
+    order beyond survivors' relative order does not matter).
+    ``new_pods``: the new membership, any order.
+    ``shards``: ``{key: entry}`` manifest-shaped entries; only
+    ``entry["owner"]`` (the pod holding the bytes) and
+    ``entry["nbytes"]`` are read, so cache manifests pass straight in.
+
+    A shard moves iff its owner departed; its new owner is the pod that
+    assumes the departed owner's rank in the canonical new ranking
+    (rank compaction wraps: with fewer pods than the departed rank, the
+    seat folds onto ``rank % len(new)`` — the same pod every caller
+    computes).  Unchanged-owner shards are listed in ``kept`` and cost
+    zero wire bytes at restore time.
+    """
+    old = list(dict.fromkeys(old_pods))
+    ranking = stable_ranking(old, new_pods)
+    new_set = set(ranking)
+    old_rank = {p: i for i, p in enumerate(old)}
+    plan = ReshardPlan(ranking=ranking)
+    for key in sorted(shards):
+        ent = shards[key]
+        owner = ent["owner"]
+        nbytes = int(ent.get("nbytes", 0))
+        plan.shards_total += 1
+        if owner in new_set:
+            plan.kept.append(key)
+            plan.kept_bytes += nbytes
+            continue
+        seat = old_rank.get(owner, 0) % max(1, len(ranking))
+        new_owner = ranking[seat] if ranking else ""
+        replica = placement.replica_for(owner, old)
+        src = replica if replica in new_set else None
+        plan.moves.append(Move(key=key, nbytes=nbytes, old_owner=owner,
+                               new_owner=new_owner, src=src))
+        plan.moved_bytes += nbytes
+    return plan
+
+
+def collect_shard_map(store, job_id: str, endpoints: dict[str, str] | None
+                      = None) -> dict:
+    """Manifest union across live cache adverts at the committed step:
+    ``{key: {"owner", "nbytes"}}`` — the ``shards`` input to
+    :func:`reshard_plan`.  Only owner-held sets are counted (a ring
+    replica of the same set is a COPY of the same keys, not extra
+    bytes).  Best-effort: an unreachable peer just contributes nothing,
+    exactly like it would at restore time."""
+    from edl_tpu.memstate import advert
+    from edl_tpu.rpc.client import RpcClient
+
+    committed = advert.read_committed_step(store, job_id)
+    if committed is None:
+        return {}
+    if endpoints is None:
+        endpoints = advert.list_adverts(store, job_id)
+    shards: dict = {}
+    for pod, ep in endpoints.items():
+        client = None
+        try:
+            client = RpcClient(ep)
+            listing = client.call("cache_manifest")
+        except Exception as e:  # noqa: BLE001 — a dead peer contributes
+            # nothing, exactly like it would at restore time
+            logger.debug("manifest probe of %s failed (%s)", pod[:8], e)
+            continue
+        finally:
+            if client is not None:
+                client.close()
+        for owner, info in listing.items():
+            if owner != pod or info.get("step") != committed:
+                continue  # replica copy or stale set
+            for key, ent in info["shards"].items():
+                shards[key] = {"owner": owner,
+                               "nbytes": int(ent.get("nbytes", 0))}
+    return shards

@@ -62,14 +62,26 @@ def _miss(reason: str) -> None:
     logger.info("memstate: cache miss (%s); falling back to storage", reason)
 
 
+# sentinel pod name for the in-RAM local source a live reshard injects:
+# shards the resizing trainer already holds are served from its own
+# host snapshot at zero wire cost (memstate/reshard.py's delta story)
+LOCAL_POD = "__local__"
+
+
 def try_restore(store, job_id: str, abstract_state,
-                expect_step: int | None = None):
+                expect_step: int | None = None, local: dict | None = None,
+                prefer_pod: str | None = None):
     """Returns ``(state, meta_json_str, info)`` or None (= use storage).
 
     ``abstract_state``: pytree of ShapeDtypeStructs WITH target
     shardings (the trainer's AOT skeleton for the new mesh).
     ``expect_step``: the storage's latest committed step — a cached set
     at any other step is stale by definition and refused.
+    ``local``: optional ``{key: (manifest_entry, buffer)}`` in-RAM
+    source at the committed step (a live reshard's host snapshot);
+    keys it covers never touch the wire.  ``prefer_pod``: holder tried
+    first after the local source (the restoring pod's OWN cache — a
+    loopback fetch beats any LAN peer).
     """
     import jax
 
@@ -82,7 +94,7 @@ def try_restore(store, job_id: str, abstract_state,
         _miss("stale")
         return None
     endpoints = advert.list_adverts(store, job_id)
-    if not endpoints:
+    if not endpoints and not local:
         _miss("no_adverts")
         return None
 
@@ -94,6 +106,9 @@ def try_restore(store, job_id: str, abstract_state,
         # candidates so one bad/corrupt holder doesn't fail the restore
         holders: dict[str, list[tuple[str, dict, str]]] = {}
         meta_holders: list[tuple[str, str]] = []  # (pod, owner)
+        local = local or {}
+        for key, (ent, _buf) in local.items():
+            holders.setdefault(key, []).append((LOCAL_POD, ent, LOCAL_POD))
         for pod, ep in endpoints.items():
             try:
                 pools[pod] = RpcChannelPool(ep)
@@ -113,8 +128,10 @@ def try_restore(store, job_id: str, abstract_state,
             return None
 
         info = {"step": committed, "shards": 0, "bytes": 0,
+                "local_bytes": 0, "wire_bytes": 0,
                 "peers": sorted({p for hs in holders.values()
-                                 for p, _, _ in hs})}
+                                 for p, _, _ in hs if p != LOCAL_POD})}
+        local_served: set = set()
         leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
 
         # pass 1 — PLAN: which manifest shards does this process's
@@ -148,23 +165,30 @@ def try_restore(store, job_id: str, abstract_state,
             nonlocal batch, batch_bytes
             sub = {key: jobs[key] for _ln, _lf, _nd, overl in batch
                    for key in overl}
-            fetched = _fetch_all(sub, pools)
+            fetched = _fetch_all(sub, pools, local=local,
+                                 prefer_pod=prefer_pod,
+                                 local_served=local_served)
             if fetched is None:
                 _miss("shard_unavailable")
                 return False
-            for data in fetched.values():
+            for key, data in fetched.items():
                 info["shards"] += 1
                 info["bytes"] += len(data)
-                _FETCHED.inc(len(data))
+                if key in local_served:
+                    info["local_shards"] = info.get("local_shards", 0) + 1
+                    info["local_bytes"] += len(data)
+                else:
+                    info["wire_bytes"] += len(data)
+                    _FETCHED.inc(len(data))
             for leaf_name, leaf, needed, overl in batch:
-                local = _assemble_leaf(leaf_name, leaf, needed, overl,
-                                       jobs, fetched)
-                if local is None:
+                assembled = _assemble_leaf(leaf_name, leaf, needed, overl,
+                                           jobs, fetched)
+                if assembled is None:
                     return False  # _assemble_leaf counted the reason
                 gshape = tuple(int(d) for d in leaf.shape)
                 out_leaves.append(jax.make_array_from_callback(
                     leaf.shape, leaf.sharding,
-                    lambda idx, a=local, g=gshape: a[_norm_box(idx, g)]))
+                    lambda idx, a=assembled, g=gshape: a[_norm_box(idx, g)]))
             batch, batch_bytes = [], 0
             return True
 
@@ -291,7 +315,8 @@ def _intersect(a: tuple, b: tuple):
     return tuple(out)
 
 
-def _fetch_all(jobs, pools) -> dict | None:
+def _fetch_all(jobs, pools, local=None, prefer_pod=None,
+               local_served=None) -> dict | None:
     """Every planned shard, fetched concurrently on a bounded worker
     pool: ``{key: bytes-like}`` (each CRC-verified) or None when any
     shard could not be served by any holder.  The first unservable
@@ -312,8 +337,15 @@ def _fetch_all(jobs, pools) -> dict | None:
 
     def fetch_one(kv):
         key, (ent, cands) = kv
+        if local and key in local:
+            # the in-RAM source: the resizing trainer already holds
+            # these bytes — zero wire cost, the delta-resize fast path
+            if local_served is not None:
+                local_served.add(key)
+            return local[key][1]
         data = None if abort.is_set() \
-            else _fetch_shard(key, ent, cands, pools, abort)
+            else _fetch_shard(key, ent, cands, pools, abort,
+                              prefer_pod=prefer_pod)
         if data is None:
             abort.set()
         return data
@@ -332,12 +364,14 @@ def _fetch_all(jobs, pools) -> dict | None:
     return results
 
 
-def _fetch_shard(key, ent, candidates, pools, abort=None):
+def _fetch_shard(key, ent, candidates, pools, abort=None, prefer_pod=None):
     """One shard's bytes, CRC-verified against the manifest, or None
     when every holder path is exhausted (or ``abort`` was set by a
     sibling shard's failure).  Large shards stripe across all live
     holders; any striped failure (including a whole-blob CRC mismatch)
-    falls back to trying each holder alone."""
+    falls back to trying each holder alone.  ``prefer_pod`` (the
+    restoring pod itself during a live reshard) is tried first on the
+    single-holder path — loopback beats the LAN."""
     from edl_tpu.rpc import transfer
 
     nbytes = int(ent["nbytes"])
@@ -348,6 +382,8 @@ def _fetch_shard(key, ent, candidates, pools, abort=None):
             live.append((pod, owner))
     if not live:
         return None
+    if prefer_pod is not None:
+        live.sort(key=lambda po: po[0] != prefer_pod)  # stable: own pod first
     owner_of = dict(live)
     t0 = time.perf_counter()
     if nbytes >= constants.STRIPE_MIN_BYTES and len(live) >= 2:

@@ -42,9 +42,12 @@ import sys
 from edl_tpu.cluster.recovery import summarize_recovery
 from edl_tpu.obs.collector import collect_row
 
-# render order: the chronological phase chain, then the totals
+# render order: the chronological phase chain, then the totals —
+# stop-resume phases first, then the delta-resize phases (a record
+# carries one shape or the other; a fallback carries parts of both)
 PHASE_ORDER = ("kill_to_detect", "detect_to_kill", "kill_to_barrier",
-               "barrier_to_spawn", "spawn_to_restored",
+               "barrier_to_spawn", "detect_to_flag", "flag_to_barrier",
+               "barrier_to_reshard", "spawn_to_restored",
                "restored_to_first_step", "total", "total_from_kill")
 
 
@@ -71,8 +74,11 @@ def render_report(report: dict) -> str:
         done = "" if "total" in s else "  [launcher half only]"
         src = (f"  restore_source={s['restore_source']}"
                if "restore_source" in s else "")
+        mode = (f"  mode={s['resize_mode']}"
+                if s.get("resize_mode", "stop_resume") != "stop_resume"
+                else "")
         lines.append(f"  resize {s['stage']} @ {s['detect_at']:.3f}"
-                     f"{done}{src}")
+                     f"{done}{mode}{src}")
         for phase in PHASE_ORDER:
             if phase in s:
                 lines.append(f"    {phase:<24} {s[phase]:>9.3f}s")

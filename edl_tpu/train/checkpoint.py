@@ -196,3 +196,46 @@ class CheckpointManager:
             self._tee.mark_committed()
             self._tee.close()
         self._mngr.close()
+
+    def abandon(self):
+        """Detach for a live reshard: stop the (local-only, safe) tee
+        and hand back the raw Orbax manager WITHOUT closing it — in a
+        multiprocess world close/wait can barrier against a collective
+        world that no longer exists (a dead peer mid-shrink).  The
+        caller must keep the returned object referenced so GC never
+        runs its teardown either; a fresh CheckpointManager over the
+        same directory takes over (train/trainer._live_reshard)."""
+        if self._tee is not None:
+            try:
+                self._tee.close()
+            except Exception:  # noqa: BLE001 — cache is best-effort
+                logger.exception("tee close during abandon failed")
+            self._tee = None
+        mngr, self._mngr = self._mngr, None
+        return mngr
+
+
+def reset_multihost_counters() -> None:
+    """Align Orbax's process-local barrier-name counters across a world
+    whose members have divergent histories.
+
+    Orbax derives multihost barrier names from module-level
+    ``itertools.count()`` counters (one tick per AsyncCheckpointer
+    construction, per save, per tmp directory, ...).  They normally
+    advance in lockstep on every process; after a LIVE reshard the
+    survivors have ticked them many times while a freshly spawned
+    joiner starts at zero — their barrier names would never match and
+    the first collective checkpoint op would die on
+    ``sync_global_devices name mismatch``.  Survivors therefore reset
+    every counter before constructing their post-reshard manager,
+    restoring lockstep with the joiners by construction."""
+    import itertools
+    try:
+        from orbax.checkpoint.multihost import counters
+    except Exception:  # noqa: BLE001 — older orbax: nothing to reset
+        logger.exception("orbax counters module unavailable; multihost "
+                         "checkpoint barriers may mismatch after reshard")
+        return
+    for name, value in list(vars(counters).items()):
+        if isinstance(value, itertools.count):
+            setattr(counters, name, itertools.count())

@@ -71,6 +71,44 @@ _EPOCHS_TOTAL = obs_metrics.counter(
 LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[Any, dict]]]
 
 
+# abandoned pre-reshard checkpoint managers: referenced forever so GC
+# can never run their teardown (which may barrier against a dead world)
+_ABANDONED_CKPTS: list = []
+
+
+@dataclass
+class _ReshardPayload:
+    """Host-side hand-off from the epoch loop to the live reshard:
+    nothing in here may reference a device array (the old backend is
+    about to be torn down)."""
+
+    mode: str                    # "grow" (paused+saved) | "shrink" (rollback)
+    local: dict | None = None    # {key: (manifest_entry, bytes_view)} at step
+    step: int | None = None      # the paused step (grow only)
+
+
+class _LiveReshard(Exception):
+    """Raised at a step boundary to unwind the epoch loop into
+    ``ElasticTrainer._live_reshard`` (EDL_TPU_RESIZE_DELTA=1): the
+    process survives the membership change and re-forms the collective
+    world in place instead of dying into a stop-resume."""
+
+    def __init__(self, payload: _ReshardPayload):
+        super().__init__(payload.mode)
+        self.payload = payload
+
+
+@dataclass
+class _LeafSpec:
+    """Mesh-independent skeleton of one state leaf (deliberately NOT a
+    registered pytree — it must ride tree.map as a leaf): enough to
+    rebuild the abstract restore target against ANY new mesh."""
+
+    shape: tuple
+    dtype: Any
+    spec: Any                    # PartitionSpec (mesh-free by design)
+
+
 @dataclass
 class TrainConfig:
     mesh_spec: MeshSpec = field(default_factory=MeshSpec)
@@ -104,6 +142,15 @@ class ElasticTrainer:
         # trainer is where the per-process observability surfaces attach
         from edl_tpu import obs
         obs.install_from_env("trainer")
+        # SIGUSR1 -> all-thread stack dump on stderr (the workerlog):
+        # the first diagnostic anyone needs for a trainer that hangs in
+        # a collective — the hang watchdog can only say THAT it hangs
+        try:
+            import faulthandler
+            import signal as _signal
+            faulthandler.register(_signal.SIGUSR1, all_threads=True)
+        except (ImportError, AttributeError, ValueError):
+            pass  # non-main thread / platform without SIGUSR1
         if tenv is not None and tenv.pod_id:
             # under the launcher, stderr IS the workerlog: install the
             # edl_tpu log handler (idempotent) so restore/preempt/
@@ -123,29 +170,44 @@ class ElasticTrainer:
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
         self.rules = self.cfg.rules
         self.adjust = AdjustRegistry()
-        # under the elastic launcher, committed saves tee into the pod's
-        # in-RAM peer checkpoint cache (memstate) so a post-resize
-        # restore can come from surviving hosts instead of storage
-        tee = None
-        if (self.cfg.checkpoint_dir and store is not None
-                and tenv is not None and tenv.pod_id):
-            from edl_tpu import memstate
-            if memstate.enabled():
-                try:
-                    tee = memstate.StateCacheTee(store, tenv.job_id,
-                                                 tenv.pod_id)
-                except Exception:  # noqa: BLE001 — cache is best-effort
-                    logger.exception("memstate tee unavailable")
-        self.ckpt = (CheckpointManager(self.cfg.checkpoint_dir,
-                                       self.cfg.max_to_keep, tee=tee)
-                     if self.cfg.checkpoint_dir else None)
+        self.ckpt = self._build_ckpt()
         self._step_fn = None
         self._t_restored: float | None = None  # recovery instrumentation
-        self._restore_source: str | None = None  # "peer" | "storage"
+        self._restore_source: str | None = None  # "peer"|"storage"|"delta"
+        # delta-resize machinery (EDL_TPU_RESIZE_DELTA): the launcher's
+        # resize flag is polled on the preempt cadence; _state_spec is
+        # the mesh-free skeleton a live reshard rebuilds against
+        self._reshard_seen = False
+        self._state_spec = None
         # id -> (metric_fn, jitted): holding metric_fn pins its id so a
         # recycled id can never alias a different function; bounded so
         # fresh closures per call can't leak jitted executables forever
         self._eval_cache: OrderedDict[int, tuple[Any, Any]] = OrderedDict()
+
+    def _build_ckpt(self) -> CheckpointManager | None:
+        """Construct the checkpoint manager (+ memstate tee).  Called at
+        init AND after every live reshard: in a multiprocess world the
+        manager's construction runs a world-wide sync, so survivors must
+        construct a FRESH one right after re-forming the world — pairing
+        with the construction sync of any freshly spawned joiner."""
+        if not self.cfg.checkpoint_dir:
+            return None
+        # under the elastic launcher, committed saves tee into the pod's
+        # in-RAM peer checkpoint cache (memstate) so a post-resize
+        # restore can come from surviving hosts instead of storage
+        tee = None
+        if self.store is not None and self.tenv is not None \
+                and self.tenv.pod_id:
+            from edl_tpu import memstate
+            if memstate.enabled():
+                try:
+                    tee = memstate.StateCacheTee(self.store,
+                                                 self.tenv.job_id,
+                                                 self.tenv.pod_id)
+                except Exception:  # noqa: BLE001 — cache is best-effort
+                    logger.exception("memstate tee unavailable")
+        return CheckpointManager(self.cfg.checkpoint_dir,
+                                 self.cfg.max_to_keep, tee=tee)
 
     # -- state construction --------------------------------------------------
     def _build_fn(self, init_fn, tx, param_logical):
@@ -318,13 +380,57 @@ class ElasticTrainer:
         if self._run_t0 is None:
             self._run_t0 = time.monotonic()
         self._report(TrainStatus.RUNNING)
-        for epoch in range(meta.next_epoch, epochs):
-            if epochs - epoch <= self.cfg.near_end_epochs:
-                self._report(TrainStatus.NEARTHEEND)
-            # per-epoch fold so dropout/augmentation differ across epochs
-            state, meta = self._run_epoch(state, meta, data_fn, epoch,
-                                          jax.random.fold_in(rng, epoch),
-                                          on_epoch_end)
+        self._capture_state_spec(state)
+        while True:
+            payload = crash = None
+            try:
+                for epoch in range(meta.next_epoch, epochs):
+                    if epochs - epoch <= self.cfg.near_end_epochs:
+                        self._report(TrainStatus.NEARTHEEND)
+                    # per-epoch fold so dropout/augmentation differ
+                    # across epochs
+                    state, meta = self._run_epoch(
+                        state, meta, data_fn, epoch,
+                        jax.random.fold_in(rng, epoch), on_epoch_end)
+                break
+            except _LiveReshard as sig:
+                payload = sig.payload
+            except Exception as exc:  # noqa: BLE001 — maybe a dying peer
+                # a peer pod's death surfaces as a failed collective
+                # seconds before the membership change is visible; with
+                # the delta path on, convert the crash into a rollback
+                # reshard instead of dying into a stop-resume.  The
+                # traceback is formatted then DROPPED: its frames pin
+                # the epoch's device arrays, which pin the old backend,
+                # whose open sockets keep blocked peers hanging
+                if not self._delta_ready():
+                    raise
+                import traceback as _tb
+                crash = "".join(_tb.format_exception(
+                    type(exc), exc, exc.__traceback__))
+                # clear the WHOLE cause/context chain: any link's
+                # traceback pins the failing frames just as well
+                link, hops = exc, 0
+                while link is not None and hops < 20:
+                    link.__traceback__ = None
+                    nxt = link.__cause__ or link.__context__
+                    link.__cause__ = link.__context__ = None
+                    link, hops = nxt, hops + 1
+                crash_exc = exc
+            # nothing below may hold device arrays: the payload is
+            # host-side and the except blocks above released their
+            # frames.  The rng crosses the teardown as host bytes
+            try:
+                rng_data, typed_key = np.asarray(
+                    jax.random.key_data(rng)), True
+            except Exception:  # noqa: BLE001 — old-style raw uint32 key
+                rng_data, typed_key = np.asarray(rng), False
+            state = rng = None
+            if crash is not None:
+                payload = self._reshard_on_failure(crash_exc, crash)
+            state, meta = self._live_reshard(payload, meta)
+            rng = (jax.random.wrap_key_data(jax.numpy.asarray(rng_data))
+                   if typed_key else jax.numpy.asarray(rng_data))
         if self.ckpt is not None:
             self.ckpt.wait()
         self._report(TrainStatus.SUCCEED)
@@ -625,44 +731,99 @@ class ElasticTrainer:
         # only rank-0-in-pod reads the store (the _heartbeat convention
         # — N identical reads per pod would be pure traffic); the
         # allgather below fans a single sighting out to every process
-        if (not self._preempt_seen and self.store is not None
-                and self.tenv.rank_in_pod == 0):
-            from edl_tpu.cluster import preempt
-            try:
-                self._preempt_seen = preempt.get_preempt(
-                    self.store, self.tenv.job_id,
-                    self.tenv.cluster_stage) is not None
-            except Exception:  # noqa: BLE001 — a store blip is not a preempt
-                logger.exception("preempt flag read failed")
+        if self.store is not None and self.tenv.rank_in_pod == 0:
+            if not self._preempt_seen:
+                from edl_tpu.cluster import preempt
+                try:
+                    self._preempt_seen = preempt.get_preempt(
+                        self.store, self.tenv.job_id,
+                        self.tenv.cluster_stage) is not None
+                except Exception:  # noqa: BLE001 — a blip is not a preempt
+                    logger.exception("preempt flag read failed")
+            if not self._reshard_seen and self._delta_ready():
+                from edl_tpu.cluster import resize as resize_rec
+                try:
+                    flag = resize_rec.read_resize_flag(
+                        self.store, self.tenv.job_id,
+                        self.tenv.cluster_stage)
+                    # ONLY a grow flag starts the cooperative pause: it
+                    # runs a collective save, which is safe iff every
+                    # old-world member is alive.  A shrink flag means a
+                    # member is already gone — any op started now would
+                    # hang (gloo never errors post-death ops); shrink
+                    # delta rides the preemption flow or the in-flight
+                    # crash conversion instead
+                    self._reshard_seen = (flag is not None
+                                          and flag.get("mode") == "grow")
+                except Exception:  # noqa: BLE001 — a blip is not a resize
+                    logger.exception("resize flag read failed")
         agreed = self._preempt_seen
+        reshard = self._reshard_seen and self._delta_ready()
         if multi:
-            # ONE allgather carries both the sighting and this process's
+            # ONE allgather carries the two sightings and this process's
             # cadence proposal (steps ~= PREEMPT_CHECK_SECONDS of wall
-            # time, from the step-time EMA); max() of each half is the
-            # same on every process, so sighting fan-out and next-check
-            # agreement cost a single collective
+            # time, from the step-time EMA); max()/any() of each part is
+            # the same on every process, so sighting fan-out and
+            # next-check agreement cost a single collective
             proposal = _c.PREEMPT_CHECK_STEPS
             if self._step_ema:
                 proposal = round(
                     _c.PREEMPT_CHECK_SECONDS / max(self._step_ema, 1e-4))
-            # pack sighting + proposal into one int32: proposal must
-            # stay under the sighting's radix whatever the env says
+            # pack sightings + proposal into one int32: proposal must
+            # stay under the sightings' radix whatever the env says
             proposal = max(1, min(999_999, proposal))
             from edl_tpu.parallel.sharding import allgather_flag
             packed = allgather_flag(
-                int(self._preempt_seen) * 1_000_000 + proposal)
-            agreed = bool((packed // 1_000_000).any())
+                (int(self._preempt_seen) * 2 + int(reshard)) * 1_000_000
+                + proposal)
+            bits = packed // 1_000_000
+            agreed = bool((bits // 2).any())
+            reshard = bool((bits % 2).any())
             self._preempt_next_check = step + int((packed % 1_000_000).max())
         if not agreed:
+            if reshard:
+                # delta resize: the whole old world agreed to pause at
+                # THIS step — commit a checkpoint here (the save is
+                # collective, hence the agreement), snapshot the local
+                # shards and unwind into the live reshard.  Preemption
+                # wins when both are flagged: a preempted world must
+                # still exit through its checkpoint.
+                self._pause_for_reshard(state, meta, step)
             return
-        logger.warning("preemption flagged: checkpointing at step %d and "
-                       "exiting %d", step, _c.PREEMPT_EXIT_CODE)
+        logger.warning("preemption flagged: checkpointing at step %d",
+                       step)
         if self.ckpt is not None:
             meta.step = step
             self._sync_data_checkpoint(meta)
             self.ckpt.save(step, state, meta, force=True)
             self.ckpt.wait()
             logger.info("preempt: checkpoint committed at step %d", step)
+        # delta resize (controlled shrink): while the WHOLE old world is
+        # still alive — the only moment collectives are guaranteed not
+        # to hang — survivors snapshot their shards; after the commit
+        # barrier below, the preempted pod's trainers exit as always and
+        # the survivors unwind into a live reshard instead of exiting.
+        # (Crash shrinks can't do this: gloo never errors an op STARTED
+        # after a peer death, so stop-resume reaps those.)
+        survive = None
+        if self._delta_ready() and self.store is not None:
+            try:
+                from edl_tpu.cluster import preempt
+                # per-pod check, NOT the single-slot flag's pod id:
+                # with several pods preempted at once the slot names
+                # only the last writer, and a departing pod that
+                # misread itself as a survivor would never exit
+                if not preempt.is_pod_preempted(
+                        self.store, self.tenv.job_id,
+                        self.tenv.cluster_stage, self.tenv.pod_id):
+                    from edl_tpu.memstate import shards as ms_shards
+                    shard_list, manifest = ms_shards.snapshot(state)
+                    survive = {key: (manifest[key], _bytes_view(arr))
+                               for key, arr in shard_list}
+            except Exception:  # noqa: BLE001 — fall back to the exit
+                logger.exception("preempt-survivor snapshot failed; "
+                                 "taking the stop-resume exit")
+                survive = None
         if jax.process_count() > 1:
             # every process's save must COMMIT before any process
             # leaves: the first abrupt exit trips the coordination
@@ -671,6 +832,12 @@ class ElasticTrainer:
             # 1 while its shards were still writing)
             from edl_tpu.parallel.sharding import allgather_flag
             allgather_flag(1)
+        if survive is not None:
+            logger.warning("peer preempted: surviving in place — "
+                           "unwinding into a live reshard")
+            raise _LiveReshard(_ReshardPayload(mode="shrink",
+                                               local=survive, step=step))
+        logger.warning("preempt: exiting %d", _c.PREEMPT_EXIT_CODE)
         # os._exit, NOT SystemExit: normal teardown runs jax's atexit
         # distributed shutdown, whose barrier hangs the coordinator-
         # hosting rank once a peer (exiting by the same agreement, a
@@ -699,6 +866,283 @@ class ElasticTrainer:
         if jax.process_count() > 1:
             from edl_tpu.data.elastic_input import sync_checkpoint
             sync_checkpoint(meta.data_checkpoint)
+
+    # -- delta resize: live reshard instead of stop-resume -------------------
+    def _delta_ready(self) -> bool:
+        """Can THIS trainer take the delta path?  Needs the knob, the
+        launcher context, a checkpoint manager (the pause-save and the
+        rollback target) and a capturable state skeleton."""
+        from edl_tpu.utils import constants as _c
+        return bool(_c.RESIZE_DELTA and self.ckpt is not None
+                    and self.store is not None and self.tenv is not None
+                    and self.tenv.pod_id and self.tenv.cluster_stage
+                    and self._state_spec is not None)
+
+    def _capture_state_spec(self, state) -> None:
+        """Mesh-free skeleton of ``state`` (shape/dtype/PartitionSpec
+        per array leaf) captured while the arrays are alive — a live
+        reshard rebuilds the abstract restore target from it against
+        the NEW mesh.  A state with non-NamedSharding array leaves
+        can't be re-specced; _delta_ready then keeps this trainer on
+        the stop-resume path."""
+        from jax.sharding import NamedSharding
+
+        def one(leaf):
+            if not hasattr(leaf, "sharding"):
+                return leaf  # static/non-array leaf: carried verbatim
+            if not isinstance(leaf.sharding, NamedSharding):
+                raise TypeError(f"non-NamedSharding leaf {type(leaf)}")
+            return _LeafSpec(tuple(int(d) for d in leaf.shape),
+                             leaf.dtype, leaf.sharding.spec)
+
+        try:
+            self._state_spec = jax.tree.map(one, state)
+        except Exception:  # noqa: BLE001 — delta path disabled, not fatal
+            logger.exception("state spec capture failed; delta resize "
+                             "disabled for this trainer")
+            self._state_spec = None
+
+    def _respec(self):
+        """The abstract restore target on the CURRENT (new) mesh."""
+        mesh = self.mesh
+
+        def one(leaf):
+            if not isinstance(leaf, _LeafSpec):
+                return leaf
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype,
+                sharding=NamedSharding(mesh, leaf.spec))
+
+        return jax.tree.map(one, self._state_spec,
+                            is_leaf=lambda x: isinstance(x, _LeafSpec))
+
+    def _pause_for_reshard(self, state, meta, step: int) -> None:
+        """The cooperative (grow) pause: commit a world-wide checkpoint
+        at the agreed step, host-snapshot this process's shards (the
+        zero-wire local source for the reshard restore) and unwind.
+        Raises :class:`_LiveReshard`; never returns."""
+        logger.warning("delta resize flagged: pausing at step %d for a "
+                       "live reshard", step)
+        meta.step = step
+        self._sync_data_checkpoint(meta)
+        self.ckpt.save(step, state, meta, force=True)
+        # wait() = storage durable + cache sets sealed + committed-step
+        # record advanced: joiners and rolled-back peers restore THIS step
+        self.ckpt.wait()
+        from edl_tpu.memstate import shards as ms_shards
+        shard_list, manifest = ms_shards.snapshot(state)
+        local = {key: (manifest[key], _bytes_view(arr))
+                 for key, arr in shard_list}
+        if jax.process_count() > 1:
+            # every process's save must COMMIT before any process tears
+            # its backend down (same contract as the preemption exit):
+            # the first leak_world would fail the stragglers' collective
+            # save
+            from edl_tpu.parallel.sharding import allgather_flag
+            allgather_flag(1)
+        raise _LiveReshard(_ReshardPayload(mode="grow", local=local,
+                                           step=step))
+
+    def _reshard_on_failure(self, exc: Exception,
+                            detail: str) -> _ReshardPayload:
+        """A peer pod's death fails survivors' collectives instantly —
+        long before the membership change is visible.  When the delta
+        path is on, wait (bounded) for the launcher's resize handshake
+        and convert the crash into a rollback reshard; on timeout
+        re-raise: the launcher handles the nonzero exit with the proven
+        stop-resume fallback.  The caller already verified
+        ``_delta_ready`` and released the failing frame's device
+        arrays; ``detail`` is the formatted original traceback."""
+        from edl_tpu.utils import constants as _c
+        from edl_tpu.cluster import resize as resize_rec
+        from edl_tpu.train import distributed as dist
+        logger.warning("step failed; delta resize on — waiting up to "
+                       "%.0fs for the resize handshake\n%s",
+                       _c.RESIZE_RESHARD_TIMEOUT, detail)
+        # tear the old backend down NOW, before any waiting: surviving
+        # peers may be BLOCKED in a collective on THIS process (their
+        # gloo reads wait on our sockets, not the dead pod's) — closing
+        # our backend fails their reads within milliseconds, so the
+        # whole old world converges on the handshake instead of hanging
+        # until someone's timeout.  If the wait below times out and the
+        # original error re-raises, the process exits anyway.  The mesh
+        # must go first: its Device objects pin the old client (and so
+        # its open sockets) through any clear_backends.
+        self._step_fn = None
+        self._eval_cache.clear()
+        self.mesh = None
+        dist.leak_world()
+        deadline = time.monotonic() + _c.RESIZE_RESHARD_TIMEOUT
+        old_stage = self.tenv.cluster_stage
+        while time.monotonic() < deadline:
+            try:
+                if (resize_rec.read_go(self.store, self.tenv.job_id,
+                                       old_stage) is not None
+                        or resize_rec.read_resize_flag(
+                            self.store, self.tenv.job_id, old_stage)
+                        is not None):
+                    # no save here: the dead pod's live-step shards are
+                    # gone, so the world rolls back to the committed
+                    # step — the same data-loss window stop-resume has
+                    return _ReshardPayload(mode="shrink")
+            except Exception:  # noqa: BLE001 — store blip: keep polling
+                logger.exception("resize handshake poll failed")
+            time.sleep(0.5)
+        raise exc
+
+    def _live_reshard(self, payload: _ReshardPayload, meta):
+        """Re-form the collective world in place and rebuild the train
+        state, moving only the bytes this process does not already
+        hold.  Any failure raises — the process exits nonzero and the
+        launcher's reshard-deadline fallback stop-resumes."""
+        from edl_tpu.cluster import resize as resize_rec
+        from edl_tpu.cluster.cluster import Cluster
+        from edl_tpu.memstate import reshard as ms_reshard
+        from edl_tpu.memstate import restore as ms_restore
+        from edl_tpu.train import distributed as dist
+        from edl_tpu.utils import constants as _c
+
+        t0 = time.monotonic()
+        t_detect = time.time()
+        old_stage = self.tenv.cluster_stage
+        old_world = self.tenv.world_size
+        # drop every executable/compiled reference into the old backend
+        # and abandon the old world BEFORE any waiting (idempotent — the
+        # crash path already did it): peers may be blocked on our gloo
+        # sockets, and the pause path has nothing left to compute.  The
+        # mesh's Device objects pin the old client, so it goes first
+        self._step_fn = None
+        self._eval_cache.clear()
+        self.mesh = None
+        dist.leak_world()
+
+        # 1. the definitive target stage (written post-barrier by the
+        # launcher) + its cluster record
+        deadline = time.monotonic() + _c.RESIZE_RESHARD_TIMEOUT
+        go = None
+        while time.monotonic() < deadline:
+            go = resize_rec.read_go(self.store, self.tenv.job_id, old_stage)
+            if go is not None:
+                break
+            time.sleep(0.2)
+        if go is None:
+            raise RuntimeError(
+                f"no reshard go record for stage {old_stage[:8]} within "
+                f"{_c.RESIZE_RESHARD_TIMEOUT:.0f}s")
+        cluster = None
+        while time.monotonic() < deadline:
+            cluster = Cluster.load_from_store(self.store, self.tenv.job_id)
+            if cluster is not None and cluster.stage == go["new_stage"]:
+                break
+            # a resize superseding THIS resize re-points the go record
+            go = resize_rec.read_go(self.store, self.tenv.job_id,
+                                    old_stage) or go
+            time.sleep(0.2)
+        if cluster is None or cluster.stage != go["new_stage"]:
+            raise RuntimeError(
+                f"cluster record never reached go stage "
+                f"{go['new_stage'][:8]}")
+
+        # 2. re-form the world in this process (leaks the old one —
+        # see train/distributed.py's teardown contract), rebuild mesh
+        with obs_trace.get_tracer().span("train/reshard",
+                                         mode=payload.mode):
+            # the OLD checkpoint manager is abandoned, never closed:
+            # its close path can barrier against a world that no longer
+            # exists (a dead peer on shrink).  Kept referenced so GC
+            # can't run its destructor either; its tee is local-only
+            # and safe to stop.
+            if self.ckpt is not None:
+                _ABANDONED_CKPTS.append(self.ckpt.abandon())
+            dist.reform_world(self.tenv, self.store, cluster)
+            # construct the NEW manager first thing in the new world:
+            # its construction sync pairs with the construction sync of
+            # freshly spawned joiner trainers, and the barrier-name
+            # counters reset so survivor and joiner names agree
+            # (checkpoint.reset_multihost_counters)
+            from edl_tpu.train.checkpoint import reset_multihost_counters
+            reset_multihost_counters()
+            self.ckpt = self._build_ckpt()
+            self.mesh = build_mesh(self.cfg.mesh_spec, None)
+            abstract = self._respec()
+
+            # 3. rebuild state: local snapshot first (zero wire), own
+            # pod's cache over loopback next, peers/replicas for the
+            # shards whose owner changed — the delta
+            expect = self.ckpt.latest_step()
+            t_restore = time.time()
+            res = None
+            try:
+                res = ms_restore.try_restore(
+                    self.store, self.tenv.job_id, abstract,
+                    expect_step=expect, local=payload.local,
+                    prefer_pod=self.tenv.pod_id)
+            except Exception:  # noqa: BLE001 — storage fallback below
+                logger.exception("reshard cache restore errored")
+            if res is not None:
+                state, meta_json, info = res
+                meta = State().from_json(meta_json)
+                source = "delta"
+                ms_reshard.BYTES_KEPT.inc(info.get("local_bytes", 0))
+                ms_reshard.BYTES_MOVED.inc(info.get("wire_bytes", 0))
+                ms_reshard.SHARDS_TOTAL.inc(info.get("shards", 0))
+                ms_reshard.SHARDS_MOVED.inc(
+                    info.get("shards", 0) - info.get("local_shards", 0))
+                logger.info(
+                    "reshard restore: step %d, %.1f MB local / %.1f MB "
+                    "moved", expect if expect is not None else -1,
+                    info.get("local_bytes", 0) / 1e6,
+                    info.get("wire_bytes", 0) / 1e6)
+            else:
+                # the world stays alive either way: a cache miss only
+                # demotes the restore to storage, not the resize to
+                # stop-resume
+                restored = self.ckpt.restore(abstract)
+                if restored is None:
+                    raise RuntimeError("no checkpoint to reshard from")
+                state, saved_meta = restored
+                meta = saved_meta if saved_meta is not None else meta
+                source = "storage"
+            if os.environ.get("EDL_TPU_MEMSTATE_VERIFY") == "1" \
+                    and source == "delta":
+                stored = self.ckpt.restore(abstract)
+                assert stored is not None
+                ms_restore.assert_bit_identical(state, stored[0])
+                logger.info("reshard restore verified bit-identical to "
+                            "storage (step %s)", expect)
+
+        # 4. bookkeeping: adjust hooks, recovery instrumentation,
+        # cadence state (joiners start fresh — agreed-step counters
+        # must not diverge from theirs), done record for the launcher
+        new_world = self.tenv.world_size
+        if old_world != new_world:
+            logger.info("world size %d -> %d (live); running adjust "
+                        "functions", old_world, new_world)
+            self.adjust.run(old_world, new_world, meta)
+        self._reshard_seen = False
+        # a preemption sighting belongs to the OLD stage: the departed
+        # pod is gone; the new stage must not re-trigger on it
+        self._preempt_seen = False
+        self._preempt_next_check = None
+        self._last_step_t = None
+        self._t_restored = t_detect
+        self._restore_source = source
+        ms_restore.RESTORE_SECONDS.labels(source=source).observe(
+            time.monotonic() - t0)
+        if self.tenv.rank_in_pod == 0:
+            try:
+                resize_rec.write_done(
+                    self.store, self.tenv.job_id, cluster.stage,
+                    self.tenv.pod_id,
+                    {"mode": payload.mode, "source": source,
+                     "seconds": round(time.monotonic() - t0, 3)})
+            except Exception:  # noqa: BLE001 — the launcher's deadline
+                logger.exception("reshard done record write failed")
+        self._capture_state_spec(state)
+        logger.info("live reshard complete: stage %s, world %d, %.2fs "
+                    "(source=%s)", cluster.stage[:8], new_world,
+                    time.monotonic() - t0, source)
+        return state, meta
 
     # -- eval ----------------------------------------------------------------
     def make_eval_step(self, metric_fn):
@@ -794,6 +1238,18 @@ class ElasticTrainer:
                               status)
         except Exception:  # noqa: BLE001 — reporting is best-effort
             logger.exception("train-status report failed")
+
+
+def _bytes_view(arr) -> memoryview | bytes:
+    """Zero-copy byte view of a host shard for the reshard's local
+    source (len() = byte length, np.frombuffer-compatible); copies only
+    when the dtype's buffer format can't be cast (ml_dtypes extras on
+    some numpy builds)."""
+    a = np.ascontiguousarray(arr).reshape(-1)
+    try:
+        return memoryview(a).cast("B")
+    except (TypeError, ValueError):
+        return a.tobytes()
 
 
 def _map_params_like(opt_state, params, fn):

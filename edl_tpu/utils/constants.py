@@ -21,6 +21,7 @@ ETCD_SCALE = "scale"                # controller desired-size + nodes_range
 ETCD_MEMSTATE = "memstate"          # peer checkpoint-cache adverts + commit record
 ETCD_SERVING = "serving"            # leased LM replica adverts (gateway fleet)
 ETCD_OBS = "obs"                    # leased /metrics endpoint adverts (obs agg)
+ETCD_RESHARD = "reshard"            # delta-resize handshake (flag/go/done/worldsvc)
 
 ALL_TABLES = [
     ETCD_POD_RESOURCE,
@@ -38,6 +39,7 @@ ALL_TABLES = [
     ETCD_MEMSTATE,
     ETCD_SERVING,
     ETCD_OBS,
+    ETCD_RESHARD,
 ]
 
 LEADER_KEY = "0"  # rank table key seized by the leader (leader_pod.py:57)
@@ -125,6 +127,25 @@ COORD_RESTART_GRACE = _f("EDL_TPU_COORD_RESTART_GRACE", -1.0)
 COORD_RETRY_DEADLINE = _f("EDL_TPU_COORD_RETRY_DEADLINE", 30.0)
 COORD_BACKOFF_INIT = _f("EDL_TPU_COORD_BACKOFF_INIT", 0.05)
 COORD_BACKOFF_MAX = _f("EDL_TPU_COORD_BACKOFF_MAX", 2.0)
+
+# -- delta resize: live reshard instead of stop-resume (ISSUE 12) ----------
+# 1 enables the delta-resize path: on a membership change, surviving
+# trainer PROCESSES stay alive, the collective world re-forms in place
+# (train/distributed.reform_world) and only the shards whose owner
+# changed move over the streaming plane (memstate/reshard.py).  Any
+# failure mid-reshard falls back to the proven stop-resume path.  Off
+# by default until burned in; the chaos/resize smokes run with it on.
+RESIZE_DELTA = int(_f("EDL_TPU_RESIZE_DELTA", 0))
+# reshard barrier timeout: bounds BOTH the trainer's wait for the
+# post-barrier "go" record + the re-formed world, and the launcher's
+# wait for its trainers' reshard-done records; expiry on either side
+# falls back to stop-resume
+RESIZE_RESHARD_TIMEOUT = _f("EDL_TPU_RESIZE_RESHARD_TIMEOUT", 60.0)
+# minimum fraction of cached checkpoint bytes that stay on surviving
+# owners for delta to be attempted: below it, moving almost everything
+# anyway, stop-resume (which overlaps the fetch with process respawn)
+# is cheaper.  0 = always attempt delta when enabled
+RESIZE_MIN_DELTA = _f("EDL_TPU_RESIZE_MIN_DELTA", 0.0)
 
 # -- in-memory peer checkpoint cache (edl_tpu/memstate) -------------------
 # 0 disables the cache entirely (saves are not teed, restores go

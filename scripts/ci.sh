@@ -68,6 +68,14 @@ JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 # tail, with zero reader failures (retries visible in metrics only)
 JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
 
+# resize smoke: delta-resharding instead of stop-resume — grow-by-one
+# and shrink-by-one must complete WITHOUT killing surviving trainer
+# processes (same PIDs, exactly one spawn per pod, resize_mode=delta in
+# the recovery record, every restore bit-verified against storage), and
+# a SIGKILL of the shard-holding leader pod mid-reshard must fall back
+# cleanly to the proven stop-resume path and still SUCCEED
+JAX_PLATFORMS=cpu python scripts/resize_smoke.py
+
 # obs-agg smoke: 2 child processes + parent — one trace_id propagated
 # over the EDL1 wire into both children's trace files, the aggregator
 # discovers all three via coord-store adverts and serves a merged
@@ -121,6 +129,10 @@ assert out.get('data_delivery_samples_s'), out
 lat, bound = out['alert_detect_latency_s'], out['alert_rule_bound_s']
 assert lat <= bound * 2 + 5, (lat, bound)
 assert out['obs_scrape_overhead_pct'] < 5, out['obs_scrape_overhead_pct']
+# live resize (ISSUE 12): delta-resharding must not lose to stop-resume
+# on the same grow-by-one (it skips process respawn + jax cold import)
+dl, sr = out['resize_delta_mttr_s'], out['resize_stop_resume_mttr_s']
+assert dl <= sr, (dl, sr)
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve

@@ -1,0 +1,398 @@
+"""CI resize smoke: sub-second-class live resize — delta-resharding
+instead of stop-resume (ISSUE 12).
+
+Three phases against REAL launchers + REAL jax trainers (CPU/gloo
+collectives), EDL_TPU_RESIZE_DELTA=1 and EDL_TPU_MEMSTATE_VERIFY=1
+throughout (every cache/delta restore is bit-compared against the
+storage checkpoint inside the trainer — a divergence crashes the job):
+
+1. **Grow-by-one, delta** — pods A+B train a 2-host world; pod C
+   joins.  A's and B's trainer PROCESSES must survive (same PIDs, one
+   "spawned trainer" line each), the recovery record must carry
+   ``resize_mode=delta`` with a reshard ack instead of a respawn, and
+   the job must finish SUCCEED at world=3 with every epoch recorded
+   exactly once.
+2. **Shrink-by-one, delta** — a 3-pod world loses its highest-rank pod
+   to SIGKILL.  Survivors' collectives fail instantly; the handshake
+   converts the crash into an in-place rollback reshard (same PIDs
+   again), sourced from the surviving caches (owner or ring replica).
+3. **Shard-holder SIGKILL mid-reshard → fallback** — while a grow
+   reshard is in flight (resize flag present), SIGKILL the rank-0 pod:
+   the leader/coordinator/shard-holder all at once.  Every delta
+   precondition trips; the survivors must fall back to the PROVEN
+   stop-resume path and still finish SUCCEED, restoring bit-identical
+   state from the dead holder's ring replica.
+
+Prints one JSON line with ``resize_delta_mttr_s`` (grow) and
+``resize_shrink_mttr_s`` so the numbers trend in the CI log.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/resize_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "examples", "collective", "train_linear.py")
+
+TTL = 1.0
+FAST = {
+    "EDL_TPU_TTL": str(TTL),
+    "EDL_TPU_GENERATOR_PERIOD": "0.2",
+    "EDL_TPU_WATCHER_PERIOD": "0.2",
+    "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+    "EDL_TPU_BARRIER_TIMEOUT": "60",
+    "EDL_TPU_RESIZE_BARRIER_TIMEOUT": "40",
+    "EDL_TPU_RESIZE_DELTA": "1",
+    "EDL_TPU_RESIZE_RESHARD_TIMEOUT": "30",
+    "EDL_TPU_MEMSTATE_VERIFY": "1",
+    "EDL_TPU_PREEMPT_CHECK_STEPS": "2",
+    "EDL_TPU_PREEMPT_CHECK_SECONDS": "1",
+    "EDL_TPU_DEMO_STEP_SLEEP": "0.25",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def spawn_coord(tmp: str):
+    from edl_tpu.coord.server import spawn_subprocess, wait_ready
+    from edl_tpu.utils.network import find_free_port
+    port = find_free_port()
+    env = dict(os.environ, EDL_TPU_TTL=str(TTL))
+    env.pop("EDL_TPU_METRICS_PORT", None)
+    proc = spawn_subprocess(port, os.path.join(tmp, "coord"), env=env)
+    wait_ready(f"127.0.0.1:{port}")
+    return proc, f"127.0.0.1:{port}"
+
+
+def spawn_launcher(job_id, coord_ep, tmp, name, ckpt, epochs=12, steps=4):
+    env = dict(os.environ)
+    env.update(FAST)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["EDL_TPU_DEMO_MARKER"] = os.path.join(tmp, f"marker-{name}")
+    log = open(os.path.join(tmp, f"launcher-{name}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.collective.launch",
+         "--job_id", job_id, "--coord_endpoints", coord_ep,
+         "--nodes_range", "1:3", "--nproc_per_node", "1",
+         "--checkpoint_dir", ckpt,
+         "--log_dir", os.path.join(tmp, f"log-{name}"), TRAIN,
+         "--", "--epochs", str(epochs), "--steps_per_epoch", str(steps)],
+        env=env, cwd=tmp, stdout=log, stderr=subprocess.STDOUT)
+    proc._logfile = log  # noqa: SLF001
+    return proc
+
+
+def trainer_pids(launcher) -> set[int]:
+    import psutil
+    try:
+        kids = psutil.Process(launcher.pid).children(recursive=True)
+    except psutil.NoSuchProcess:
+        return set()
+    out = set()
+    for k in kids:
+        try:
+            if any("train_linear.py" in c for c in k.cmdline()):
+                out.add(k.pid)
+        except psutil.NoSuchProcess:
+            continue
+    return out
+
+
+def kill_tree(proc) -> None:
+    import psutil
+    try:
+        victims = psutil.Process(proc.pid).children(recursive=True)
+        victims.append(psutil.Process(proc.pid))
+    except psutil.NoSuchProcess:
+        return
+    for p in victims:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except psutil.NoSuchProcess:
+            pass
+
+
+def wait_first_checkpoint(ckpt: str, procs, deadline_s=180):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        done = [d for d in (os.listdir(ckpt) if os.path.isdir(ckpt) else [])
+                if d.isdigit()]
+        if done:
+            return
+        for p in procs:
+            assert p.poll() is None, f"launcher died in warmup (rc={p.poll()})"
+        time.sleep(0.2)
+    raise AssertionError("no checkpoint committed in warmup")
+
+
+def wait_resize_record(client, job_id, mode, deadline_s=120,
+                      min_count=1) -> dict:
+    """Poll summarize_recovery until >= min_count records of ``mode``
+    exist with a completed trainer half; returns the newest."""
+    from edl_tpu.cluster.recovery import summarize_recovery
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            recs = [s for s in summarize_recovery(client, job_id)
+                    if s.get("resize_mode") == mode and "total" in s]
+        except Exception:  # noqa: BLE001 — store warming up
+            recs = []
+        if len(recs) >= min_count:
+            return recs[-1]
+        time.sleep(0.3)
+    raise AssertionError(f"no completed {mode} resize record in "
+                         f"{deadline_s}s")
+
+
+def finish(proc, timeout):
+    try:
+        rc = proc.wait(timeout)
+    except subprocess.TimeoutExpired:
+        kill_tree(proc)
+        raise AssertionError("launcher did not finish in time")
+    finally:
+        if getattr(proc, "_logfile", None):
+            proc._logfile.close()  # noqa: SLF001
+    return rc
+
+
+def log_text(tmp, name) -> str:
+    path = os.path.join(tmp, f"launcher-{name}.log")
+    with open(path, "rb") as f:
+        return f.read().decode(errors="replace")
+
+
+def spawn_count(tmp, name) -> int:
+    return log_text(tmp, name).count("spawned trainer")
+
+
+def wait_world(client, job_id, n_pods, deadline_s=120):
+    """Poll the cluster record until the membership has ``n_pods`` and
+    stays unchanged for a full second — the pre-resize baseline must
+    not be captured during the warmup joins' own stop-resumes."""
+    from edl_tpu.cluster.cluster import Cluster
+    deadline = time.monotonic() + deadline_s
+    stable_since, stage = None, None
+    while time.monotonic() < deadline:
+        try:
+            c = Cluster.load_from_store(client, job_id)
+        except Exception:  # noqa: BLE001 — store warming up
+            c = None
+        if c is not None and len(c.pods) == n_pods:
+            if stage == c.stage:
+                if stable_since and time.monotonic() - stable_since > 1.0:
+                    return c
+            else:
+                stage, stable_since = c.stage, time.monotonic()
+        else:
+            stage, stable_since = None, None
+        time.sleep(0.1)
+    raise AssertionError(f"cluster never stabilized at {n_pods} pods")
+
+
+def phase_grow(tmp, coord_ep) -> float:
+    from edl_tpu.cluster.status import Status, load_job_status
+    from edl_tpu.coord.client import connect
+    job = "resize-grow"
+    ckpt = os.path.join(tmp, "ckpt-grow")
+    pa = spawn_launcher(job, coord_ep, tmp, "ga", ckpt)
+    pb = spawn_launcher(job, coord_ep, tmp, "gb", ckpt)
+    try:
+        client = connect(coord_ep)
+        wait_world(client, job, 2)
+        wait_first_checkpoint(ckpt, (pa, pb))
+        time.sleep(1.0)  # settle past the warmup join's own resize
+        pids_a, pids_b = trainer_pids(pa), trainer_pids(pb)
+        spawns = {n: spawn_count(tmp, n) for n in ("ga", "gb")}
+        assert pids_a and pids_b, "no trainer processes found pre-resize"
+
+        pc = spawn_launcher(job, coord_ep, tmp, "gc", ckpt)
+        rec = wait_resize_record(client, job, "delta")
+        assert trainer_pids(pa) == pids_a, "pod A trainer was replaced"
+        assert trainer_pids(pb) == pids_b, "pod B trainer was replaced"
+        assert rec.get("restore_source") in ("delta", "peer"), rec
+
+        assert finish(pa, 240) == 0 and finish(pb, 240) == 0 \
+            and finish(pc, 240) == 0, "grow job failed"
+        assert load_job_status(client, job) == Status.SUCCEED
+        client.close()
+        for n in ("ga", "gb"):
+            after = spawn_count(tmp, n)
+            assert after == spawns[n], (
+                f"launcher {n} respawned trainers across the delta "
+                f"resize ({spawns[n]} -> {after}):\n"
+                f"{log_text(tmp, n)[-3000:]}")
+        done = [l for n in ("ga", "gb", "gc")
+                for l in open(os.path.join(tmp, f"marker-{n}"))
+                .read().splitlines() if l.startswith("done")]
+        assert done and all("world=3" in l for l in done), done
+        print(f"resize smoke: GROW delta OK — mttr {rec['total']:.2f}s, "
+              f"reshard {rec.get('barrier_to_reshard', -1):.2f}s, "
+              f"restore_source={rec.get('restore_source')}")
+        return float(rec["total"])
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                kill_tree(p)
+        if "pc" in locals() and pc.poll() is None:
+            kill_tree(pc)
+
+
+def phase_shrink(tmp, coord_ep) -> float:
+    from edl_tpu.cluster.status import Status, load_job_status
+    from edl_tpu.coord.client import connect
+    job = "resize-shrink"
+    ckpt = os.path.join(tmp, "ckpt-shrink")
+    procs = {n: spawn_launcher(job, coord_ep, tmp, n, ckpt)
+             for n in ("sa", "sb", "sc")}
+    try:
+        client = connect(coord_ep)
+        cluster = wait_world(client, job, 3)
+        wait_first_checkpoint(ckpt, tuple(procs.values()))
+        # let the 3-pod world commit a world=3 checkpoint before the kill
+        time.sleep(2.0)
+        # the highest-rank pod is PREEMPTED (SIGTERM + grace — the
+        # controlled scale-in every real scheduler performs): the whole
+        # old world checkpoints at an agreed step, the departing pod
+        # exits DESCALED, and the survivors unwind into the live
+        # reshard.  NOT the leader: the jax coordination service rides
+        # the leader pod's launcher (leader death is the fallback
+        # path, phase 3).  A SIGKILLed pod instead lands on the
+        # stop-resume fallback — gloo cannot error collectives started
+        # after a silent peer death (doc/robustness.md).  Map pod id ->
+        # launcher via the "pod <id> ... launching" line each one logs.
+        victim_pod = cluster.pods[-1].pod_id
+        victim = next(n for n in procs
+                      if f"pod {victim_pod}" in log_text(tmp, n))
+        survivors = {n: p for n, p in procs.items() if n != victim}
+        pids = {n: trainer_pids(p) for n, p in survivors.items()}
+        spawns = {n: spawn_count(tmp, n) for n in survivors}
+        assert all(pids.values()), "no trainer processes found pre-kill"
+
+        procs[victim].send_signal(signal.SIGTERM)
+        rec = wait_resize_record(client, job, "delta")
+        assert finish(procs[victim], 240) == 0, \
+            "preempted pod must exit cleanly (DESCALED)"
+        for n, p in survivors.items():
+            assert trainer_pids(p) == pids[n], f"pod {n} trainer replaced"
+        assert all(finish(p, 240) == 0 for p in survivors.values()), \
+            "shrink job failed"
+        assert load_job_status(client, job) == Status.SUCCEED
+        client.close()
+        for n in survivors:
+            after = spawn_count(tmp, n)
+            assert after == spawns[n], (
+                f"launcher {n} respawned trainers across the delta "
+                f"shrink ({spawns[n]} -> {after}):\n"
+                f"{log_text(tmp, n)[-3000:]}")
+        done = [l for n in survivors
+                for l in open(os.path.join(tmp, f"marker-{n}"))
+                .read().splitlines() if l.startswith("done")]
+        assert done and all("world=2" in l for l in done), done
+        print(f"resize smoke: SHRINK delta OK — mttr {rec['total']:.2f}s, "
+              f"restore_source={rec.get('restore_source')}")
+        return float(rec["total"])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                kill_tree(p)
+
+
+def phase_fallback(tmp, coord_ep) -> None:
+    """SIGKILL the rank-0 pod (leader + jax coordinator + replica-0
+    shard holder) while a grow reshard is in flight: survivors must
+    fall back to stop-resume and still finish, restoring from the dead
+    holder's ring replica (bit-verified by EDL_TPU_MEMSTATE_VERIFY)."""
+    from edl_tpu.cluster import paths
+    from edl_tpu.cluster.status import Status, load_job_status
+    from edl_tpu.coord.client import connect
+    from edl_tpu.utils import constants
+    job = "resize-fb"
+    ckpt = os.path.join(tmp, "ckpt-fb")
+    procs = {n: spawn_launcher(job, coord_ep, tmp, n, ckpt, epochs=14)
+             for n in ("fa", "fb")}
+    try:
+        client = connect(coord_ep)
+        cluster = wait_world(client, job, 2)
+        wait_first_checkpoint(ckpt, tuple(procs.values()))
+        time.sleep(1.0)
+        # the leader pod = rank 0 = jax-coordination host = the holder
+        # of the replica-0 shard set (it owns the committed copy every
+        # restore leans on)
+        leader_pod = cluster.pods[0].pod_id
+        leader = next(n for n in procs
+                      if f"pod {leader_pod}" in log_text(tmp, n))
+        procs["fc"] = spawn_launcher(job, coord_ep, tmp, "fc", ckpt,
+                                     epochs=14)
+        # wait for the resize flag = the grow reshard is IN FLIGHT
+        prefix = paths.table_prefix(job, constants.ETCD_RESHARD)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            recs, _rev = client.get_prefix(prefix)
+            if any("flag/" in r.key for r in recs):
+                break
+            assert all(p.poll() is None
+                       for n, p in procs.items() if n != "fc")
+            time.sleep(0.05)
+        else:
+            raise AssertionError("resize flag never appeared")
+        kill_tree(procs[leader])  # the shard holder dies mid-reshard
+
+        # survivors must converge through stop-resume and SUCCEED
+        survivors = [n for n in procs if n != leader]
+        assert all(finish(procs[n], 300) == 0 for n in survivors), \
+            "fallback job failed"
+        assert load_job_status(client, job) == Status.SUCCEED
+        client.close()
+        text = "".join(log_text(tmp, n) for n in survivors)
+        assert ("falling back to stop-resume" in text
+                or "restart trainers (stop-resume)" in text), \
+            "no stop-resume fallback found in survivor logs"
+        done = [l for n in survivors
+                for l in open(os.path.join(tmp, f"marker-{n}"))
+                .read().splitlines() if l.startswith("done")]
+        assert done and all("world=2" in l for l in done), done
+        print("resize smoke: FALLBACK OK — holder SIGKILL mid-reshard "
+              "fell back to stop-resume, job SUCCEEDed bit-identical")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                kill_tree(p)
+
+
+def main() -> None:
+    # optional phase filter for targeted debugging:
+    #   python scripts/resize_smoke.py [grow|shrink|fallback]
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    tmp = tempfile.mkdtemp(prefix="edl-resize-smoke-")
+    coord, coord_ep = spawn_coord(tmp)
+    try:
+        grow_mttr = shrink_mttr = -1.0
+        if only in (None, "grow"):
+            grow_mttr = phase_grow(tmp, coord_ep)
+        if only in (None, "shrink"):
+            shrink_mttr = phase_shrink(tmp, coord_ep)
+        if only in (None, "fallback"):
+            phase_fallback(tmp, coord_ep)
+        if only is None:
+            print(json.dumps(
+                {"resize_delta_mttr_s": round(grow_mttr, 3),
+                 "resize_shrink_mttr_s": round(shrink_mttr, 3)}))
+        print("resize smoke OK")
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait(timeout=30)
+
+
+if __name__ == "__main__":
+    main()
