@@ -47,7 +47,9 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from edl_tpu.obs import advert
+from edl_tpu.obs import goodput as obs_goodput
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import profile as obs_profile
 from edl_tpu.obs import rules as obs_rules
 from edl_tpu.obs.metrics import REGISTRY, parse_exposition
 from edl_tpu.obs.tsdb import TSDB, quantile_from_buckets  # noqa: F401 — re-export
@@ -180,7 +182,8 @@ class Aggregator:
                  retention_s: float | None = None,
                  quantile_window: float | None = None,
                  rules: list | None = None,
-                 incident_dir: str | None = None):
+                 incident_dir: str | None = None,
+                 enable_actions: bool = True):
         self.store = store
         self.job_id = job_id
         self.scrape_timeout = scrape_timeout
@@ -195,12 +198,26 @@ class Aggregator:
         retention = (float(os.environ.get("EDL_TPU_OBS_RETENTION", 600.0))
                      if retention_s is None else float(retention_s))
         self.tsdb = TSDB(retention_s=retention)
+        # goodput ledger: fed every scrape from the recovery records +
+        # the live trainer-target view; its gauges live in THIS
+        # process's registry, which rides the merged page (include_self)
+        # into the TSDB, so the goodput-regression rule sees it
+        self.goodput = obs_goodput.GoodputLedger()
+        # alert action hooks: "profile" captures a profiler trace on
+        # the alerting instance.  Read-only hosts (edl-obs-top's
+        # embedded aggregator) disable actions; EDL_TPU_PROFILE_ON_ALERT=0
+        # turns the capture action off fleet-wide
+        actions = None
+        if (enable_actions
+                and os.environ.get("EDL_TPU_PROFILE_ON_ALERT", "1") != "0"):
+            actions = {"profile": self._profile_action}
+        self._action_last: dict[str, float] = {}
         self.engine = obs_rules.RuleEngine(
             self.tsdb,
             obs_rules.load_rules() if rules is None else rules,
             incident_log=obs_rules.IncidentLog(incident_dir, "obs-agg",
                                                job_id),
-            trace_provider=self._job_trace_id)
+            trace_provider=self._job_trace_id, actions=actions)
         self._lock = threading.Lock()
         # single-flight gate for the scrape fan-out: collect() holds it
         # across the network I/O so concurrent callers coalesce onto one
@@ -225,12 +242,26 @@ class Aggregator:
         t0 = time.perf_counter()
         now = time.time() if now is None else now
         try:
-            merged, _info = self.collect()
+            merged, info = self.collect()
             self.tsdb.ingest(parse_exposition(merged), now)
+            self._update_goodput(now, info)
             self.engine.evaluate(now)
         except Exception:  # noqa: BLE001 — the loop must survive anything
             logger.exception("scrape loop iteration failed")
         _LOOP_SECONDS.observe(time.perf_counter() - t0)
+
+    def _update_goodput(self, now: float, info: dict) -> None:
+        """Feed the goodput ledger: recovery records (cached, deadline-
+        scoped) + whether any trainer target is live this scrape."""
+        try:
+            resizes = self._recovery_summary()
+        except Exception:  # noqa: BLE001 — a store blip must not stop the loop
+            logger.debug("goodput recovery read failed", exc_info=True)
+            resizes = None  # unknown: the ledger keeps its baseline
+        trainers_live = any(
+            str(t.get("component")) == "trainer"
+            for t in info.get("targets", {}).values())
+        self.goodput.update(now, resizes, trainers_live)
 
     def start_loop(self) -> None:
         """Start the background scrape loop (idempotent; a
@@ -354,6 +385,100 @@ class Aggregator:
                 self._cached = (time.monotonic(), merged, info)
             return merged, info
 
+    # -- on-demand profiler capture (alert action + /profile) ----------------
+    def _profile_targets(self, group: str = "",
+                         component: str = "trainer") -> list[str]:
+        """Endpoints to capture on: the alerting instance when the
+        alert group IS a discovered endpoint, else every ``component``
+        target (bounded)."""
+        _merged, info = self.collect()
+        targets = info.get("targets", {})
+        eps = [str(t.get("endpoint")) for t in targets.values()
+               if t.get("endpoint")]
+        if group and group in eps:
+            return [group]
+        return [str(t["endpoint"]) for t in targets.values()
+                if str(t.get("component")) == component
+                and t.get("endpoint")][:4]
+
+    def profile_fanout(self, duration_s: float | None = None,
+                       group: str = "", component: str = "trainer",
+                       trigger: str = "http") -> dict:
+        """GET ``/profile`` on the resolved targets (the capture itself
+        runs asynchronously in each target process — this returns each
+        target's started/busy manifest)."""
+        duration_s = duration_s or obs_profile.default_duration()
+        targets = self._profile_targets(group, component)
+        out: dict[str, object] = {}
+
+        def one(ep: str):
+            url = (f"http://{ep}/profile?duration_s={duration_s:g}"
+                   f"&trigger={trigger}")
+            return json.loads(urllib.request.urlopen(
+                url, timeout=self.scrape_timeout).read().decode())
+
+        if targets:
+            # concurrent like collect()'s scrape fan-out: several dead
+            # targets must cost ONE timeout, not one each in series
+            with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+                futs = {ep: pool.submit(one, ep) for ep in targets}
+                for ep, fut in futs.items():
+                    try:
+                        out[ep] = fut.result()
+                    except Exception as e:  # noqa: BLE001 — a dead target is an answer
+                        out[ep] = {"error": f"{type(e).__name__}: {e}"}
+        return {"duration_s": duration_s, "targets": out}
+
+    def _profile_action(self, rule, group: str, value: float) -> None:
+        """The ``action="profile"`` hook: a firing straggler / p99-SLO
+        alert requests a capture on the suspect instance.  Per-rule
+        cooldown (``EDL_TPU_PROFILE_COOLDOWN``) so a flapping alert
+        cannot turn the fleet into a continuous profiler.  The network
+        fan-out runs on a daemon thread: the engine calls actions from
+        the scrape loop, and a handful of dead targets at the scrape
+        timeout must not stall TSDB ingestion exactly when alert
+        history matters.  The capture component follows the rule's
+        signal: gateway-family alerts profile the serving fleet's
+        replicas, everything else the trainers."""
+        try:
+            cooldown = float(os.environ.get("EDL_TPU_PROFILE_COOLDOWN",
+                                            60.0))
+        except ValueError:
+            cooldown = 60.0
+        now = time.monotonic()
+        last = self._action_last.get(rule.name)
+        if last is not None and now - last < cooldown:
+            return
+        self._action_last[rule.name] = now
+        component = ("replica" if rule.metric.startswith("edl_gateway")
+                     or rule.name.startswith("gateway") else "trainer")
+
+        def run():
+            res = self.profile_fanout(group=group, component=component,
+                                      trigger="alert")
+            # "busy" is not a capture: that target is mid-capture for
+            # someone else — without a release the alert's own capture
+            # would be silently skipped for the whole cooldown
+            ok = [ep for ep, r in res["targets"].items()
+                  if isinstance(r, dict) and not r.get("error")
+                  and not r.get("busy")]
+            if not ok:
+                # nothing captured (no targets / all unreachable/busy):
+                # release the cooldown so the next firing retries
+                # instead of silently burning the whole window
+                self._action_last.pop(rule.name, None)
+                logger.info("alert %s fired but no %s target accepted "
+                            "a profile capture (%s); will retry on the "
+                            "next firing", rule.name, component,
+                            res["targets"] or "none discovered")
+                return
+            logger.info("alert %s fired (group=%r, value=%.4g): "
+                        "requested profile capture on %s", rule.name,
+                        group, value, sorted(ok))
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"edl-profile-action:{rule.name}").start()
+
     def _recovery_summary(self):
         """``summarize_recovery`` behind a cache + a scoped deadline:
         /healthz is a health probe — a slow coord store must cost it at
@@ -405,6 +530,12 @@ class Aggregator:
             summary["last_resize"] = resizes[-1] if resizes else None
         except Exception as e:  # noqa: BLE001 — store blip must not 500 healthz
             summary["resizes_error"] = f"{type(e).__name__}: {e}"
+        # elastic goodput: the utilization headline (obs/goodput.py);
+        # the scrape loop keeps the ledger current — a loop-less
+        # aggregator (scrape_interval<=0, tests) still reports the
+        # accumulated view.  Before the exposition parse on purpose:
+        # goodput must survive one target serving a malformed page.
+        summary["goodput"] = self.goodput.summary()
         try:
             parsed = parse_exposition(merged)
         except ValueError as e:
@@ -485,7 +616,7 @@ class AggregatorServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path in ("/metrics", "/"):
                         body = agg.collect()[0].encode("utf-8")
@@ -498,6 +629,18 @@ class AggregatorServer:
                     elif path == "/alerts":
                         body = (json.dumps(agg.engine.to_json())
                                 .encode("utf-8"))
+                        ctype = "application/json"
+                    elif path == "/profile":
+                        # fan the capture request out to the live
+                        # trainer targets (?component= overrides,
+                        # ?duration_s= bounds the window)
+                        from edl_tpu.obs import exposition as expo
+                        q = expo.parse_query(query)
+                        body = json.dumps(agg.profile_fanout(
+                            duration_s=expo.query_float(q, "duration_s")
+                            or None,
+                            component=str(q.get("component", "trainer")),
+                        )).encode("utf-8")
                         ctype = "application/json"
                     else:
                         self.send_error(404)

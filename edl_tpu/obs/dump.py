@@ -158,8 +158,15 @@ def to_perfetto(events: list[dict]) -> dict:
     """Chrome/Perfetto ``trace_event`` JSON: spans (events with ``dur``)
     become complete ``"X"`` events, instants become ``"i"``; each source
     process (trace file) gets its own pid row named by its component, so
-    the cross-process causal chain reads as parallel tracks."""
-    core = {"ts", "name", "dur", "component", "file"}
+    the cross-process causal chain reads as parallel tracks.
+
+    Events carrying a ``counters`` dict (the step-phase ledger's
+    ``train/step_phases``, the goodput ledger's ``goodput/sample``)
+    additionally emit a ``"C"`` **counter** sample on a per-event-name
+    track, so a resize's badput and the surrounding steps' phase split
+    render as stacked counter graphs in the SAME view as the resize's
+    handshake spans."""
+    core = {"ts", "name", "dur", "component", "file", "counters"}
     pids: dict[str, int] = {}
     trace_events: list[dict] = []
     for e in events:
@@ -184,6 +191,16 @@ def to_perfetto(events: list[dict]) -> dict:
             rec["ph"] = "i"
             rec["s"] = "p"
         trace_events.append(rec)
+        counters = e.get("counters")
+        if isinstance(counters, dict):
+            vals = {str(k): float(v) for k, v in counters.items()
+                    if isinstance(v, (int, float))}
+            if vals:
+                trace_events.append({
+                    "name": str(e.get("name", "?")), "ph": "C",
+                    "pid": pid, "tid": pid,
+                    "ts": round(float(e.get("ts", 0.0)) * 1e6, 3),
+                    "args": vals})
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 

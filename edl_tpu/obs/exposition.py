@@ -18,8 +18,10 @@ so harnesses and scrapers can discover auto-picked ports.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from edl_tpu.obs.metrics import REGISTRY, Registry
@@ -30,9 +32,41 @@ logger = get_logger(__name__)
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# process-wide extra GET routes on the /metrics endpoint: path ->
+# fn(query: dict[str, str]) -> JSON-able dict.  The profiler capture
+# (obs/profile.py) mounts "/profile" here, so the endpoint every
+# process already advertises in the coord store is also the surface
+# alert actions and operators hit for an on-demand capture — no second
+# server, no second advert.  Registered lazily at runtime; the handler
+# consults the dict per request, so routes added after the server
+# started (the trainer builds its ledger after install_from_env) work.
+_routes: dict[str, object] = {}
+
+
+def register_route(path: str, fn) -> None:
+    """Serve ``fn(query)`` as JSON at ``path`` on this process's
+    metrics endpoint(s).  Last registration per path wins."""
+    _routes[path] = fn
+
+
+def parse_query(query: str) -> dict[str, str]:
+    """Query string → last-value-wins flat dict — the one parser every
+    route handler (here, the aggregator's /profile, obs/profile.py)
+    shares, so target-side and aggregator-side parsing can't diverge."""
+    return {k: v[-1] for k, v in urllib.parse.parse_qs(query).items()}
+
+
+def query_float(q: dict, key: str, default: float = 0.0) -> float:
+    """A float query param, tolerating absence and garbage."""
+    try:
+        return float(q.get(key, default) or default)
+    except (TypeError, ValueError):
+        return default
+
 
 class MetricsServer:
-    """Serve ``registry.render()`` at ``/metrics`` (and ``/``)."""
+    """Serve ``registry.render()`` at ``/metrics`` (and ``/``), plus
+    any process-wide :func:`register_route` extras."""
 
     def __init__(self, registry: Registry | None = None,
                  host: str = "0.0.0.0", port: int = 0):
@@ -40,12 +74,25 @@ class MetricsServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                path, _, query = self.path.partition("?")
+                route = _routes.get(path)
+                if route is not None:
+                    try:
+                        body = json.dumps(
+                            route(parse_query(query))).encode("utf-8")
+                        ctype = "application/json"
+                    except Exception:  # noqa: BLE001 — a bad route != dead endpoint
+                        logger.exception("route %s failed", path)
+                        self.send_error(500)
+                        return
+                elif path in ("/metrics", "/"):
+                    body = reg.render().encode("utf-8")
+                    ctype = CONTENT_TYPE
+                else:
                     self.send_error(404)
                     return
-                body = reg.render().encode("utf-8")
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

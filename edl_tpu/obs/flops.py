@@ -1,0 +1,92 @@
+"""Shared FLOP accounting for MFU: one source of truth for bench AND
+the trainer's live gauges.
+
+Before this module, the peak-TFLOPS table, the XLA cost-analysis FLOP
+count, and the analytic transformer FLOP formula lived inside
+``edl_tpu/bench.py`` — which meant MFU existed only in the one-shot
+bench artifact and the trainer could not publish it continuously
+without duplicating (and drifting from) that logic.  Three helpers:
+
+- :func:`peak_tflops` — bf16 peak per chip from the device kind
+  (longest-match against :data:`PEAK_TFLOPS`; ``EDL_TPU_PEAK_TFLOPS``
+  overrides — the only way to get an MFU on CPU or an unknown kind);
+- :func:`xla_cost_flops` — the compiled computation's total FLOPs from
+  XLA's cost analysis (the whole module, all devices), ``None`` when
+  the backend can't answer.  Caveat: a model running layers under
+  ``lax.scan`` counts the loop body ONCE — use the analytic count for
+  those (the bench's LM section measured 0.70 "TFLOP"/step vs ~7 real);
+- :func:`analytic_lm_flops_per_token` — the PaLM-appendix transformer
+  accounting (6·N matmul params + 6·layers·seq·d_model causal
+  attention per token).
+
+``mfu = achieved_tflops / peak_tflops``; both bench sections and the
+trainer's ``edl_mfu`` / ``edl_tflops_per_chip`` gauges
+(``train/trainer.py``) compute it through here so they cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets);
+# extend as kinds appear.  Used only for the optional MFU estimate.
+PEAK_TFLOPS = {
+    "TPU v4": 275, "TPU v5": 459, "TPU v5p": 459,
+    "TPU v5 lite": 197, "TPU v5e": 197, "TPU v6e": 918, "TPU v6 lite": 918,
+}
+
+
+def peak_tflops(device) -> float | None:
+    """Known bf16 peak for ``device`` (a jax Device), or None.
+    ``EDL_TPU_PEAK_TFLOPS`` overrides unconditionally."""
+    env = os.environ.get("EDL_TPU_PEAK_TFLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            return None  # malformed override: MFU absent, never a crash
+    kind = getattr(device, "device_kind", "")
+    # LONGEST match wins: "TPU v5 lite" (197) must not be swallowed by
+    # the "TPU v5" prefix (459, the v5p number) — the r03 MFU was
+    # understated 2.3× by exactly that (0.131 reported vs 0.306 real)
+    best = None
+    for name, peak in PEAK_TFLOPS.items():
+        if (kind.startswith(name) or name in kind) and (
+                best is None or len(name) > len(best[0])):
+            best = (name, peak)
+    return float(best[1]) if best else None
+
+
+def xla_cost_flops(jitted, *args) -> float | None:
+    """Total FLOPs of one execution of ``jitted(*args)`` from XLA's
+    compiled cost analysis (global — across every device the
+    computation spans), or None when the backend offers no analysis /
+    reports zero.  The AOT ``lower().compile()`` path does NOT share
+    the jit dispatch cache: this is a FULL recompile (~0.9 s measured
+    on a toy model) even when ``jitted`` has already run with these
+    shapes.  Never call it on a hot path — background it the way
+    ``train/trainer.py``'s ``_compute_flops`` thread does."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    # edl-lint: disable=wire-error — optional enrichment: MFU simply
+    # stays absent when the backend offers no cost analysis
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def analytic_lm_flops_per_token(num_layers: int, embed_dim: int,
+                                mlp_dim: int, vocab_size: int,
+                                seq: int) -> float:
+    """Analytic train FLOPs per token for the decoder-only transformer:
+    6·N for the matmul params (embed table excluded — lookup, not
+    matmul; lm_head kept — it IS a matmul) + causal-attention
+    6·layers·seq·d_model.  Use this instead of :func:`xla_cost_flops`
+    for scan-over-layers models, where cost analysis counts the loop
+    body once instead of ×num_layers."""
+    n_matmul = (num_layers * (4 * embed_dim ** 2           # qkv + out proj
+                              + 3 * embed_dim * mlp_dim)   # swiglu mlp
+                + embed_dim * vocab_size)                  # lm head
+    return float(6 * n_matmul + 6 * num_layers * seq * embed_dim)

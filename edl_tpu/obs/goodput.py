@@ -1,0 +1,259 @@
+"""Elastic goodput accounting: where did the job's wall-clock go?
+
+The reference's whole pitch is that elasticity raises utilization —
+but nothing in-tree could state utilization: resize MTTRs existed as
+per-event histograms, not as "this job spent 3.2% of its life
+resizing".  This module closes that gap with a per-job ledger that
+classifies ALL observed wall-clock into
+
+- ``productive`` — trainers live, no recovery in progress;
+- ``resize``     — inside a resize record's launcher span (detect →
+  respawn/reshard handshake, from ``cluster/recovery.py`` records);
+- ``restore``    — the trainer half of a resize (checkpoint restore +
+  recompile to first step);
+- ``hang``       — recovery records written by hang-watchdog restarts
+  (the launcher suffixes those stages with ``+hang<ts>``);
+- ``idle``       — zero live trainer targets outside any recovery
+  window (the job exists but nothing is training)
+
+exposed as the ``edl_goodput_ratio`` gauge (productive / observed) +
+``edl_badput_seconds_total{reason}`` counters.  The aggregator updates
+the ledger every scrape, surfaces it on ``/healthz`` and as an
+``edl-obs-top`` headline, and — because its own registry rides the
+merged page — the TSDB records the series, so the built-in
+``goodput-regression`` rule (:mod:`edl_tpu.obs.rules`) can alert on
+it like any other signal.
+
+:func:`classify_records` is the pure part (recovery records → badput
+intervals), unit-tested against every resize shape: stop-resume, delta,
+delta-with-fallback (both ``flagged`` and ``killed`` present), hang
+restarts, and launcher-half-only records (trainer half never landed —
+all of it counts as resize badput, the clamped-negative-duration rule
+from PR 11 included).
+
+The observation window starts when the ledger does (the aggregator's
+start) — goodput is a property of the *observed* job, the same contract
+as every other TSDB-derived number.
+"""
+
+from __future__ import annotations
+
+import time
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
+
+BADPUT_REASONS = ("resize", "restore", "hang", "idle")
+
+GOODPUT_RATIO_G = obs_metrics.gauge(
+    "edl_goodput_ratio",
+    "Fraction of observed job wall-clock spent productive (trainers "
+    "live, no recovery in progress) — the elastic-utilization headline")
+BADPUT_SECONDS = obs_metrics.counter(
+    "edl_badput_seconds_total",
+    "Observed non-productive job wall-clock by reason: resize "
+    "(launcher half of a membership change), restore (trainer "
+    "restore-to-first-step half), hang (hang-watchdog recoveries), "
+    "idle (no live trainer targets)", ("reason",))
+
+# trace-emit throttle: goodput/sample events feed the Perfetto counter
+# track; one every few seconds is plenty of resolution
+_EMIT_EVERY_S = 10.0
+
+
+def _interval_badput(rec: dict) -> tuple[float, float, dict[str, float]]:
+    """(begin_ts, end_ts, {reason: seconds}) of one summarize_recovery
+    entry.  Durations are clamped ≥ 0 (a delta-resize fallback's
+    overlapping halves can make raw phase arithmetic negative — PR 11)
+    and the per-reason split never exceeds the record's own span."""
+    begin = float(rec.get("detect_at", 0.0))
+    restore = 0.0
+    for phase in ("spawn_to_restored", "restored_to_first_step"):
+        restore += max(0.0, float(rec.get(phase, 0.0)))
+    if "total" in rec:
+        total = max(0.0, float(rec["total"]))
+    else:
+        # launcher half only (trainer never reported): the launcher
+        # phases are all we know — and with no trainer half there is
+        # no restore portion to split out.  The stop-resume chain
+        # (detect→kill→barrier→spawn) and the delta chain
+        # (detect→flag→barrier→reshard) each span detect→their end; a
+        # FALLBACK record carries phases of BOTH chains over the SAME
+        # wall-clock (the delta attempt sits inside detect_to_kill),
+        # so the record's span is the LONGER chain, never the sum
+        def chain(*phases):
+            return sum(max(0.0, float(rec.get(p, 0.0))) for p in phases)
+
+        total = max(chain("detect_to_kill", "kill_to_barrier",
+                          "barrier_to_spawn"),
+                    chain("detect_to_flag", "flag_to_barrier",
+                          "barrier_to_reshard"))
+        restore = 0.0
+    restore = min(restore, total)
+    if "+hang" in str(rec.get("stage", "")):
+        # a hang-watchdog recovery: the whole span is hang badput —
+        # the restart's restore cost is part of what the hang cost
+        return begin, begin + total, {"hang": total}
+    return begin, begin + total, {"resize": total - restore,
+                                  "restore": restore}
+
+
+def _overlap_seconds(lo: float, hi: float, spans) -> float:
+    return sum(max(0.0, min(hi, e) - max(lo, s)) for s, e in spans)
+
+
+def classify_records(resizes: list[dict], since: float | None = None,
+                     until: float | None = None,
+                     exclude=()) -> dict[str, float]:
+    """Total badput seconds by reason across ``summarize_recovery``
+    records (pure; monotone in the record set, and — with ``since``/
+    ``until`` — monotone in a growing ``until``).  ``since``/``until``
+    clip each record's span to the observation window: a record that
+    predates the window contributes nothing (an aggregator restarted
+    onto an old job must not count the job's whole history as badput
+    it observed), a straddling record contributes proportionally.
+    ``exclude`` is a list of ``(lo, hi)`` wall-clock spans whose time
+    is already attributed elsewhere (the ledger's idle spans: records
+    only land AFTER a recovery completes, so time the ledger watched
+    pass as idle must not be re-counted when the covering record
+    arrives — first attribution wins)."""
+    out = dict.fromkeys(BADPUT_REASONS, 0.0)
+    for rec in resizes:
+        begin, end, split = _interval_badput(rec)
+        span = end - begin
+        frac = 1.0
+        if span > 0:
+            lo = begin if since is None else max(begin, since)
+            hi = end if until is None else min(end, until)
+            covered = max(0.0, hi - lo)
+            if covered and exclude:
+                covered = max(0.0,
+                              covered - _overlap_seconds(lo, hi, exclude))
+            frac = covered / span
+        for reason, sec in split.items():
+            out[reason] += sec * frac
+    return out
+
+
+class GoodputLedger:
+    """Accumulate the observed wall-clock split for one job.
+
+    ``update(now, resizes, trainers_live)`` is called by the
+    aggregator's scrape loop: record-derived badput is recomputed from
+    the (monotone) record set and the counters advance by the delta;
+    ``idle`` accrues for scrape intervals observed with zero live
+    trainer targets and no recovery in flight.  ``summary()`` is the
+    ``/healthz`` block."""
+
+    def __init__(self, emit_trace: bool = True):
+        self._t0: float | None = None
+        self._last: float | None = None
+        self._idle_s = 0.0
+        # wall-clock spans already attributed to idle: a recovery's
+        # record only lands after it completes, so downtime long enough
+        # to out-live the trainers' advert leases accrues as idle FIRST
+        # — these spans are excluded when the covering record arrives
+        # (first attribution wins; bounded, oldest dropped)
+        self._idle_spans: list[list[float]] = []
+        self._record_badput = dict.fromkeys(BADPUT_REASONS, 0.0)
+        self._records: list[dict] = []   # last successful record read
+        self._seen_trainers = False      # has a trainer target EVER lived?
+        self._emit_trace = emit_trace
+        self._last_emit = 0.0
+
+    def update(self, now: float, resizes: list[dict] | None,
+               trainers_live: bool) -> dict:
+        """``resizes=None`` means the record read FAILED this scrape —
+        keep the previous baseline (a store blip must not reset it to
+        zero and double-count all prior badput on the next success)."""
+        if self._t0 is None:
+            self._t0 = self._last = now
+        interval = max(0.0, now - self._last)
+        self._last = now
+        if trainers_live:
+            self._seen_trainers = True
+        if resizes is not None:
+            self._records = resizes
+        # does a recovery window cover this instant? idle must not
+        # double-count time a resize already claims
+        in_recovery = any(b <= now <= e + 1.0
+                          for b, e, _s in map(_interval_badput,
+                                              self._records))
+        # idle only counts for a job that HAS trainers: a serving-only
+        # fleet (gateway + replicas, no trainer component ever) must
+        # read ratio 1.0, not accrue 100% idle and latch the
+        # goodput-regression alert on a perfectly healthy job
+        if (self._seen_trainers and not trainers_live and not in_recovery
+                and interval > 0):
+            lo, hi = now - interval, now
+            # a recovery whose end falls inside this interval already
+            # claimed the tail [lo, end] as resize/restore badput on an
+            # earlier scrape — idle starts after the latest such end,
+            # or the same seconds would be attributed twice
+            rec_end = max((e for _b, e, _s in map(_interval_badput,
+                                                  self._records)
+                           if lo < e <= hi), default=None)
+            if rec_end is not None:
+                lo = max(lo, rec_end)
+            dur = hi - lo
+            if dur > 0:
+                self._idle_s += dur
+                BADPUT_SECONDS.labels(reason="idle").inc(dur)
+                self._push_idle_span(lo, hi)
+        # badput clipped to the OBSERVATION window [t0, now] — records
+        # that predate this ledger belong to somebody else's watch —
+        # and excluding spans already attributed to idle (a recovery
+        # long enough to expire the trainers' adverts accrues idle
+        # before its record can exist; first attribution wins)
+        new = classify_records(self._records, since=self._t0, until=now,
+                               exclude=self._idle_spans)
+        for reason in ("resize", "restore", "hang"):
+            # elementwise max keeps the counters monotone even against
+            # a partial/odd record read (records only ever grow)
+            new[reason] = max(new[reason], self._record_badput[reason])
+            delta = new[reason] - self._record_badput[reason]
+            if delta > 0:
+                BADPUT_SECONDS.labels(reason=reason).inc(delta)
+        self._record_badput = new
+        return self._finish(now)
+
+    def _push_idle_span(self, lo: float, hi: float) -> None:
+        if self._idle_spans and lo <= self._idle_spans[-1][1] + 1e-9:
+            self._idle_spans[-1][1] = hi
+            return
+        self._idle_spans.append([lo, hi])
+        if len(self._idle_spans) > 256:
+            # bound memory WITHOUT un-excluding counted idle time:
+            # folding the two oldest spans into one covering span
+            # over-excludes the gap between them (conservative —
+            # ancient badput may be slightly under-counted, but the
+            # same second can never be attributed twice)
+            self._idle_spans[0:2] = [[self._idle_spans[0][0],
+                                      self._idle_spans[1][1]]]
+
+    def _finish(self, now: float) -> dict:
+        summ = self.summary(now)
+        GOODPUT_RATIO_G.set(summ["ratio"])
+        if self._emit_trace and now - self._last_emit >= _EMIT_EVERY_S:
+            self._last_emit = now
+            counters = {"goodput_ratio": round(summ["ratio"], 4)}
+            counters.update({f"badput_{r}_s": round(summ["badput"][r], 3)
+                             for r in BADPUT_REASONS})
+            obs_trace.emit("goodput/sample", counters=counters)
+        return summ
+
+    def summary(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        observed = max(0.0, (now - self._t0) if self._t0 is not None
+                       else 0.0)
+        badput = dict(self._record_badput)
+        badput["idle"] = self._idle_s
+        # record spans can predate the observation window; never let
+        # badput exceed what we actually watched
+        bad_total = min(observed, sum(badput.values()))
+        productive = max(0.0, observed - bad_total)
+        ratio = productive / observed if observed > 0 else 1.0
+        return {"observed_s": round(observed, 3),
+                "productive_s": round(productive, 3),
+                "badput": {r: round(s, 3) for r, s in badput.items()},
+                "ratio": round(ratio, 4)}

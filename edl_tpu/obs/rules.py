@@ -63,6 +63,10 @@ _RECORDED_G = obs_metrics.gauge(
     "edl_alerts_recorded",
     "Recording-rule outputs, by recorded name and series group",
     ("rule", "series"))
+_ACTIONS_TOTAL = obs_metrics.counter(
+    "edl_alert_actions_total",
+    "Alert action hooks invoked on firing transitions, by action and "
+    "outcome (ok / error / no_handler)", ("action", "outcome"))
 
 KINDS = ("gauge", "rate", "stalled", "quantile", "outlier")
 _OPS = {">": lambda v, t: v > t, "<": lambda v, t: v < t,
@@ -98,6 +102,11 @@ class Rule:
     labels: dict = dataclasses.field(default_factory=dict)
     summary: str = ""
     record: str | None = None
+    # action hook: the name of a handler the engine host registered
+    # (RuleEngine ``actions=``) to run on each FIRING transition —
+    # "profile" asks the aggregator to capture a profiler trace on the
+    # alerting instance (the first alert->action plumbing, ROADMAP 4)
+    action: str | None = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -189,7 +198,7 @@ def builtin_rules() -> list[Rule]:
              metric="edl_train_step_seconds",
              match={"component": "trainer"}, by="instance",
              op=">", threshold=2.0, window=60.0 * s, for_s=30.0 * s,
-             min_series=3, severity="warning",
+             min_series=3, severity="warning", action="profile",
              summary="pod step latency > 2x the fleet median"),
         Rule("data-starvation", kind="rate",
              metric="edl_data_spans_requeued_total",
@@ -215,7 +224,7 @@ def builtin_rules() -> list[Rule]:
         Rule("gateway-p99-slo", kind="quantile",
              metric="edl_gateway_request_seconds", q=0.99,
              op=">", threshold=p99_slo, window=120.0 * s, for_s=30.0 * s,
-             severity="critical",
+             severity="critical", action="profile",
              summary="gateway p99 over the latency SLO",
              record="gateway_p99_s"),
         Rule("gateway-reject-burn", kind="rate",
@@ -228,6 +237,17 @@ def builtin_rules() -> list[Rule]:
              op=">", threshold=0.0, window=300.0 * s,
              severity="critical",
              summary="the hang watchdog restarted trainers"),
+        # the goodput ledger publishes edl_goodput_ratio from the
+        # aggregator's own registry, which rides the merged page into
+        # the TSDB — so utilization regressions alert like any signal
+        Rule("goodput-regression", kind="gauge",
+             metric="edl_goodput_ratio",
+             op="<", threshold=float(os.environ.get(
+                 "EDL_TPU_ALERT_GOODPUT_MIN", 0.5)),
+             window=300.0 * s, for_s=60.0 * s, agg="min",
+             severity="warning",
+             summary="the job is spending most of its wall-clock on "
+                     "resizes/restores/hangs/idle instead of training"),
     ]
 
 
@@ -356,11 +376,15 @@ class RuleEngine:
 
     def __init__(self, tsdb: TSDB, rules: list[Rule],
                  incident_log: IncidentLog | None = None,
-                 trace_provider=None):
+                 trace_provider=None, actions: dict | None = None):
         self.tsdb = tsdb
         self.rules = list(rules)
         self.incidents = incident_log
         self._trace_provider = trace_provider
+        # action name -> handler(rule, group, value); a rule naming an
+        # action this host did not register is counted, not an error —
+        # read-only hosts (edl-obs-top's embedded engine) pass none
+        self.actions = dict(actions or {})
         self._lock = threading.Lock()
         self._state: dict[tuple[str, str], _AlertState] = {}
 
@@ -436,7 +460,27 @@ class RuleEngine:
             firing = self._firing_locked()
         for state, rule, group, v in transitions:
             self._incident(state, rule, group, v)
+            if state == "firing" and rule.action:
+                self._run_action(rule, group, v)
         return firing
+
+    def _run_action(self, rule: Rule, group: str, value: float) -> None:
+        """Invoke the rule's action hook on a firing transition —
+        OUTSIDE the engine lock (handlers do network I/O: the profile
+        action GETs the target's /profile endpoint).  Failures are
+        counted and logged; an action can never take down alerting."""
+        handler = self.actions.get(rule.action)
+        if handler is None:
+            _ACTIONS_TOTAL.labels(action=rule.action,
+                                  outcome="no_handler").inc()
+            return
+        try:
+            handler(rule, group, value)
+            _ACTIONS_TOTAL.labels(action=rule.action, outcome="ok").inc()
+        except Exception:  # noqa: BLE001 — an action must not stop alerting
+            logger.exception("alert action %s for rule %s failed",
+                             rule.action, rule.name)
+            _ACTIONS_TOTAL.labels(action=rule.action, outcome="error").inc()
 
     def _resolve(self, rule: Rule, group: str, st: _AlertState,
                  transitions: list) -> None:

@@ -76,6 +76,17 @@ def render_top(health: dict, alerts: dict | None = None,
             f"p99={_fmt_s(gw.get('p99_s'))} "
             f"requests={_fmt_num(gw.get('requests'))} "
             f"[{gw.get('window', '?')}]")
+    gp = health.get("goodput")
+    if gp:
+        bad = gp.get("badput", {})
+        badline = " ".join(f"{r}={_fmt_s(bad[r])}"
+                           for r in ("resize", "restore", "hang", "idle")
+                           if bad.get(r))
+        lines.append(
+            f"  goodput: ratio={_fmt_num(gp.get('ratio'))} "
+            f"productive={_fmt_s(gp.get('productive_s'))} "
+            f"observed={_fmt_s(gp.get('observed_s'))}"
+            f"{('  badput: ' + badline) if badline else ''}")
     rb = health.get("robustness")
     if rb:
         lines.append(
@@ -147,9 +158,11 @@ def main(argv: list[str] | None = None) -> int:
         # incident_dir="": top is a VIEWER — its embedded rule engine
         # must never write incident records next to (and duplicating)
         # the real aggregator's, however EDL_TPU_*_DIR is set
+        # enable_actions=False for the same reason: a viewer must never
+        # trigger profiler captures the real aggregator didn't ask for
         agg = Aggregator(store, args.job_id,
                          scrape_interval=max(args.interval, 0.25),
-                         incident_dir="")
+                         incident_dir="", enable_actions=False)
 
     def frame() -> str:
         if agg is not None:

@@ -312,6 +312,11 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
     args = p.parse_args(argv)
     configure()
     obs.install_from_env("replica")
+    # /profile on the replica's metrics endpoint: the gateway-p99-slo
+    # alert action captures HERE (jax.profiler on real accelerators;
+    # manifest-only on CPU — no step ledger runs in a replica)
+    from edl_tpu.obs import profile as obs_profile
+    obs_profile.install_route(obs_profile.ProfileCapture("replica"))
 
     cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
                             embed_dim=args.embed, num_heads=args.heads,

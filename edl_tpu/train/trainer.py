@@ -45,7 +45,10 @@ from edl_tpu.parallel.mesh import MeshSpec, batch_divisor, build_mesh
 from edl_tpu.parallel.sharding import (
     ShardingRules, logical_sharding, shard_host_batch,
 )
+from edl_tpu.obs import flops as obs_flops
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import profile as obs_profile
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.train.checkpoint import CheckpointManager
 from edl_tpu.train.state import TrainState, abstract_like
@@ -66,6 +69,19 @@ _EXAMPLES_TOTAL = obs_metrics.counter(
     "edl_train_examples_total", "Examples consumed (global batch rows)")
 _EPOCHS_TOTAL = obs_metrics.counter(
     "edl_train_epochs_total", "Completed epochs")
+
+# live MFU: XLA cost-analysis FLOPs (obs/flops.py — the same count
+# bench.py reports) over the step-time EMA, published continuously so
+# utilization is a scrape away instead of a bench artifact away
+_TFLOPS_G = obs_metrics.gauge(
+    "edl_tflops_per_chip",
+    "Achieved TFLOP/s per chip from XLA cost analysis over the "
+    "step-time EMA (train/trainer.py; shares obs/flops.py with bench)")
+_MFU_G = obs_metrics.gauge(
+    "edl_mfu",
+    "Model FLOPs utilization: edl_tflops_per_chip / the chip's known "
+    "bf16 peak (EDL_TPU_PEAK_TFLOPS overrides; absent when the device "
+    "kind is unknown)")
 
 # loss_fn(params, extra, batch, rng) -> (loss, (new_extra, metrics))
 LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[Any, dict]]]
@@ -179,6 +195,17 @@ class ElasticTrainer:
         # the mesh-free skeleton a live reshard rebuilds against
         self._reshard_seen = False
         self._state_spec = None
+        # per-step phase ledger (EDL_TPU_STEP_LEDGER) + the on-demand
+        # profiler capture it backs on CPU; /profile rides the same
+        # endpoint the process already advertises for /metrics
+        self._ledger = obs_ledger.StepPhaseLedger(component="trainer")
+        self._profiler = obs_profile.ProfileCapture("trainer",
+                                                    ledger=self._ledger)
+        obs_profile.install_route(self._profiler)
+        # live MFU: FLOPs/step from XLA cost analysis, computed once per
+        # compiled step function (invalidated with _step_fn on reshard)
+        self._flops_per_step: float | None = None
+        self._mfu_denom: tuple[float | None, int] = (None, 1)
         # id -> (metric_fn, jitted): holding metric_fn pins its id so a
         # recycled id can never alias a different function; bounded so
         # fresh closures per call can't leak jitted executables forever
@@ -445,43 +472,60 @@ class ElasticTrainer:
             meta.in_epoch = epoch
             meta.epoch_start_step = start_step
             meta.data_checkpoint = DataCheckpoint()
-        for gbatch, spans in self._sharded_stream(data_fn(epoch)):
-            if spans:
-                # batches from the data service carry their record spans;
-                # marking HERE (not at production/prefetch time) keeps
-                # mid-epoch checkpoints exactly consistent with what has
-                # actually been trained, whatever the prefetch depth
-                for fi, b, e in spans:
-                    meta.data_checkpoint.mark_processed(fi, b, e)
-            self._profile_hook(start_step + n_steps + 1)
-            rng, step_rng = jax.random.split(rng)
-            state, metrics = self.step_fn(state, gbatch, step_rng)
+        ledger = self._ledger
+        stream = iter(self._sharded_stream(data_fn(epoch)))
+        while True:
+            # time blocked obtaining the batch — input-bound time; the
+            # h2d staging wait inside the stream credits itself and is
+            # deducted, so data_wait is the prefetch-ran-dry remainder
+            with ledger.phase("data_wait"):
+                item = next(stream, None)
+            if item is None:
+                break
+            gbatch, spans = item
+            with ledger.phase("hooks"):
+                if spans:
+                    # batches from the data service carry their record
+                    # spans; marking HERE (not at production/prefetch
+                    # time) keeps mid-epoch checkpoints exactly
+                    # consistent with what has actually been trained,
+                    # whatever the prefetch depth
+                    for fi, b, e in spans:
+                        meta.data_checkpoint.mark_processed(fi, b, e)
+                self._profile_hook(start_step + n_steps + 1)
+                rng, step_rng = jax.random.split(rng)
+            with ledger.phase("compute"):
+                state, metrics = self.step_fn(state, gbatch, step_rng)
             n_steps += 1
-            self._observe_step_time()
-            _STEPS_TOTAL.inc()
-            # global batch rows, counted by process 0 only: scrapes are
-            # per-process and Prometheus sums across targets, so every
-            # process counting the GLOBAL dimension would overcount by
-            # the process count
-            if jax.process_index() == 0:
-                leaves = jax.tree.leaves(gbatch)
-                if leaves and getattr(leaves[0], "shape", None):
-                    _EXAMPLES_TOTAL.inc(int(leaves[0].shape[0]))
-            if self._t_restored is not None:
-                self._report_recovery(metrics)
-            self._heartbeat()
             step = start_step + n_steps
-            self._maybe_preempt(state, meta, step)
-            if self.cfg.log_every and step % self.cfg.log_every == 0:
-                logger.info("epoch %d step %d: %s", epoch, step,
-                            {k: float(v) for k, v in metrics.items()})
-            if self._profiling and step >= self.cfg.profile_window[1]:
-                self._stop_profile()
+            self._observe_step_time(step)
+            with ledger.phase("hooks"):
+                _STEPS_TOTAL.inc()
+                # global batch rows, counted by process 0 only: scrapes
+                # are per-process and Prometheus sums across targets, so
+                # every process counting the GLOBAL dimension would
+                # overcount by the process count
+                if jax.process_index() == 0:
+                    leaves = jax.tree.leaves(gbatch)
+                    if leaves and getattr(leaves[0], "shape", None):
+                        _EXAMPLES_TOTAL.inc(int(leaves[0].shape[0]))
+                if self._flops_per_step is None:
+                    self._compute_flops(state, gbatch, step_rng)
+                if self._t_restored is not None:
+                    self._report_recovery(metrics)
+                self._heartbeat()
+                self._maybe_preempt(state, meta, step)
+                if self.cfg.log_every and step % self.cfg.log_every == 0:
+                    logger.info("epoch %d step %d: %s", epoch, step,
+                                {k: float(v) for k, v in metrics.items()})
+                if self._profiling and step >= self.cfg.profile_window[1]:
+                    self._stop_profile()
             if (self.ckpt is not None and self.cfg.save_every_steps
                     and step % self.cfg.save_every_steps == 0):
-                meta.step = step
-                self._sync_data_checkpoint(meta)
-                self.ckpt.save(step, state, meta)
+                with ledger.phase("checkpoint"):
+                    meta.step = step
+                    self._sync_data_checkpoint(meta)
+                    self.ckpt.save(step, state, meta)
         dt = time.monotonic() - t_epoch
         # step_num covers the WHOLE epoch, including segments trained
         # before a mid-epoch stop-resume; avg time reflects this segment
@@ -491,23 +535,26 @@ class ElasticTrainer:
         meta.step = start_step + n_steps
         meta.epoch_no = epoch
         meta.in_epoch = -1  # epoch complete: next resume starts the next one
+        ledger.flush(step=start_step + n_steps)
         if self.ckpt is not None:
-            self._sync_data_checkpoint(meta)
-            if (self.cfg.save_every_steps
-                    and self.ckpt.latest_step() == int(state.step)):
-                # the last mid-epoch save already committed this step's
-                # arrays; just patch its sidecar with the end-of-epoch
-                # accounting (in_epoch=-1, the epoch record)
-                self.ckpt.save_meta(int(state.step), meta)
-            else:
-                self.ckpt.save(int(state.step), state, meta, force=True)
-            # Under the elastic launcher a membership change SIGTERMs the
-            # trainer between epochs; drain the async save so the resize
-            # never lands before any checkpoint committed (a killed
-            # pending save would cold-start the resized job, losing all
-            # progress).  Standalone runs keep saves fully async.
-            if self.tenv is not None and self.tenv.pod_id:
-                self.ckpt.wait()
+            with ledger.phase("checkpoint"):
+                self._sync_data_checkpoint(meta)
+                if (self.cfg.save_every_steps
+                        and self.ckpt.latest_step() == int(state.step)):
+                    # the last mid-epoch save already committed this
+                    # step's arrays; just patch its sidecar with the
+                    # end-of-epoch accounting (in_epoch=-1, the record)
+                    self.ckpt.save_meta(int(state.step), meta)
+                else:
+                    self.ckpt.save(int(state.step), state, meta, force=True)
+                # Under the elastic launcher a membership change SIGTERMs
+                # the trainer between epochs; drain the async save so the
+                # resize never lands before any checkpoint committed (a
+                # killed pending save would cold-start the resized job,
+                # losing all progress).  Standalone runs keep saves
+                # fully async.
+                if self.tenv is not None and self.tenv.pod_id:
+                    self.ckpt.wait()
         if on_epoch_end is not None:
             # The epoch checkpoint is committed FIRST so a SIGTERM during
             # the hook (a long eval pass) can't lose the epoch's training;
@@ -542,12 +589,26 @@ class ElasticTrainer:
                 spans = batch.pop(_SPANS_KEY)
             return batch, spans
 
+        ledger = self._ledger
         if not self.cfg.prefetch_batches:
             for batch in batches:
                 batch, spans = split(batch)
-                yield shard_host_batch(batch, self.mesh, self.rules), spans
+                t0 = time.perf_counter()
+                g = shard_host_batch(batch, self.mesh, self.rules)
+                ledger.add("h2d", time.perf_counter() - t0)
+                yield g, spans
             return
         from concurrent.futures import ThreadPoolExecutor
+
+        def staged(fut):
+            # the wait for the staging thread IS the unhidden host->
+            # device time; it runs inside the consumer's data_wait
+            # phase and credits itself out of it
+            t0 = time.perf_counter()
+            g = fut.result()
+            ledger.add("h2d", time.perf_counter() - t0)
+            return g
+
         with ThreadPoolExecutor(1) as pool:
             fut = None
             for batch in batches:
@@ -555,10 +616,10 @@ class ElasticTrainer:
                 nxt = (pool.submit(shard_host_batch, batch, self.mesh,
                                    self.rules), spans)
                 if fut is not None:
-                    yield fut[0].result(), fut[1]
+                    yield staged(fut[0]), fut[1]
                 fut = nxt
             if fut is not None:
-                yield fut[0].result(), fut[1]
+                yield staged(fut[0]), fut[1]
 
     # -- profiler window (reference train_with_fleet.py:521-530) -------------
     _profiling = False
@@ -609,19 +670,96 @@ class ElasticTrainer:
     _run_t0: float | None = None
     _warned_no_beat = False
 
-    def _observe_step_time(self) -> None:
+    def _observe_step_time(self, step: int | None = None) -> None:
         """EMA of the wall time between completed-step observations.
         Steps dispatch asynchronously, but with a bounded dispatch
         queue the steady-state loop rate equals the device step rate,
         so the EMA converges on the true step time (the first gaps —
-        compile — are absorbed by the EMA and the threshold floor)."""
+        compile — are absorbed by the EMA and the threshold floor).
+        Also closes the step's phase ledger against the interval and
+        refreshes the live MFU gauges."""
         now = time.monotonic()
         if self._last_step_t is not None:
             dt = now - self._last_step_t
             self._step_ema = (dt if self._step_ema is None
                               else 0.9 * self._step_ema + 0.1 * dt)
             _STEP_SECONDS.observe(dt)
+            self._ledger.step_done(dt, step=step)
+            self._publish_mfu()
+        else:
+            # first observation (fresh run / post-reshard): no interval
+            # exists, and the phases accumulated so far include the jit
+            # compile — discard them instead of attributing a
+            # compile-sized "compute" sample to the next step
+            self._ledger.reset()
         self._last_step_t = now
+
+    def _compute_flops(self, state, gbatch, rng) -> None:
+        """FLOPs of one compiled step from XLA cost analysis — once per
+        step function (obs/flops.py, the same count bench reports).
+
+        Runs on a BACKGROUND daemon thread: the AOT ``lower().compile()``
+        path does not share the jit dispatch cache (measured: a full
+        recompile), so on a big model it can cost a real compile — that
+        must never stall the train loop (or be booked as a giant hooks
+        phase).  The thread sees only ``ShapeDtypeStruct`` skeletons,
+        never device arrays — but its reference to the jitted function
+        itself pins compiled executables, and so the backend.  For a
+        stop-resume trainer that is harmless (teardown is process
+        death); a DELTA-capable trainer must be able to truly destroy
+        its old backend mid-reshard (train/distributed.leak_world —
+        peers hang on our open gloo sockets otherwise), and a thread
+        mid-compile cannot be swept.  So live MFU is skipped when the
+        delta path is armed — phase ledger and goodput still run; the
+        bench artifact still reports MFU for the model.  The result
+        lands only if the step function is still the one it was
+        computed for.  Gated with the ledger so EDL_TPU_STEP_LEDGER=0
+        disables every continuous-profiling surface at once; 0.0 =
+        pending-or-unanswerable, so there is no per-step retry."""
+        self._flops_per_step = 0.0
+        if not self._ledger.enabled or self._delta_ready():
+            return
+        jitted = self.step_fn
+
+        def skel(x):
+            if hasattr(x, "shape") and hasattr(x, "sharding"):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=x.sharding)
+            return x
+
+        try:
+            args = jax.tree.map(skel, (state, gbatch, rng))
+        except Exception:  # noqa: BLE001 — no MFU, never a stall
+            logger.exception("MFU arg skeleton failed; live MFU disabled")
+            return
+
+        def run():
+            flops = obs_flops.xla_cost_flops(jitted, *args)
+            try:
+                denom = (obs_flops.peak_tflops(jax.devices()[0]),
+                         jax.device_count())
+            except Exception:  # noqa: BLE001 — no backend, no MFU
+                denom = (None, 1)
+            if flops and self._step_fn is jitted:
+                self._mfu_denom = denom
+                self._flops_per_step = flops
+                # publish immediately too: a short job may finish its
+                # last step before this thread lands
+                self._publish_mfu()
+
+        import threading
+        threading.Thread(target=run, daemon=True,
+                         name="edl-mfu-cost-analysis").start()
+
+    def _publish_mfu(self) -> None:
+        if not (self._flops_per_step and self._step_ema):
+            return
+        peak, n_dev = self._mfu_denom
+        tflops = (self._flops_per_step / self._step_ema
+                  / max(1, n_dev) / 1e12)
+        _TFLOPS_G.set(tflops)
+        if peak:
+            _MFU_G.set(tflops / peak)
 
     def _heartbeat(self) -> None:
         """Throttled liveness beat after a completed step (rank 0 in
@@ -969,6 +1107,7 @@ class ElasticTrainer:
         # must go first: its Device objects pin the old client (and so
         # its open sockets) through any clear_backends.
         self._step_fn = None
+        self._flops_per_step = None
         self._eval_cache.clear()
         self.mesh = None
         dist.leak_world()
@@ -1012,6 +1151,7 @@ class ElasticTrainer:
         # sockets, and the pause path has nothing left to compute.  The
         # mesh's Device objects pin the old client, so it goes first
         self._step_fn = None
+        self._flops_per_step = None
         self._eval_cache.clear()
         self.mesh = None
         dist.leak_world()

@@ -93,6 +93,16 @@ JAX_PLATFORMS=cpu python scripts/obs_agg_smoke.py
 # fire the data-leader MTTR rule off the reader's observed outage
 JAX_PLATFORMS=cpu python scripts/alerts_smoke.py
 
+# profiling + goodput smoke: the continuous-profiling layer end to
+# end — a real instrumented trainer's phase ledger must account for
+# >=95% of step wall time and publish live MFU; the aggregator's
+# /healthz must carry the goodput block and a resize record must move
+# edl_badput_seconds_total{reason="resize"} and nothing else; a
+# straggler alert must auto-trigger a profile capture whose manifest
+# carries the generation trace id and joins the merged timeline, with
+# Perfetto counter tracks alongside the span rows
+JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
+
 # transfer smoke: the streaming data plane's microbench (loopback,
 # small payload, subprocess holders) — pipelined/striped fetch must not
 # regress below the serial baseline, and the MiB/s numbers land in the
@@ -133,6 +143,9 @@ assert out['obs_scrape_overhead_pct'] < 5, out['obs_scrape_overhead_pct']
 # on the same grow-by-one (it skips process respawn + jax cold import)
 dl, sr = out['resize_delta_mttr_s'], out['resize_stop_resume_mttr_s']
 assert dl <= sr, (dl, sr)
+# continuous profiling (ISSUE 13): the per-step phase ledger must cost
+# the hot loop under 2% of step time (measured directly, noise-immune)
+assert out['step_phase_overhead_pct'] < 2, out['step_phase_overhead_pct']
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""CI continuous-profiling + goodput smoke (scripts/ci.sh, ISSUE 13).
+
+One instrumented run proves the whole layer end to end:
+
+1. **phase ledger** — a real ``ElasticTrainer`` job runs with the
+   step ledger on; the ledger's phase sum must account for >= 95% of
+   step wall time (``edl_step_ledger_coverage_ratio``) and the live
+   MFU gauges (``edl_tflops_per_chip`` / ``edl_mfu`` — shared
+   obs/flops.py cost analysis, ``EDL_TPU_PEAK_TFLOPS`` pinned) must
+   publish;
+2. **goodput on /healthz** — a real AggregatorServer scrape loop over
+   a 3-"trainer" fleet reports the goodput block; a resize record
+   pushed through the unified recovery write path must move
+   ``edl_badput_seconds_total{reason="resize"}`` by exactly its
+   launcher span and NOTHING else (restore/hang/idle stay 0);
+3. **profile-on-alert** — the built-in ``trainer-straggler`` rule
+   (windows shrunk via ``EDL_TPU_ALERT_SCALE``) fires on the slow
+   fleet member; the aggregator's ``action="profile"`` hook must GET
+   that instance's ``/profile`` endpoint, and the capture manifest
+   must land on disk carrying the published generation trace_id;
+4. **timeline join** — the capture's ``profile/capture`` event and the
+   ledger's ``train/step_phases`` events join the generation trace in
+   ``edl-obs-dump --merge``, and the Perfetto export carries ``"C"``
+   counter samples (step phases / goodput) next to the span rows.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_TRACE_DIR = os.environ.setdefault("EDL_TPU_TRACE_DIR",
+                                   tempfile.mkdtemp(prefix="edl-prof-"))
+_PROFILE_DIR = os.environ.setdefault("EDL_TPU_PROFILE_DIR",
+                                     tempfile.mkdtemp(prefix="edl-prof-out-"))
+os.environ["EDL_TPU_METRICS_PORT"] = "0"
+os.environ.setdefault("EDL_TPU_ALERT_SCALE", "0.1")   # 6s straggler window
+os.environ.setdefault("EDL_TPU_PEAK_TFLOPS", "1")     # CPU: any peak -> MFU
+os.environ.setdefault("EDL_TPU_PROFILE_DURATION", "0.5")
+os.environ.setdefault("EDL_TPU_PROFILE_COOLDOWN", "0")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# a fleet "trainer": /metrics + a TTL-leased advert + the /profile
+# route backed by a phase ledger — the straggler's capture surface
+_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.obs import advert, context as obs_context
+from edl_tpu.obs import profile as obs_profile, trace as obs_trace
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.ledger import StepPhaseLedger
+from edl_tpu.obs.metrics import Registry
+
+coord_ep, job, step_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+obs_context.install_from_env()                 # the generation trace
+obs_trace.configure_from_env("trainer")
+reg = Registry()
+steps = reg.histogram("edl_train_step_seconds", "per-step wall time")
+ledger = StepPhaseLedger(enabled=True, component="trainer")
+obs_profile.install_route(obs_profile.ProfileCapture("trainer",
+                                                     ledger=ledger))
+srv = MetricsServer(reg, host="127.0.0.1").start()
+store = CoordClient(coord_ep)
+advert.advertise_metrics(store, job, "trainer", srv.endpoint,
+                         name=f"trainer-{{os.getpid()}}", ttl=60)
+print("trainer up", srv.endpoint, flush=True)
+while True:
+    time.sleep(step_s)
+    steps.observe(step_s)
+    with ledger.phase("compute"):
+        pass
+    ledger.step_done(step_s)
+"""
+
+
+def _spawn_trainer(coord_ep, job, step_s, ctx):
+    env = dict(os.environ, EDL_TPU_METRICS_PORT="",
+               EDL_TPU_TRACE_CONTEXT=ctx.to_env())
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD.format(repo=_REPO),
+         coord_ep, job, str(step_s)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "trainer up" in line:
+            return proc, line.rsplit(" ", 1)[-1].strip()
+        if not line and proc.poll() is not None:
+            raise AssertionError("trainer child died before announcing")
+    raise AssertionError("trainer child never announced")
+
+
+def _get_json(url):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read().decode())
+
+
+def _train_instrumented() -> None:
+    """A real ElasticTrainer run under the ledger; gates coverage and
+    the live MFU gauges from this process's registry."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.cluster.state import State
+    from edl_tpu.obs.metrics import REGISTRY
+    from edl_tpu.train import ElasticTrainer, TrainConfig
+
+    rng = np.random.default_rng(0)
+
+    def loss(params, extra, batch, _rng):
+        pred = jnp.tanh(batch["x"] @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - batch["y"]) ** 2), (extra, {})
+
+    def batches():
+        # batch/width sized so a step costs a few ms: the coverage gate
+        # measures the ledger against realistic steps, not loop glue on
+        # microsecond toy steps
+        for _ in range(60):
+            x = rng.normal(size=(128, 384)).astype(np.float32)
+            yield {"x": x, "y": rng.normal(size=(128, 1)).astype(np.float32)}
+
+    trainer = ElasticTrainer(loss, TrainConfig(log_every=0))
+    state = trainer.create_state(
+        lambda: ({"w1": jnp.zeros((384, 384)), "w2": jnp.zeros((384, 1))},
+                 None), optax.sgd(0.01))
+    trainer.fit(state, State(), lambda e: batches(), epochs=2)
+
+    cover = REGISTRY.get("edl_step_ledger_coverage_ratio").value
+    assert cover >= 0.95, \
+        f"phase ledger covers {cover:.3f} < 0.95 of step wall time"
+    phase_count = sum(
+        REGISTRY.get("edl_step_phase_seconds").labels(phase=p).count
+        for p in ("data_wait", "h2d", "compute", "hooks", "checkpoint"))
+    assert phase_count > 0, "no phase observations recorded"
+    # cost analysis runs on a background thread (it must never stall
+    # the train loop) and publishes the gauges when it lands
+    deadline = time.time() + 20
+    while (time.time() < deadline
+           and REGISTRY.get("edl_tflops_per_chip").value == 0):
+        time.sleep(0.1)
+    tflops = REGISTRY.get("edl_tflops_per_chip").value
+    mfu = REGISTRY.get("edl_mfu").value
+    assert tflops > 0, "edl_tflops_per_chip never published"
+    assert mfu > 0, "edl_mfu never published (EDL_TPU_PEAK_TFLOPS is set)"
+    print(f"smoke: ledger coverage {cover:.3f}, live mfu {mfu:.3g} "
+          f"({tflops:.3g} TFLOP/s/chip vs pinned peak)")
+
+
+def main() -> None:
+    from edl_tpu import obs
+    from edl_tpu.cluster import recovery
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.obs import context as obs_context
+    from edl_tpu.obs import dump as obs_dump
+    from edl_tpu.obs import rules as obs_rules
+    from edl_tpu.obs import trace as obs_trace
+    from edl_tpu.obs.advert import publish_job_trace
+    from edl_tpu.obs.agg import AggregatorServer
+    from edl_tpu.obs.metrics import parse_exposition
+
+    job = "profsmoke"
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = CoordClient(coord_ep)
+
+    # the generation trace everything must join (the launcher contract)
+    ctx = obs_context.new_trace(job=job)
+    obs_context.set_process_root(ctx)
+    obs.install_from_env("parent")
+    publish_job_trace(store, job, ctx, stage="gen0")
+    obs_trace.emit("smoke/generation", stage="gen0")
+
+    # 1 -- instrumented training in THIS process
+    _train_instrumented()
+
+    strag = {r.name: r for r in obs_rules.builtin_rules()}["trainer-straggler"]
+    children = [_spawn_trainer(coord_ep, job, s, ctx)
+                for s in (0.05, 0.05, 0.25)]
+    slow_ep = children[2][1]
+    agg_srv = None
+    try:
+        agg_srv = AggregatorServer(
+            store, job, host="127.0.0.1", cache_s=0.0,
+            scrape_interval=0.25, incident_dir=_TRACE_DIR).start()
+        agg_ep = agg_srv.endpoint
+
+        # 2 -- goodput on /healthz; a resize moves ONLY reason="resize"
+        health = _get_json(f"http://{agg_ep}/healthz")
+        assert "goodput" in health and "ratio" in health["goodput"], health
+        # the record's span must land INSIDE the goodput observation
+        # window (badput is clipped to what the ledger watched — an
+        # aggregator restarted onto an old job must not inherit its
+        # history), so let the ledger open first, then backdate less
+        # than that
+        time.sleep(1.5)
+        t0 = time.time()
+        recovery.write_launcher_half(
+            store, job, "stageA", "pod0",
+            {"detect": t0 - 0.9, "killed": t0 - 0.6, "barrier": t0 - 0.5,
+             "spawn": t0 - 0.2})                 # 0.7s launcher span
+        deadline = time.time() + 30
+        gp = None
+        while time.time() < deadline:
+            gp = _get_json(f"http://{agg_ep}/healthz").get("goodput", {})
+            if gp.get("badput", {}).get("resize"):
+                break
+            time.sleep(0.25)
+        assert gp and abs(gp["badput"]["resize"] - 0.7) < 0.01, gp
+        for other in ("restore", "hang", "idle"):
+            assert gp["badput"][other] == 0.0, \
+                f"resize moved badput[{other}] too: {gp}"
+        assert 0.0 <= gp["ratio"] < 1.0, gp
+        page = urllib.request.urlopen(f"http://{agg_ep}/metrics",
+                                      timeout=10).read().decode()
+        parsed = parse_exposition(page)
+        assert parsed[("edl_badput_seconds_total",
+                       (("component", "obs-agg"), ("instance", "self"),
+                        ("reason", "resize")))] > 0
+        assert any(n == "edl_goodput_ratio" for n, _l in parsed), \
+            "edl_goodput_ratio missing from the merged page"
+        print(f"smoke: goodput on /healthz, resize badput "
+              f"{gp['badput']['resize']:.1f}s (ratio {gp['ratio']:.3f}), "
+              f"no other reason moved")
+
+        # 3 -- straggler alert -> automatic profile capture on the slow pod
+        bound = (strag.window + strag.for_s) * 2 + 20.0
+        deadline = time.time() + bound
+        alert = None
+        while time.time() < deadline:
+            firing = _get_json(f"http://{agg_ep}/alerts")["firing"]
+            hit = [a for a in firing if a["alert"] == "trainer-straggler"]
+            if hit:
+                alert = hit[0]
+                break
+            time.sleep(0.2)
+        assert alert is not None, "trainer-straggler never fired"
+        assert alert.get("instance") == slow_ep, alert
+        manifest = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            for path in glob.glob(os.path.join(_PROFILE_DIR,
+                                               "profile-*.json")):
+                with open(path, encoding="utf-8") as f:
+                    m = json.load(f)
+                if m.get("trigger") == "alert":
+                    manifest = m
+                    break
+            if manifest:
+                break
+            time.sleep(0.25)
+        assert manifest is not None, \
+            f"no alert-triggered capture landed in {_PROFILE_DIR}"
+        assert manifest.get("trace_id") == ctx.trace_id, \
+            f"capture trace_id {manifest.get('trace_id')} != generation " \
+            f"trace {ctx.trace_id}"
+        print(f"smoke: straggler alert on {slow_ep} auto-captured a "
+              f"{manifest['kind']} profile carrying trace "
+              f"{ctx.trace_id[:8]}")
+
+        # 4 -- the capture + step phases join the merged timeline, and
+        # Perfetto gets counter tracks
+        events, _skipped = obs_dump.read_trace_dir(_TRACE_DIR)
+        tl = obs_dump.merge_timeline(events, ctx.trace_id)
+        names = {e["name"] for e in tl}
+        assert "profile/capture" in names, sorted(names)
+        assert "train/step_phases" in names, sorted(names)
+        pf = obs_dump.to_perfetto(obs_dump.merge_timeline(events))
+        counter_tracks = {e["name"] for e in pf["traceEvents"]
+                          if e.get("ph") == "C"}
+        assert "train/step_phases" in counter_tracks, counter_tracks
+        json.dumps(pf)
+        print(f"smoke: capture + step phases joined trace "
+              f"{ctx.trace_id[:8]} ({len(tl)} events); Perfetto counter "
+              f"tracks: {sorted(counter_tracks)}")
+    finally:
+        if agg_srv is not None:
+            agg_srv.stop()
+        for proc, _ in children:
+            proc.kill()
+        store.close()
+        coord.stop()
+    print("profiling smoke OK")
+
+
+if __name__ == "__main__":
+    main()

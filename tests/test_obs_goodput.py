@@ -1,0 +1,261 @@
+"""Elastic goodput accounting (obs/goodput.py, ISSUE 13): wall-clock
+classification across every resize shape, and the ledger's live
+idle/productive split."""
+
+from edl_tpu.obs import goodput as gp
+from edl_tpu.obs.goodput import GoodputLedger, classify_records
+
+
+def _stop_resume(stage="s1", detect=100.0):
+    # a summarize_recovery entry for a full stop-resume resize
+    return {"stage": stage, "resize_mode": "stop_resume",
+            "detect_at": detect,
+            "detect_to_kill": 1.0, "kill_to_barrier": 2.0,
+            "barrier_to_spawn": 3.0,
+            "spawn_to_restored": 4.0, "restored_to_first_step": 5.0,
+            "total": 15.0}
+
+
+def _delta_fallback(stage="s2", detect=200.0):
+    # a delta attempt that fell back: BOTH flagged and killed phases
+    # present (the delta attempt sits inside detect_to_kill), plus the
+    # trainer half from the eventual stop-resume
+    return {"stage": stage, "resize_mode": "stop_resume",
+            "detect_at": detect,
+            "detect_to_flag": 0.5, "flag_to_barrier": 1.5,
+            "detect_to_kill": 4.0, "kill_to_barrier": 1.0,
+            "barrier_to_spawn": 2.0,
+            "spawn_to_restored": 1.0, "restored_to_first_step": 2.0,
+            "total": 10.0}
+
+
+def _hang(stage="s1+hang1700000000", detect=300.0):
+    return {"stage": stage, "resize_mode": "stop_resume",
+            "detect_at": detect,
+            "detect_to_kill": 0.5, "kill_to_barrier": 0.5,
+            "barrier_to_spawn": 1.0,
+            "spawn_to_restored": 1.0, "restored_to_first_step": 1.0,
+            "total": 4.0}
+
+
+def _delta(stage="s3", detect=400.0):
+    return {"stage": stage, "resize_mode": "delta", "detect_at": detect,
+            "detect_to_flag": 0.2, "flag_to_barrier": 0.8,
+            "barrier_to_reshard": 1.5,
+            "spawn_to_restored": 0.5, "restored_to_first_step": 1.0,
+            "total": 4.0}
+
+
+def test_stop_resume_split():
+    out = classify_records([_stop_resume()])
+    # restore = spawn_to_restored + restored_to_first_step = 9; the
+    # launcher-side remainder of the 15s total is resize
+    assert out["restore"] == 9.0
+    assert out["resize"] == 6.0
+    assert out["hang"] == 0.0 and out["idle"] == 0.0
+
+
+def test_delta_and_fallback_split():
+    out = classify_records([_delta(), _delta_fallback()])
+    # delta: total 4 = 1.5 restore + 2.5 resize; fallback: total 10 =
+    # 3 restore + 7 resize (the failed delta attempt is resize badput)
+    assert out["restore"] == 1.5 + 3.0
+    assert out["resize"] == 2.5 + 7.0
+
+
+def test_hang_record_is_all_hang():
+    out = classify_records([_hang()])
+    assert out["hang"] == 4.0
+    assert out["resize"] == 0.0 and out["restore"] == 0.0
+
+
+def test_launcher_half_only_counts_as_resize():
+    rec = {"stage": "s9", "detect_at": 100.0,
+           "detect_to_kill": 1.0, "kill_to_barrier": 2.0}
+    out = classify_records([rec])
+    assert out["resize"] == 3.0
+    assert out["restore"] == 0.0
+
+
+def test_launcher_half_fallback_record_is_not_double_counted():
+    # a delta FALLBACK record carries phases of BOTH chains over the
+    # SAME wall-clock (the delta attempt sits inside detect_to_kill):
+    # the span is the LONGER chain, never the sum of both
+    rec = {"stage": "sf", "detect_at": 100.0,
+           "detect_to_flag": 0.5, "flag_to_barrier": 1.0,      # delta: 1.5
+           "detect_to_kill": 4.0, "kill_to_barrier": 1.0,
+           "barrier_to_spawn": 2.0}                            # resume: 7.0
+    out = classify_records([rec])
+    assert out["resize"] == 7.0
+
+
+def test_negative_durations_clamped():
+    # the PR-11 edge: fallback phase arithmetic can go negative in raw
+    # records; classification must clamp, never emit negative badput
+    rec = {"stage": "s8", "detect_at": 100.0,
+           "spawn_to_restored": -2.0, "restored_to_first_step": 1.0,
+           "total": 0.5}
+    out = classify_records([rec])
+    assert out["restore"] == 0.5          # capped by the record's total
+    assert out["resize"] == 0.0
+    assert all(v >= 0.0 for v in out.values())
+
+
+def test_restore_never_exceeds_total():
+    rec = {"stage": "s7", "detect_at": 0.0, "spawn_to_restored": 50.0,
+           "restored_to_first_step": 50.0, "total": 10.0}
+    out = classify_records([rec])
+    assert out["restore"] == 10.0 and out["resize"] == 0.0
+
+
+def _counter(reason):
+    return gp.BADPUT_SECONDS.labels(reason=reason).value
+
+
+def test_ledger_idle_and_productive_split():
+    led = GoodputLedger(emit_trace=False)
+    base = {r: _counter(r) for r in gp.BADPUT_REASONS}
+    led.update(1000.0, [], trainers_live=True)      # window opens
+    s = led.update(1010.0, [], trainers_live=True)
+    assert s["ratio"] == 1.0 and s["productive_s"] == 10.0
+    # 5s with no live trainers and no recovery window -> idle
+    s = led.update(1015.0, [], trainers_live=False)
+    assert s["badput"]["idle"] == 5.0
+    assert s["productive_s"] == 10.0
+    assert abs(s["ratio"] - 10.0 / 15.0) < 1e-4  # summary rounds to 4dp
+    assert _counter("idle") - base["idle"] == 5.0
+
+
+def test_ledger_records_move_only_their_reason():
+    led = GoodputLedger(emit_trace=False)
+    base = {r: _counter(r) for r in gp.BADPUT_REASONS}
+    led.update(1000.0, [], trainers_live=True)
+    # a resize record lands (launcher half only -> pure resize badput):
+    # ONLY reason="resize" may move
+    rec = {"stage": "sx", "detect_at": 1001.0, "detect_to_kill": 2.0}
+    s = led.update(1010.0, [rec], trainers_live=True)
+    assert _counter("resize") - base["resize"] == 2.0
+    for other in ("restore", "hang", "idle"):
+        assert _counter(other) - base[other] == 0.0
+    assert s["badput"]["resize"] == 2.0
+    # records are monotone: a second update with the same set moves nothing
+    led.update(1020.0, [rec], trainers_live=True)
+    assert _counter("resize") - base["resize"] == 2.0
+
+
+def test_ledger_no_idle_during_recovery_window():
+    led = GoodputLedger(emit_trace=False)
+    base_idle = _counter("idle")
+    led.update(1000.0, [], trainers_live=True)
+    # trainers dead AT a covering resize record's instant: that time is
+    # the resize's, not idle's — no double count
+    rec = {"stage": "sy", "detect_at": 999.0, "detect_to_kill": 30.0}
+    led.update(1005.0, [rec], trainers_live=False)
+    assert _counter("idle") - base_idle == 0.0
+
+
+def test_classify_records_window_clipping():
+    rec = {"stage": "sw", "detect_at": 100.0, "detect_to_kill": 10.0}
+    # fully inside / fully before / straddling the window
+    assert classify_records([rec], since=90.0, until=200.0)["resize"] == 10.0
+    assert classify_records([rec], since=120.0, until=200.0)["resize"] == 0.0
+    half = classify_records([rec], since=105.0, until=200.0)["resize"]
+    assert abs(half - 5.0) < 1e-9
+    # monotone in a growing `until`
+    early = classify_records([rec], since=90.0, until=104.0)["resize"]
+    later = classify_records([rec], since=90.0, until=108.0)["resize"]
+    assert early < later <= 10.0
+
+
+def test_ledger_prewindow_records_are_not_observed_badput():
+    # the aggregator-restart scenario: a job with 400s of historical
+    # resize badput must not zero a fresh ledger's ratio
+    led = GoodputLedger(emit_trace=False)
+    base = _counter("resize")
+    old = {"stage": "old", "detect_at": 0.0, "detect_to_kill": 400.0}
+    led.update(1000.0, [old], trainers_live=True)
+    s = led.update(1300.0, [old], trainers_live=True)
+    assert _counter("resize") - base == 0.0
+    assert s["ratio"] == 1.0 and s["productive_s"] == 300.0
+
+
+def test_ledger_store_blip_keeps_baseline():
+    # a failed record read (resizes=None) must not reset the baseline:
+    # the next successful read would otherwise re-add all prior badput
+    led = GoodputLedger(emit_trace=False)
+    base = _counter("resize")
+    led.update(1000.0, [], trainers_live=True)
+    rec = {"stage": "sb", "detect_at": 1001.0, "detect_to_kill": 2.0}
+    led.update(1010.0, [rec], trainers_live=True)
+    assert _counter("resize") - base == 2.0
+    led.update(1020.0, None, trainers_live=True)       # blip
+    led.update(1030.0, [rec], trainers_live=True)      # store recovers
+    assert _counter("resize") - base == 2.0            # NOT 4.0
+
+
+def test_ledger_idle_then_record_does_not_double_count():
+    # a recovery longer than the advert TTL: trainers vanish, idle
+    # accrues, THEN the record lands covering the same wall-clock —
+    # that time must stay idle, not be re-counted as resize
+    led = GoodputLedger(emit_trace=False)
+    base = {r: _counter(r) for r in gp.BADPUT_REASONS}
+    led.update(1000.0, [], trainers_live=True)
+    led.update(1010.0, [], trainers_live=False)   # idle span [1000,1010]
+    assert _counter("idle") - base["idle"] == 10.0
+    rec = {"stage": "sd", "detect_at": 1002.0, "detect_to_kill": 6.0}
+    led.update(1020.0, [rec], trainers_live=True)
+    # the record's [1002,1008] span is fully inside the idle span
+    assert _counter("resize") - base["resize"] == 0.0
+    assert _counter("idle") - base["idle"] == 10.0
+
+
+def test_ledger_partial_idle_overlap_attributes_remainder():
+    led = GoodputLedger(emit_trace=False)
+    base = {r: _counter(r) for r in gp.BADPUT_REASONS}
+    led.update(1000.0, [], trainers_live=True)
+    led.update(1010.0, [], trainers_live=False)   # idle span [1000,1010]
+    # record spans [1005,1015]: 5s already idle, 5s genuinely new
+    rec = {"stage": "sp", "detect_at": 1005.0, "detect_to_kill": 10.0}
+    led.update(1020.0, [rec], trainers_live=True)
+    assert _counter("resize") - base["resize"] == 5.0
+
+
+def test_ledger_idle_starts_after_a_record_tail():
+    # a recovery ends mid-scrape-interval while trainers stay dead:
+    # the tail the record already claimed must not also accrue as idle
+    led = GoodputLedger(emit_trace=False)
+    base = {r: _counter(r) for r in gp.BADPUT_REASONS}
+    led.update(1000.0, [], trainers_live=True)
+    rec = {"stage": "st", "detect_at": 1001.0, "detect_to_kill": 6.0}
+    led.update(1005.0, [rec], trainers_live=True)      # partial: 4s resize
+    # next scrape past the record's end (1007) + grace, trainers dead:
+    # idle covers only [1007, 1012], not the record's [1005, 1007] tail
+    led.update(1012.0, [rec], trainers_live=False)
+    assert _counter("idle") - base["idle"] == 5.0
+    # the record completes its 6s of resize; total badput == wall-clock
+    # of the bad period, attributed exactly once
+    led.update(1020.0, [rec], trainers_live=True)
+    assert _counter("resize") - base["resize"] == 6.0
+
+
+def test_ledger_serving_only_job_never_accrues_idle():
+    # a gateway+replica fleet with no trainer component ever: ratio
+    # must stay 1.0 (the goodput-regression rule must not latch on a
+    # healthy serving job)
+    led = GoodputLedger(emit_trace=False)
+    base = _counter("idle")
+    led.update(1000.0, [], trainers_live=False)
+    s = led.update(1100.0, [], trainers_live=False)
+    assert _counter("idle") - base == 0.0
+    assert s["ratio"] == 1.0
+
+
+def test_ledger_ratio_gauge_and_badput_capped_by_observation():
+    led = GoodputLedger(emit_trace=False)
+    led.update(1000.0, [], trainers_live=True)
+    # a record whose span predates the window entirely: badput must not
+    # exceed observed wall-clock (ratio floors at 0, never negative)
+    rec = {"stage": "sz", "detect_at": 0.0, "detect_to_kill": 1e6}
+    s = led.update(1001.0, [rec], trainers_live=True)
+    assert 0.0 <= s["ratio"] <= 1.0
+    assert gp.GOODPUT_RATIO_G.value == s["ratio"]

@@ -267,13 +267,16 @@ def test_600_token_prompt_1024_cache():
 
 
 def test_mixed_load_decode_not_starved(small):
-    """Decode lanes advance every tick no matter how fast new requests
-    arrive: two long generations run to completion while a queue of
-    short arrivals churns through the remaining slot, and their wall
-    time stays within 2x the quiet-engine run.  (The tick design bounds
-    prefill to ONE dispatch per tick; the >=0.8 device-class ratio is
-    measured on real hardware by bench.py's engine section — wall-clock
-    asserts any tighter than 2x flake on a loaded 1-core CI host.)"""
+    """Decode lanes advance no matter how fast new requests arrive: two
+    long generations run to completion while a queue of short arrivals
+    churns through the remaining slot.  Starvation is gated on the
+    engine's OWN scheduler accounting — the longs' completion proves
+    liveness, ``requests_done`` proves the churn was real, and the
+    wall-clock ratio is a wide LOAD-TOLERANT backstop only (ISSUE 13
+    deflake: the old 2x bound tripped under the full tier-1 suite on a
+    1-core box purely from host scheduler jitter; the >=0.8
+    device-class ratio is measured on real hardware by bench.py's
+    engine section, not here)."""
     import time as _t
 
     cfg, params = small
@@ -305,7 +308,11 @@ def test_mixed_load_decode_not_starved(small):
 
     quiet = run(churn=0)
     busy = run(churn=12)
-    assert busy <= max(2.0 * quiet, quiet + 2.0), (
+    # backstop, not the starvation oracle: a starved decode lane would
+    # take ~churn/slots times longer (the longs would queue behind every
+    # short), so 4x + a flat 8s scheduler allowance cleanly separates
+    # "starved" from "loaded CI host" without flaking under tier-1
+    assert busy <= max(4.0 * quiet, quiet + 8.0), (
         f"long decodes starved by arrivals: quiet {quiet:.2f}s vs "
         f"busy {busy:.2f}s")
 
