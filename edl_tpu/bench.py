@@ -331,6 +331,17 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- paged KV cache: prefix-reuse throughput + session migration ---------
+    # the ISSUE 14 numbers: a shared-system-prompt workload through a
+    # paged engine vs the same engine unpaged (what prefix reuse buys),
+    # plus the drain-with-migration wall time for one live session
+    if os.environ.get("EDL_TPU_BENCH_KV", "1") != "0":
+        try:
+            out.update(_bench_serving_kv())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     # -- tracing overhead: distributed tracing must stay invisible ------------
     # tracing-on vs tracing-off step latency + the gateway p50/p99 under
     # an active tracer, so trace-context cost shows in the perf trajectory
@@ -1357,6 +1368,108 @@ def _bench_gateway() -> dict:
         for s in servers:
             s.close()
         store.close()
+
+
+def _bench_serving_kv() -> dict:
+    """Prefix-reusable paged KV cache (ISSUE 14): the SAME
+    shared-system-prompt workload (one long common prefix, short unique
+    tails, short generations — the prefill-dominated regime the cache
+    exists for) through an unpaged engine and a paged one whose chain
+    is already committed.  Tokens/s counts PROCESSED tokens (prompt +
+    generated): identical work either way, so the ratio isolates the
+    skipped prefill.  Both paths are pre-compiled outside the measured
+    window.  Plus: the wall time of a drain() that migrates one live
+    session chain to an adoptive replica (the scale-down warm-handoff
+    cost a conversation would otherwise pay as a full re-prefill)."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.gateway import fleet
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.serving import ContinuousBatcher
+    from edl_tpu.serving.replica import ReplicaServer
+
+    n_req = int(os.environ.get("EDL_TPU_BENCH_KV_REQS", 8))
+    prefix_len = int(os.environ.get("EDL_TPU_BENCH_KV_PREFIX", 160))
+    block = int(os.environ.get("EDL_TPU_BENCH_KV_BLOCK", 16))
+    tail_len, new = 8, 2
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, embed_dim=16,
+                            num_heads=2, mlp_dim=32, max_len=256,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(23)
+    prefix = rng.integers(1, 61, (prefix_len,)).astype(np.int32)
+
+    def prompt(i):
+        tail = np.asarray([(7 * i + j) % 60 + 1 for j in range(tail_len)],
+                          np.int32)
+        return np.concatenate([prefix, tail])
+
+    def run(kv: bool) -> tuple[float, float]:
+        eng = ContinuousBatcher(cfg, params, slots=4, temperature=0.0,
+                                steps_per_sync=2,
+                                kv_block=block if kv else 0)
+        try:
+            eng.warm(prefix_len + tail_len)    # cold prefill + step jits
+            if kv:
+                # seed the shared chain, then one unmeasured hit so the
+                # reuse-path jit is compiled before the clock starts
+                eng.generate(prompt(10_001), new, timeout=600)
+                eng.generate(prompt(10_002), new, timeout=600)
+            s0 = eng.stats()
+            t0 = time.perf_counter()
+            futs = [eng.submit(prompt(i), new) for i in range(n_req)]
+            for f in futs:
+                f.result(timeout=600)
+            dt = time.perf_counter() - t0
+            s1 = eng.stats()
+        finally:
+            eng.stop()
+        tokens_s = n_req * (prefix_len + tail_len + new) / dt
+        did = s1.get("kv_prefill_tokens", 0) - s0.get("kv_prefill_tokens", 0)
+        skipped = (s1.get("kv_prefill_tokens_skipped", 0)
+                   - s0.get("kv_prefill_tokens_skipped", 0))
+        frac = skipped / did if did else 0.0
+        return tokens_s, frac
+
+    cold_tokens_s, _ = run(kv=False)
+    warm_tokens_s, skipped_frac = run(kv=True)
+
+    # -- session migration: one live chain handed off across a drain --
+    store = MemoryKV(sweep_period=1.0)
+    servers = []
+    migration_ms = None
+    try:
+        engines = [ContinuousBatcher(cfg, params, slots=2, temperature=0.0,
+                                     steps_per_sync=2, kv_block=block)
+                   for _ in range(2)]
+        servers = [ReplicaServer(store, "benchkv", e,
+                                 replica_id=f"kv-{i}", host="127.0.0.1",
+                                 ttl=60)
+                   for i, e in enumerate(engines)]
+        engines[0].submit(prompt(0), new, session="bench-sess").result(600)
+        t0 = time.perf_counter()
+        servers[0].drain(timeout=60)
+        migration_ms = 1e3 * (time.perf_counter() - t0)
+        pins = fleet.list_session_pins(store, "benchkv")
+        if pins.get("bench-sess") != "kv-1" \
+                or engines[1].stats().get("kv_sessions") != 1:
+            migration_ms = None          # handoff didn't land: no number
+    finally:
+        for s in servers:
+            s.close()
+        store.close()
+
+    out = {
+        "serving_cold_tokens_s": round(cold_tokens_s, 1),
+        "serving_prefix_tokens_s": round(warm_tokens_s, 1),
+        "serving_prefill_skipped_frac": round(skipped_frac, 3),
+    }
+    if migration_ms is not None:
+        out["serving_kv_migration_ms"] = round(migration_ms, 1)
+    return out
 
 
 def _forever(feed, limit: int):

@@ -133,6 +133,12 @@ class CoordSession:
             with self._lock:
                 self._keys[key] = _Entry(value, exclusive)
 
+    def is_registered(self, key: str) -> bool:
+        """Whether ``key`` is currently tracked (registered and not
+        unregistered) on this session."""
+        with self._lock:
+            return key in self._keys
+
     def update(self, key: str, value: bytes) -> None:
         """Refresh the payload (load stats etc.); the new value is what
         any later self-heal re-asserts — it is recorded BEFORE the put,
@@ -359,7 +365,11 @@ class SessionKey:
 
     @property
     def is_stopped(self) -> bool:
-        return self._session.is_stopped
+        # Register parity: true after OUR stop(), not just the shared
+        # session's — a refresh loop polling its handle must go quiet
+        # once its key is gone, not KeyError every period
+        return (self._session.is_stopped
+                or not self._session.is_registered(self._key))
 
     @property
     def error(self) -> Exception | None:
@@ -370,3 +380,9 @@ class SessionKey:
         on.  ``revoke`` deletes the key from the store now, else it
         lapses at TTL like ``Register.stop(revoke=False)``."""
         self._session.unregister(self._key, delete=revoke)
+
+    def stop_heartbeat_only(self) -> None:
+        """Test hook (Register parity): abandon the UNDERLYING shared
+        session — every key riding it expires at TTL, which is what a
+        process whose keepalive died looks like from outside."""
+        self._session.abandon()

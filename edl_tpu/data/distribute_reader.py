@@ -62,7 +62,7 @@ import msgpack
 from edl_tpu.cluster.state import DataCheckpoint
 from edl_tpu.data.data_server import PodDataServer, in_spans, merge_span
 from edl_tpu.data.dataset import FileSplitter, TxtFileSplitter
-from edl_tpu.data.resilient import ResilientDataClient
+from edl_tpu.data.resilient import CallAborted, ResilientDataClient
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.client import RpcChannelPool
 from edl_tpu.utils import constants
@@ -116,7 +116,8 @@ class DistributedReader:
                  retry_deadline: float | None = None,
                  fetch_workers: int | None = None,
                  prefetch_depth: int | None = None,
-                 stream: bool | None = None):
+                 stream: bool | None = None,
+                 produce_meta_batch: int | None = None):
         self.name = reader_name
         self.pod_id = pod_id
         self._leader = ResilientDataClient(
@@ -148,6 +149,17 @@ class DistributedReader:
         # this (half the default PodDataServer cache, so local caches
         # never evict in steady state)
         self._backpressure = 128
+        # producer-side meta coalescing (ROADMAP item 3 leftover): one
+        # report_batch_meta leader RPC per chunk of produced batches
+        # instead of one per batch.  Buffered metas are guarded by
+        # _state_lock because the reattach handshake flushes them from
+        # whichever thread hit the leader failure (see _do_reattach:
+        # unflushed metas MUST land before the rebuild grace expires,
+        # or a re-seeded leader's span repair would re-produce them)
+        self._meta_batch = max(1, constants.DATA_PRODUCE_META_BATCH
+                               if produce_meta_batch is None
+                               else produce_meta_batch)
+        self._meta_buf: list[list] = []
         self._produce_exc: BaseException | None = None
         self._stop_produce = threading.Event()
         self._producer: threading.Thread | None = None
@@ -225,6 +237,23 @@ class DistributedReader:
             # our in-flight file was re-granted elsewhere: stop emitting
             # it (the producer loop checks this between records)
             self._abandon_produce.set()
+            with self._state_lock:
+                self._meta_buf.clear()   # the new owner covers them
+        else:
+            # flush coalesced-but-unreported metas on the successor NOW,
+            # inside the rebuild grace: their spans ride our producing
+            # position, so a repair grant issued before this report
+            # would re-produce them (grant-time skip covers spans that
+            # are already reported — exactly the single-batch
+            # mid-publish-crash ordering, widened to the buffer)
+            with self._state_lock:
+                buf = list(self._meta_buf)
+            if buf:
+                raw_call("report_batch_meta", reader=self.name,
+                         pod_id=self.pod_id,
+                         endpoint=self._server.endpoint, batches=buf)
+                with self._state_lock:
+                    del self._meta_buf[:len(buf)]
         logger.info("reader %s: reattached to leader %s (%d held, "
                     "producing=%s)", self.name, self._leader.endpoint,
                     len(held), producing)
@@ -287,7 +316,11 @@ class DistributedReader:
                 if self._abandon_produce.is_set():
                     # the leader re-granted this file elsewhere while we
                     # were partitioned: stop emitting, report nothing —
-                    # the new owner covers the remainder
+                    # the new owner covers the remainder (including any
+                    # metas still buffered: reporting them NOW would
+                    # double-produce spans the re-grant already covers)
+                    with self._state_lock:
+                        self._meta_buf.clear()
                     logger.warning("reader %s: abandoning file %d "
                                    "mid-production (re-granted elsewhere)",
                                    self.name, file_idx)
@@ -314,6 +347,18 @@ class DistributedReader:
             if batch:
                 self._note_position(record_no + 1)
                 seq = self._publish(seq, batch, spans)
+            # the tail of the coalescing buffer must land before the
+            # grant closes: file_done with unreported metas could let
+            # the generation drain without them
+            self._flush_metas()
+            if self._abandon_produce.is_set():
+                # re-granted elsewhere during the tail flush (or after
+                # the last record check): the new owner finishes the
+                # file — a file_done from us would close THEIR grant
+                logger.warning("reader %s: abandoning file %d at "
+                               "file_done (re-granted elsewhere)",
+                               self.name, file_idx)
+                return seq
             self._leader.call("file_done", reader=self.name,
                               pod_id=self.pod_id, file_idx=file_idx)
             with self._state_lock:
@@ -345,20 +390,51 @@ class DistributedReader:
     def _publish(self, seq: int, batch: list, spans: list) -> int:
         batch_id = f"{self.pod_id}:{self.name}:{seq}"
         self._server.put_batch(batch_id, {"records": batch, "spans": spans})
-        backlog = self._leader.call(
-            "report_batch_meta", reader=self.name, pod_id=self.pod_id,
-            endpoint=self._server.endpoint,
-            batches=[[batch_id, spans]])["backlog"]
+        with self._state_lock:
+            self._meta_buf.append([batch_id, [list(s) for s in spans]])
+            full = len(self._meta_buf) >= self._meta_batch
+        if full:
+            self._flush_metas(throttle=True)
+        return seq + 1
+
+    def _flush_metas(self, throttle: bool = False) -> None:
+        """Report every buffered meta in ONE leader RPC (the coalesced
+        cadence: leader traffic amortizes to 1/meta_batch per batch on
+        the produce side, matching the consumer's chunked hand-out)."""
+        with self._state_lock:
+            buf, self._meta_buf = self._meta_buf, []
+        if not buf and not throttle:
+            return
+        abort = self._abandon_produce.is_set
+        try:
+            backlog = self._leader.call(
+                "report_batch_meta", reader=self.name, pod_id=self.pod_id,
+                endpoint=self._server.endpoint, batches=buf,
+                _abort_if=abort)["backlog"]
+        except CallAborted:
+            # the file was re-granted elsewhere during a reattach a
+            # retry of THIS report triggered: the re-grant's skip does
+            # not cover these unreported spans (the new owner produces
+            # them), so replaying the swapped-out buffer on the
+            # successor would double-produce.  Drop it — the record
+            # loop's abandon check ends the grant.
+            logger.warning("reader %s: dropped %d in-flight metas (file "
+                           "re-granted elsewhere mid-report)",
+                           self.name, len(buf))
+            return
         # throttle: running far ahead of consumption would evict
         # unfetched batches from the local cache (repairable, but wasted
         # re-production); an empty report is the cheap backlog poll
-        while (backlog > self._backpressure
+        while (throttle and backlog > self._backpressure
                and not self._stop_produce.is_set()):
             time.sleep(0.05)
-            backlog = self._leader.call(
-                "report_batch_meta", reader=self.name, pod_id=self.pod_id,
-                endpoint=self._server.endpoint, batches=[])["backlog"]
-        return seq + 1
+            try:
+                backlog = self._leader.call(
+                    "report_batch_meta", reader=self.name,
+                    pod_id=self.pod_id, endpoint=self._server.endpoint,
+                    batches=[], _abort_if=abort)["backlog"]
+            except CallAborted:
+                return   # metas already delivered; just stop polling
 
     # -- consumer ------------------------------------------------------------
     def __iter__(self) -> Iterator[tuple[str, list]]:

@@ -47,6 +47,15 @@ from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
+
+class CallAborted(Exception):
+    """Raised by :meth:`ResilientDataClient.call` when the caller's
+    ``_abort_if`` predicate turned true between attempts — the op was
+    NOT delivered on the aborted attempt.  Deliberately not an
+    ``EdlError``: it is local control flow (the caller changed its
+    mind), never a wire or service failure."""
+
+
 _RETRIES = obs_metrics.counter(
     "edl_data_rpc_retries_total",
     "Data-plane leader RPCs retried after a transport error, by op",
@@ -205,7 +214,15 @@ class ResilientDataClient:
                 self._attach_gen += 1
 
     # -- the retry loop ------------------------------------------------------
-    def call(self, op: str, **kwargs):
+    def call(self, op: str, _abort_if: "Callable[[], bool] | None" = None,
+             **kwargs):
+        """``_abort_if`` (when set) is checked at the head of EVERY
+        attempt, after any pending reattach ran: a reattach triggered
+        by a mid-call leader failover can invalidate the op it
+        interrupted (e.g. the producer's file was re-granted elsewhere,
+        so a buffered ``report_batch_meta`` must NOT be replayed on the
+        successor — it would double-produce spans the re-grant already
+        covers).  Fires :class:`CallAborted` instead of delivering."""
         deadline = time.monotonic() + self._deadline
         delay = constants.DATA_BACKOFF_INIT
         attempt = 0
@@ -213,6 +230,8 @@ class ResilientDataClient:
             try:
                 client = self._ensure_client(reresolve=attempt > 0)
                 self._maybe_reattach()
+                if _abort_if is not None and _abort_if():
+                    raise CallAborted(op)
                 remaining = self._remaining(deadline)
                 if remaining <= 0:
                     raise EdlCoordError(

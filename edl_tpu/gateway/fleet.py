@@ -55,6 +55,44 @@ def advertise(store, job_id: str, replica_id: str, payload: dict,
                            session=session)
 
 
+def _sessions_prefix(job_id: str) -> str:
+    return paths.key(job_id, constants.ETCD_SERVING, "sessions/")
+
+
+def session_pin_key(job_id: str, session: str) -> str:
+    return paths.key(job_id, constants.ETCD_SERVING, f"sessions/{session}")
+
+
+def pin_session(store, job_id: str, session: str, replica_id: str,
+                ttl: float = constants.ETCD_TTL,
+                coord_session: CoordSession | None = None):
+    """TTL-leased session **pin**: ``serving/sessions/<session> ->
+    {replica}``, written by the replica that ADOPTED the session's
+    migrated KV chain (ReplicaServer drain handoff).  The gateway
+    prefers a pinned replica over the consistent-hash ring owner, so a
+    conversation follows its KV instead of re-prefilling wherever the
+    ring points after the fleet changed.  Leased by the adopter: the
+    pin dies with it and routing falls back to the ring."""
+    return leased_register(store, session_pin_key(job_id, session),
+                           json.dumps({"replica": replica_id,
+                                       "ts": time.time()}).encode(),
+                           ttl=ttl, session=coord_session)
+
+
+def list_session_pins(store, job_id: str) -> dict[str, str]:
+    """Live session pins: ``{session: replica_id}``."""
+    prefix = _sessions_prefix(job_id)
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, str] = {}
+    for rec in recs:
+        try:
+            out[rec.key[len(prefix):]] = json.loads(
+                rec.value.decode())["replica"]
+        except (ValueError, KeyError):
+            continue  # torn pin: the lease will expire it
+    return out
+
+
 def list_replicas(store, job_id: str) -> dict[str, dict]:
     """Live replica adverts: ``{replica_id: payload}``."""
     prefix = _nodes_prefix(job_id)
@@ -88,6 +126,7 @@ class FleetView:
         self._period = period
         self._lock = threading.Lock()       # writers only
         self._replicas: dict[str, dict] = {}
+        self._pins: dict[str, str] = {}     # session -> adopted replica
         self.ring = ConsistentHash()
         self._halt = threading.Event()
         self.refresh()
@@ -103,6 +142,12 @@ class FleetView:
         try:
             with self._store.scoped_deadline(2.0):
                 fresh = list_replicas(self._store, self._job_id)
+                # pins can only exist while a paged replica (the only
+                # possible adopter) is live — an unpaged fleet (the
+                # default) must not pay a second prefix read per poll
+                pins = (list_session_pins(self._store, self._job_id)
+                        if any(p.get("kv_block") for p in fresh.values())
+                        else {})
         except Exception as e:  # noqa: BLE001 — store blips must not kill the view
             logger.warning("fleet refresh failed: %s", e)
             return self.replicas()
@@ -110,11 +155,18 @@ class FleetView:
             if set(fresh) != set(self._replicas):
                 self.ring.set_nodes(sorted(fresh))
             self._replicas = fresh
+            self._pins = pins
         return dict(fresh)
 
     def replicas(self) -> dict[str, dict]:
         with self._lock:
             return dict(self._replicas)
+
+    def session_pin(self, session: str) -> str | None:
+        """The replica that adopted this session's migrated KV chain,
+        if any (routing prefers it over the ring owner)."""
+        with self._lock:
+            return self._pins.get(session)
 
     def drop(self, replica_id: str) -> None:
         """Remove a replica the caller observed dead (its advert may

@@ -233,9 +233,11 @@ class Gateway:
     # -- routing -------------------------------------------------------------
     def _pick(self, session: str | None,
               exclude: set[str]) -> tuple[str, dict] | None:
-        """Choose a routable replica: session ring owner if routable,
-        else least loaded by ``queue_depth + gateway legs - free_slots``
-        (advert staleness corrected by our own assignment counts)."""
+        """Choose a routable replica: the session's migration pin (the
+        replica that adopted its KV chain on a drain) if routable, else
+        the session ring owner, else least loaded by ``queue_depth +
+        gateway legs - free_slots`` (advert staleness corrected by our
+        own assignment counts)."""
         reps = self._fleet.replicas()
         _REPLICAS_G.set(len(reps))
         now = time.monotonic()
@@ -250,6 +252,9 @@ class Gateway:
         if not cands:
             return None
         if session is not None:
+            pinned = self._fleet.session_pin(session)
+            if pinned is not None and pinned in cands:
+                return pinned, cands[pinned]
             pref = self._fleet.ring.get_node(session)
             if pref in cands:
                 return pref, cands[pref]
@@ -431,8 +436,13 @@ class Gateway:
         dead replica is noticed within one slice), then chunk-fetch the
         token buffer and release it.  Returns None when cancelled."""
         with RpcClient(endpoint, timeout=self.cfg.rpc_timeout_s) as client:
+            # the session key rides to the replica so a paged-KV engine
+            # can pin the conversation's chain (omitted when absent:
+            # pre-session replicas keep working)
+            extra = ({"session": req.session}
+                     if req.session is not None else {})
             client.call("serve_submit", request_id=req.id,
-                        prompt=req.prompt, max_new=req.max_new)
+                        prompt=req.prompt, max_new=req.max_new, **extra)
             while True:
                 if winner.is_set():
                     self._release(client, req.id)

@@ -1,5 +1,6 @@
-"""TPU LM serving: slot-based continuous batching (engine.py) and the
-fleet-facing replica server (replica.py) the elastic gateway
+"""TPU LM serving: slot-based continuous batching (engine.py), the
+prefix-reusable paged KV block pool it admits from (kv_cache.py), and
+the fleet-facing replica server (replica.py) the elastic gateway
 (``edl_tpu.gateway``) routes to."""
 
 from edl_tpu.serving.engine import ContinuousBatcher

@@ -52,6 +52,7 @@ import numpy as np
 
 from edl_tpu.models.generate import _split_layer_params, sample_logits
 from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -71,13 +72,28 @@ class _Slot:
 
 
 class _Request:
-    __slots__ = ("ids", "max_new", "future", "t_submit")
+    __slots__ = ("ids", "max_new", "future", "t_submit", "session")
 
-    def __init__(self, ids: np.ndarray, max_new: int):
+    def __init__(self, ids: np.ndarray, max_new: int,
+                 session: str | None = None):
         self.ids = ids
         self.max_new = max_new
+        self.session = session
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+
+
+class _Task:
+    """A closure the ENGINE THREAD runs between ticks (single-writer
+    device mutations from other threads — e.g. a migrated-session KV
+    import arriving over the wire — are serialised through the same
+    queue the requests ride)."""
+
+    __slots__ = ("fn", "future")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.future: Future = Future()
 
 
 class ContinuousBatcher:
@@ -108,7 +124,9 @@ class ContinuousBatcher:
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int | None = None,
                  steps_per_sync: int = 8, rng_seed: int = 20_26,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None, kv_block: int = 0,
+                 kv_pool_blocks: int = 0, prefix_reuse: bool = True,
+                 kv_max_sessions: int | None = None):
         cache_len = max_len or cfg.max_len
         self.cfg = cfg
         self._dcfg = dataclasses.replace(
@@ -153,7 +171,35 @@ class ContinuousBatcher:
         self._rng = jax.random.key(rng_seed)
         self._cache = self._fresh_cache(slots)
         self._toks = np.zeros((slots,), np.int32)   # last token per slot
-        self._queue: queue.Queue[_Request | None] = queue.Queue()
+        # -- paged KV block pool + prefix-reuse index (kv_cache.py) --
+        # kv_block=0 keeps the engine EXACTLY on the pre-paged path (no
+        # pool, no index, no extra dispatches); with a block size, every
+        # finished request's full KV blocks persist in the pool and an
+        # admission whose prompt extends a committed chain prefills only
+        # the suffix.  Mesh engines stay unpaged for now: the pool
+        # scatter/gather would need the tp sharding propagated through
+        # two more jit families for a path the sharded cache already
+        # dominates with HBM, not prefill compute.
+        self._kv = None
+        self._reuse = bool(prefix_reuse)
+        if kv_block > 0:
+            if mesh is not None:
+                raise ValueError(
+                    "paged KV cache is not supported on a mesh engine "
+                    "yet; construct with kv_block=0")
+            from edl_tpu.serving.kv_cache import PagedKVCache
+            blocks_per_slot = max(1, cache_len // kv_block)
+            pool_blocks = kv_pool_blocks or (2 * slots * blocks_per_slot + 1)
+            self._kv = PagedKVCache(
+                self._cache_shapes(1), kv_block, pool_blocks,
+                constants.KV_SESSIONS if kv_max_sessions is None
+                else kv_max_sessions)
+        self._kv_hits = 0
+        self._kv_misses = 0
+        self._prefill_tokens = 0
+        self._prefill_tokens_skipped = 0
+        self._tasks: "deque[_Task]" = deque()
+        self._queue: queue.Queue[_Request | _Task | None] = queue.Queue()
         self._stopping = False
         self._draining = False
         # makes check-stopping + enqueue atomic vs stop()'s drain (the
@@ -192,9 +238,14 @@ class ContinuousBatcher:
         self._thread.start()
 
     # -- public --------------------------------------------------------------
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Future:
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               session: str | None = None) -> Future:
         """Queue one prompt (1-D int32).  The future resolves to the
-        generated tokens (≤ max_new_tokens; truncated at eos_id)."""
+        generated tokens (≤ max_new_tokens; truncated at eos_id).
+        ``session`` (paged-KV engines) pins the finished conversation's
+        KV chain so the session's next turn — routed back here by the
+        gateway's affinity — resumes from it instead of re-prefilling,
+        and marks the chain for migration on drain()."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         cache_len = self._dcfg.max_len
         if len(ids) == 0:
@@ -209,7 +260,7 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {len(ids)} + new {max_new_tokens} exceeds "
                 f"max_len {cache_len}")
-        req = _Request(ids, max_new_tokens)
+        req = _Request(ids, max_new_tokens, session)
         with self._enqueue_lock:
             if self._stopping:
                 raise RuntimeError("engine stopping")
@@ -223,6 +274,60 @@ class ContinuousBatcher:
                  timeout: float | None = None) -> np.ndarray:
         """Blocking convenience wrapper around :meth:`submit`."""
         return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def run_on_engine(self, fn, timeout: float = 30.0):
+        """Run ``fn()`` on the engine thread between ticks and return
+        its result.  The single-writer rule for device state extends to
+        the KV block pool — imports and any future cache surgery go
+        through here rather than racing the tick loop."""
+        task = _Task(fn)
+        with self._enqueue_lock:
+            if self._stopping:
+                raise RuntimeError("engine stopping")
+            self._queue.put(task)
+        return task.future.result(timeout)
+
+    def import_session(self, session: str, tokens: list[int], meta: dict,
+                       blob: bytes) -> int:
+        """Adopt one migrated session chain (engine-thread-executed);
+        returns the number of blocks newly uploaded.  Raises on a
+        paging-disabled engine or a layout mismatch — the exporter falls
+        back to letting the session cold-start elsewhere."""
+        if self._kv is None:
+            raise RuntimeError("paged KV cache disabled on this engine")
+        return self.run_on_engine(
+            lambda: self._kv.import_chain(session, tokens, meta, blob))
+
+    def kv_pinned_sessions(self) -> list[str] | None:
+        """Best-effort any-thread snapshot of pinned session ids ([] on
+        unpaged engines).  Returns None when a concurrent engine-thread
+        pin/unpin raced the iteration — callers polling (the replica's
+        pin pruner) just retry next period."""
+        if self._kv is None:
+            return []
+        try:
+            return self._kv.sessions()
+        except RuntimeError:
+            return None
+
+    def export_sessions(self) -> list[tuple[str, list[int], dict, bytes]]:
+        """``[(session, tokens, meta, blob)]`` for every pinned session
+        chain.  Only legal once the engine thread has stopped (after
+        :meth:`drain`/:meth:`stop`) — the drain()-then-migrate path."""
+        if self._kv is None:
+            return []
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "export_sessions() requires a stopped engine (call "
+                "drain() first)")
+        out = []
+        for session in self._kv.sessions():
+            chain = self._kv.chain_of(session)
+            if not chain:
+                continue
+            meta, blob = self._kv.export_chain(chain)
+            out.append((session, self._kv.chain_tokens(chain), meta, blob))
+        return out
 
     def warm(self, prompt_len: int) -> None:
         """Compile everything serving ``prompt_len``-class prompts can
@@ -259,6 +364,33 @@ class ContinuousBatcher:
             jax.block_until_ready(toks)
         self._step_jit.lower(self._cache, jnp.asarray(self._toks), key,
                              self._params).compile()
+        if self._kv is not None and self._reuse:
+            # the reuse-prefill family too — the first prefix hit per
+            # (suffix bucket, padded chain depth) must not compile on
+            # the engine thread mid-traffic.  Reachable n_pads are the
+            # power-of-two paddings (capped at the pool's blocks-per-
+            # cache) of every chain depth the shortening guard admits.
+            bs = self._kv.block
+            cache_len = self._dcfg.max_len
+            max_blocks = cache_len // bs
+            n_pads = sorted({
+                min(1 << max(0, (n - 1).bit_length()), max_blocks)
+                for n in range(1, max_blocks + 1)
+                if n * bs + self._buckets[0] <= cache_len})
+            for n_pad in n_pads:
+                # shallowest real depth that pads to n_pad — combos no
+                # admissible chain can produce must not be compiled
+                n_min = n_pad // 2 + 1 if n_pad > 1 else 1
+                for Pb in (b for b in self._buckets if b <= P):
+                    if n_min * bs + Pb > cache_len:
+                        continue
+                    _, toks, _ = self._reuse_prefill_fn(Pb, n_pad)(
+                        self._params, self._kv.pool,
+                        jnp.zeros((1, Pb), jnp.int32),
+                        jnp.zeros((n_pad,), jnp.int32),
+                        jnp.asarray(bs, jnp.int32),
+                        jnp.ones((1,), jnp.int32), key)
+                    jax.block_until_ready(toks)
 
     def stats(self) -> dict:
         with self._stats_lock:
@@ -286,7 +418,26 @@ class ContinuousBatcher:
                 "max_prompt_len": self._dcfg.max_len - 1,
                 "uptime_s": round(dt, 3),
                 "draining": self._draining,
+                **self._kv_stats(),
             }
+
+    def _kv_stats(self) -> dict:
+        """Paged-KV counters (empty when paging is off, so stats()
+        consumers see the pre-paged shape unchanged)."""
+        if self._kv is None:
+            return {}
+        return {
+            "kv_block": self._kv.block,
+            "kv_blocks_used": self._kv.blocks_used(),
+            "kv_blocks_free": self._kv.blocks_free(),
+            "kv_prefix_hits": self._kv_hits,
+            "kv_prefix_misses": self._kv_misses,
+            "kv_prefill_tokens": self._prefill_tokens,
+            "kv_prefill_tokens_skipped": self._prefill_tokens_skipped,
+            "kv_evictions": self._kv.evictions,
+            "kv_commit_skips": self._kv.commit_skips,
+            "kv_sessions": self._kv.session_count(),
+        }
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful shutdown: stop admission (submit() raises), let every
@@ -329,12 +480,15 @@ class ContinuousBatcher:
         while self._pending:      # engine thread joined: safe to touch
             self._pending.popleft().future.set_exception(
                 RuntimeError("engine stopped"))
+        while self._tasks:
+            self._tasks.popleft().future.set_exception(
+                RuntimeError("engine stopped"))
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if req is not None:
+            if req is not None:   # requests and tasks both carry a future
                 req.future.set_exception(RuntimeError("engine stopped"))
 
     # -- device state construction -------------------------------------------
@@ -479,61 +633,85 @@ class ContinuousBatcher:
         while True:
             try:
                 req = self._queue.get(block=block and not self._pending
+                                      and not self._tasks
                                       and not self._stopping)
             except queue.Empty:
                 return
             if req is None:                            # stop signal
                 self._stopping = True
                 return
-            self._pending.append(req)
+            if isinstance(req, _Task):
+                self._tasks.append(req)
+            else:
+                self._pending.append(req)
             block = False                              # drain non-blocking
 
     def _tick(self) -> None:
-        """One engine tick: dispatch at most ONE prefill group, then the
+        """One engine tick: admit every consecutive prefix-reuse hit at
+        the queue front plus at most ONE cold prefill group, then the
         decode chunk for the lanes that were already live, then the
-        cache insert — and sync the host once for all of it.  Bounding
-        prefill to one group per tick means a burst of arrivals can
-        never starve running lanes: they advance ``steps_per_sync``
-        tokens every tick regardless of the queue."""
+        cache inserts — and sync the host once for all of it.  Admission
+        work per tick stays bounded by the free-slot count, so a burst
+        of arrivals can never starve running lanes: they advance
+        ``steps_per_sync`` tokens every tick regardless of the queue."""
+        while self._tasks:
+            task = self._tasks.popleft()
+            try:
+                task.future.set_result(task.fn())
+            except BaseException as e:  # noqa: BLE001 — future must resolve
+                task.future.set_exception(e)
         active = [i for i, s in enumerate(self._slots) if not s.free]
-        pre = None
-        group = self._next_group()
+        pres: list[tuple] = []
+        t0 = time.monotonic()
+        taken: set[int] = set()       # slots claimed by THIS tick's admissions
+        while True:
+            # drain consecutive front-of-queue prefix hits first — each
+            # is a cheap one-lane suffix prefill, and a shared-prefix
+            # burst (the cache's own target traffic) must not serialize
+            # to one admission per tick
+            reuse = self._next_reuse(taken)
+            if reuse is None:
+                break
+            pre = self._dispatch_reuse(*reuse)
+            if pre is not None:
+                taken.add(reuse[0])
+                pres.append(pre)
+        group = self._next_group(taken)
         if group is not None:
-            t0 = time.monotonic()
             pre = self._dispatch_prefill(*group)
-            if active:
-                with self._stats_lock:
-                    self._prefill_stall_s += time.monotonic() - t0
+            if pre is not None:
+                pres.append(pre)
+        if pres and active:
+            with self._stats_lock:
+                self._prefill_stall_s += time.monotonic() - t0
         # everything from here to the sync can raise with the prefill
         # group already popped from _pending but not yet in slots —
         # _fail_all (our caller's handler) only covers slot-resident
-        # requests, so fail the group's futures before re-raising
+        # requests, so fail the admitted futures before re-raising
         try:
             dec = None
             if active:
                 self._rng, key = jax.random.split(self._rng)
                 self._cache, dec = self._step_jit(
                     self._cache, jnp.asarray(self._toks), key, self._params)
-            if pre is not None:
-                slab, ptoks, pdrops, slots, reqs, lens = pre
+            for slab, _, _, slots, _, lens in pres:
                 self._cache = self._insert_jit(
                     self._cache, slab, jnp.asarray(slots, jnp.int32),
                     jnp.asarray(lens, jnp.int32))
-            # single sync point for decode + prefill
+            # single sync point for decode + every admission
             dec_np = np.asarray(dec) if dec is not None else None
-            if pre is not None:
-                ptoks_np = np.asarray(ptoks)
-                drops = int(np.asarray(pdrops))
+            fins = [(p[3], p[4], np.asarray(p[1]), int(np.asarray(p[2])))
+                    for p in pres]
         except Exception as e:  # noqa: BLE001
-            if pre is not None:
-                for req in pre[4]:
+            for p in pres:
+                for req in p[4]:
                     req.future.set_exception(e)
-                with self._stats_lock:
-                    self._failed_requests += len(pre[4])
+            with self._stats_lock:
+                self._failed_requests += sum(len(p[4]) for p in pres)
             raise
         if dec_np is not None:
             self._finish_decode(dec_np, len(active))
-        if pre is not None:
+        for slots, reqs, ptoks_np, drops in fins:
             self._finish_prefill(slots, reqs, ptoks_np, drops)
 
     def _fail_all(self, e: Exception) -> None:
@@ -555,14 +733,17 @@ class ContinuousBatcher:
         accepts has one)."""
         return next(b for b in self._buckets if n <= b)
 
-    def _next_group(self) -> tuple[int, list[int], list[_Request]] | None:
+    def _next_group(self, taken: set[int] = frozenset()
+                    ) -> tuple[int, list[int], list[_Request]] | None:
         """Take the next same-bucket run of pending requests (FIFO from
-        the front) as one prefill group, capped by free slots and the
-        largest PREFILL_KS sub-batch size (compile count stays bounded
-        at buckets × |PREFILL_KS|)."""
+        the front) as one prefill group, capped by free slots (minus
+        ``taken``, slots this tick's reuse admissions already claimed)
+        and the largest PREFILL_KS sub-batch size (compile count stays
+        bounded at buckets × |PREFILL_KS|)."""
         if self._stopping or not self._pending:
             return None
-        free = [i for i, s in enumerate(self._slots) if s.free]
+        free = [i for i, s in enumerate(self._slots)
+                if s.free and i not in taken]
         if not free:
             return None
         P = self._bucket(len(self._pending[0].ids))
@@ -584,6 +765,9 @@ class ContinuousBatcher:
         futures are failed here; device-side errors surface at the tick
         sync)."""
         K = len(reqs)
+        if self._kv is not None:
+            self._kv_misses += K
+            self._prefill_tokens += sum(len(r.ids) for r in reqs)
         try:
             ids = np.zeros((K, P), np.int32)
             lens = np.zeros((K,), np.int32)
@@ -601,6 +785,125 @@ class ContinuousBatcher:
             with self._stats_lock:
                 self._failed_requests += len(reqs)
             return None
+
+    # -- prefix reuse (paged KV engines only) --------------------------------
+    def _next_reuse(self, taken: set[int] = frozenset()
+                    ) -> tuple[int, "_Request", list] | None:
+        """If the FRONT pending request extends a committed chain, take
+        it as a one-lane reuse admission (FIFO preserved: a miss at the
+        front falls through to the group path unchanged).  ``taken``
+        excludes slots already claimed by this tick's admissions."""
+        if self._kv is None or not self._reuse:
+            return None
+        if self._stopping or not self._pending:
+            return None
+        free = next((i for i, s in enumerate(self._slots)
+                     if s.free and i not in taken), None)
+        if free is None:
+            return None
+        req0 = self._pending[0]
+        chain = self._kv.match(req0.ids)
+        cache_len = self._dcfg.max_len
+        while chain:
+            # the suffix pads to its bucket, and the cache write is a
+            # CLAMPED dynamic_update_slice (transformer.py) — an
+            # overhanging slab would silently shift backwards over the
+            # gathered prefix and poison the pool at commit.  Shorten
+            # the chain until prefix + suffix bucket fits; n=0 is the
+            # cold path, which always fits by construction.
+            prefix = len(chain) * self._kv.block
+            if prefix + self._bucket(len(req0.ids) - prefix) <= cache_len:
+                break
+            chain.pop()
+        if not chain:
+            return None
+        return free, self._pending.popleft(), chain
+
+    def _dispatch_reuse(self, slot: int, req: "_Request", chain: list):
+        """Dispatch one prefix-hit admission: gather the chain's blocks
+        into a fresh one-lane slab and prefill ONLY the suffix (the
+        skipped prefix is the whole point — its logits were already
+        paid for by whoever committed the chain).  Returns the same
+        in-flight tuple shape as :meth:`_dispatch_prefill` so the tick's
+        insert/finish path is shared."""
+        n = len(chain)
+        prefix_len = n * self._kv.block
+        suffix = req.ids[prefix_len:]
+        P = self._bucket(len(suffix))
+        self._kv_hits += 1
+        self._prefill_tokens += len(req.ids)
+        self._prefill_tokens_skipped += prefix_len
+        try:
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :len(suffix)] = suffix
+            # chain length pads to a power of two (capped at the cache)
+            # with the reserved scratch block, so the compile family is
+            # buckets x log2(blocks-per-cache), not one per depth — a
+            # growing conversation must not stall every live lane on a
+            # fresh XLA compile each turn.  The padded zeros land
+            # beyond prefix_len and are overwritten or masked before
+            # any query can attend them.
+            n_pad = 1
+            while n_pad < n:
+                n_pad *= 2
+            n_pad = min(n_pad, self._dcfg.max_len // self._kv.block)
+            block_ids = np.zeros((n_pad,), np.int32)
+            block_ids[:n] = [nd.block_id for nd in chain]
+            self._rng, key = jax.random.split(self._rng)
+            slab, toks, drops = self._reuse_prefill_fn(P, n_pad)(
+                self._params, self._kv.pool, jnp.asarray(ids),
+                jnp.asarray(block_ids),
+                jnp.asarray(prefix_len, jnp.int32),
+                jnp.asarray([len(suffix)], jnp.int32), key)
+            # insert true_lens = the FULL prompt length: the slab's
+            # cache_index already sits at prefix+suffix and the pool
+            # lane must agree
+            return slab, toks, drops, [slot], [req], [len(req.ids)]
+        except Exception as e:  # noqa: BLE001 — fail THIS request only
+            logger.exception("reuse prefill failed (suffix bucket %d, "
+                             "%d blocks)", P, n)
+            req.future.set_exception(e)
+            with self._stats_lock:
+                self._failed_requests += 1
+            return None
+
+    def _reuse_prefill_fn(self, P: int, n_pad: int):
+        """Compiled per (suffix bucket, PADDED chain length): fused
+        gather-prefix + suffix prefill + sample.  ``prefix_len`` (the
+        real chain length in tokens, <= ``n_pad * block``) rides as a
+        traced scalar so every chain depth in a padding bucket shares
+        one executable."""
+        cached = self._prefill_cache.get(("reuse", P, n_pad))
+        if cached is not None:
+            return cached
+        model = self._model
+        kv = self._kv
+
+        def prefill(params, pool, ids, block_ids, prefix_len, true_lens,
+                    key):
+            from edl_tpu.models.generate import _sum_drops
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    lambda: model.init(
+                        jax.random.key(0), jnp.zeros((1, 1), jnp.int32),
+                        positions=jnp.zeros((1, 1), jnp.int32)))["cache"])
+            cache = kv.load_prefix_into(cache, pool, block_ids, n_pad,
+                                        prefix_len)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, ids,
+                positions=prefix_len
+                + jnp.broadcast_to(jnp.arange(P), ids.shape),
+                token_mask=jnp.arange(P)[None, :] < true_lens[:, None],
+                mutable=["cache", "intermediates"])
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+            toks = self._sample(last, key)
+            return mut["cache"], toks, _sum_drops(mut.get("intermediates"))
+
+        fn = jax.jit(prefill)
+        self._prefill_cache[("reuse", P, n_pad)] = fn
+        return fn
 
     def _finish_prefill(self, slots: list[int], reqs: list[_Request],
                         toks: np.ndarray, drops: int) -> None:
@@ -645,9 +948,30 @@ class ContinuousBatcher:
         out = np.asarray(s.emitted, np.int32)
         if self._eos is not None and self._eos in s.emitted:
             out = out[:s.emitted.index(self._eos) + 1]
+        if self._kv is not None:
+            try:
+                self._kv_commit(slot, req, s.emitted)
+            except Exception:  # noqa: BLE001 — the cache is an accelerator
+                logger.exception("kv commit failed for slot %d (request "
+                                 "unaffected)", slot)
         with self._stats_lock:
             self._done_requests += 1
             self._emitted_tokens += len(out)
         s.request = None
         s.emitted = []
         req.future.set_result(out)
+
+    def _kv_commit(self, slot: int, req: "_Request",
+                   emitted: list[int]) -> None:
+        """Persist the finished lane's full KV blocks into the pool and
+        pin the chain for the request's session.  The lane holds KV for
+        every PROCESSED token — the prompt plus every emitted token that
+        was fed back — so the committed sequence is
+        ``prompt + emitted[:-1]`` (the final sampled token was never
+        re-embedded; its KV does not exist)."""
+        seq = np.concatenate([req.ids,
+                              np.asarray(emitted[:-1], np.int32)])
+        start_block, new_ids, tail = self._kv.commit(seq)
+        self._kv.store_blocks(self._cache, slot, start_block, new_ids)
+        if req.session is not None and tail is not None:
+            self._kv.pin_session(req.session, tail)

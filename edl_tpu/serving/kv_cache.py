@@ -1,0 +1,505 @@
+"""Paged KV block pool with a prefix-reuse index (vLLM/SGLang, TPU-shaped).
+
+The continuous-batching engine keeps one contiguous KV slab per decode
+slot; every admission prefills the WHOLE prompt even when the fleet
+serves a shared system prompt to every request and session affinity
+routes a conversation's turns back to the replica that already computed
+them.  This module is the missing half (ROADMAP item 2a): KV state,
+chunked into fixed-size **blocks**, persists across requests in a
+device-resident block pool and is found again through a token-exact
+prefix index, so a new prompt's prefill starts from the longest cached
+prefix instead of position 0.
+
+Design (PagedAttention re-shaped for the engine's attention layout):
+
+- **blocks, not pages-in-attention** — the decode attention kernel
+  keeps reading one contiguous per-slot slab (``[Hk, D, max_len]``
+  keys / ``[Hk, max_len, D]`` values: the two matmul operands,
+  transformer.Block._decode_attention).  Paging happens at the
+  *admission boundary*: a prefix hit gathers its block chain into the
+  fresh slot slab in one fused jit (then prefills only the suffix), and
+  a finished request's full blocks scatter back into the pool.  This
+  trades one gather-copy per admission for leaving the bit-exact,
+  profiled decode path untouched — on a TPU the copy is a contiguous
+  HBM move that is orders of magnitude cheaper than the prefill it
+  replaces;
+- **hash-chain trie** — a block's identity is its token chunk *in its
+  chain*: node = (parent, tuple(tokens[i*bs:(i+1)*bs])).  Two prompts
+  sharing a prefix share nodes; token-exact matching keeps RoPE
+  positions honest (a block is only reusable at the absolute position
+  it was computed at, which the chain encodes by construction);
+- **copy-on-write by immutability** — committed blocks are never
+  written again; a reused chain is *copied* into the admitting slot's
+  private slab, so a diverging continuation writes its own lanes and
+  commits NEW blocks under new chain keys.  Sibling sessions can never
+  observe each other's divergence (the smoke bit-compares outputs
+  against fresh-cache runs);
+- **refcount + LRU** — session pins refcount chain tails (the whole
+  ancestor path is implicitly protected: a node with children is never
+  evictable); allocation evicts the least-recently-used unpinned leaf
+  when the free list runs dry, and an unallocatable commit is *skipped*
+  (counted), never an error — the cache is an accelerator, not a
+  correctness dependency;
+- **migration-portable** — a pinned chain exports as (tokens, blob) and
+  imports into another replica's pool, deduping against blocks the
+  target already holds.  ``ReplicaServer.drain()`` uses this to hand
+  live conversations to an adoptive replica instead of cold-starting
+  them (doc/serving.md "Session KV migration").
+
+Thread model: single-writer — every mutating call runs on the engine
+thread (admission, finish-commit, import-task); ``export_chain`` runs
+only after the engine thread has stopped.  Counters are plain ints read
+racily by ``stats()`` (atomic loads; exactness there is not a contract).
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+
+import numpy as np
+
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class _Node:
+    """One committed block in the prefix trie."""
+
+    __slots__ = ("chunk", "block_id", "parent", "children", "pins",
+                 "last_use")
+
+    def __init__(self, chunk: tuple, block_id: int, parent: "_Node | None"):
+        self.chunk = chunk
+        self.block_id = block_id
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}
+        self.pins = 0
+        self.last_use = 0
+
+
+class PagedKVCache:
+    """Device block pools (one k + one v buffer per layer) plus the
+    host-side prefix trie, free list, session pins and eviction policy.
+
+    ``cache_shapes`` is the engine's per-slot cache skeleton
+    (``{layer: {cached_key, cached_value, cache_index}}`` eval_shape
+    tree) — pool layouts are derived from it so the gather/scatter jits
+    line up with the slot slabs by construction.
+    """
+
+    def __init__(self, cache_shapes, block: int, n_blocks: int,
+                 max_sessions: int):
+        import jax
+        import jax.numpy as jnp
+
+        if block < 1:
+            raise ValueError(f"kv block size must be >= 1, got {block}")
+        if n_blocks < 1:
+            raise ValueError(f"kv pool needs >= 1 block, got {n_blocks}")
+        self.block = int(block)
+        self.n_blocks = int(n_blocks)
+        self._layers: list[str] = sorted(cache_shapes)
+        self._layout: dict[str, tuple] = {}
+        for name in self._layers:
+            node = cache_shapes[name]
+            if set(node) != {"cached_key", "cached_value", "cache_index"}:
+                raise ValueError(
+                    f"paged KV cache requires plain per-layer "
+                    f"cached_key/cached_value/cache_index state; layer "
+                    f"{name} carries {sorted(node)} (MoE/custom decode "
+                    f"caches are served unpaged)")
+            k = node["cached_key"]          # [slots, Hk, D, max_len]
+            _, hk, d, max_len = k.shape
+            if block > max_len:
+                raise ValueError(
+                    f"kv block {block} exceeds cache length {max_len}")
+            self._layout[name] = (hk, d, k.dtype)
+        self.max_len = max_len
+        # block 0 is a reserved scratch block (never allocated) so a
+        # zero-filled block-id vector can never alias live state
+        self.pool = {
+            name: {
+                "k": jnp.zeros((n_blocks, hk, d, block), dtype),
+                "v": jnp.zeros((n_blocks, hk, block, d), dtype),
+            }
+            for name, (hk, d, dtype) in self._layout.items()
+        }
+        self._free: list[int] = list(range(n_blocks - 1, 0, -1))
+        self._root = _Node((), 0, None)
+        self._nodes: set[_Node] = set()         # every live non-root node
+        # lazy min-heap of eviction candidates (last_use, seq, node):
+        # pushed on every candidate transition (created childless,
+        # unpinned, child evicted), validated on pop — a full pool's
+        # steady-state commit must not rescan every node per block
+        self._evict_heap: list[tuple[int, int, _Node]] = []
+        self._heap_seq = 0
+        self._sessions: "OrderedDict[str, _Node]" = OrderedDict()
+        self._max_sessions = max(1, int(max_sessions))
+        self._clock = 0
+        self._jit_cache: dict[tuple, object] = {}
+        self._jax = jax
+        self._jnp = jnp
+        # -- counters (engine stats mirror these) --
+        self.evictions = 0
+        self.commit_skips = 0
+
+    # -- host index ----------------------------------------------------------
+    def _chunks(self, tokens, n: int):
+        bs = self.block
+        return [tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+                for i in range(n)]
+
+    def match(self, tokens) -> list[_Node]:
+        """Longest committed chain covering full-block prefixes of
+        ``tokens``, capped so at least ONE prompt token is always left
+        to prefill (the admission needs its logits to sample from)."""
+        max_blocks = (len(tokens) - 1) // self.block
+        node = self._root
+        chain: list[_Node] = []
+        for chunk in self._chunks(tokens, max_blocks):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        self._clock += 1
+        for nd in chain:
+            nd.last_use = self._clock
+        return chain
+
+    def commit(self, tokens) -> tuple[int, list[int], "_Node | None"]:
+        """Extend the trie with every full block of ``tokens`` that is
+        not already committed.  Returns ``(first_new_block_index,
+        new_block_ids, tail_node)`` — the caller owns writing the new
+        blocks' KV into the pool (``scatter_fn``).  A dry pool truncates
+        the commit (counted in ``commit_skips``) rather than failing."""
+        n_full = len(tokens) // self.block
+        node = self._root
+        chunks = self._chunks(tokens, n_full)
+        i = 0
+        while i < n_full:
+            child = node.children.get(chunks[i])
+            if child is None:
+                break
+            node = child
+            i += 1
+        start = i
+        new_ids: list[int] = []
+        for chunk in chunks[start:]:
+            child = self._extend(node, chunk)
+            if child is None:
+                break
+            node = child
+            new_ids.append(child.block_id)
+        tail = node if node is not self._root else None
+        return start, new_ids, tail
+
+    def _extend(self, node: _Node, chunk: tuple) -> "_Node | None":
+        """Attach ONE new child block under ``node`` — the single place
+        the trie grows (commit + import share it so the eviction-guard
+        invariants can't drift).  The walk tail is childless until the
+        new child attaches, so it is pinned across the allocation to
+        keep eviction from taking it.  Returns None on a dry pool — the
+        caller truncates (counted), never fails."""
+        node.pins += 1
+        bid = self._alloc()
+        self._unpin(node)
+        if bid is None:
+            self.commit_skips += 1
+            return None
+        child = _Node(chunk, bid, node)
+        node.children[chunk] = child
+        self._nodes.add(child)
+        self._clock += 1
+        child.last_use = self._clock
+        self._heap_push(child)
+        return child
+
+    def _heap_push(self, nd: _Node) -> None:
+        """Enter ``nd`` as an eviction candidate if it is one right now
+        (childless, unpinned, non-root).  Entries go stale when the node
+        is touched, gains a child or pins, or is evicted — ``_alloc``
+        revalidates on pop, so pushing eagerly is always safe."""
+        if nd is self._root or nd.children or nd.pins:
+            return
+        self._heap_seq += 1
+        heapq.heappush(self._evict_heap, (nd.last_use, self._heap_seq, nd))
+
+    def _unpin(self, nd: _Node) -> None:
+        """Drop one pin; a node whose last pin leaves while it is a
+        leaf becomes evictable and must re-enter the heap (its pinned
+        pops were dropped without re-push)."""
+        nd.pins -= 1
+        self._heap_push(nd)
+
+    def _alloc(self) -> int | None:
+        if self._free:
+            return self._free.pop()
+        heap = self._evict_heap
+        while heap:
+            last_use, _, nd = heapq.heappop(heap)
+            parent = nd.parent
+            if parent is None or parent.children.get(nd.chunk) is not nd:
+                continue                      # already evicted
+            if nd.children or nd.pins:
+                continue  # not a leaf / pinned; transitions re-push it
+            if nd.last_use != last_use:
+                self._heap_push(nd)           # touched since push: re-rank
+                continue
+            del parent.children[nd.chunk]
+            self._nodes.discard(nd)
+            if parent is not self._root and not parent.children:
+                self._heap_push(parent)       # newly a leaf
+            self.evictions += 1
+            return nd.block_id
+        return None
+
+    # -- session pins --------------------------------------------------------
+    def pin_session(self, session: str, node: _Node) -> None:
+        old = self._sessions.pop(session, None)
+        if old is not None:
+            self._unpin(old)
+        node.pins += 1
+        self._sessions[session] = node
+        while len(self._sessions) > self._max_sessions:
+            _, stale = self._sessions.popitem(last=False)
+            self._unpin(stale)
+
+    def unpin_session(self, session: str) -> None:
+        node = self._sessions.pop(session, None)
+        if node is not None:
+            self._unpin(node)
+
+    def sessions(self) -> list[str]:
+        """Pinned session ids — engine-thread / post-stop callers only
+        (iterating the OrderedDict races live pinning; cross-thread
+        pollers go through ``ContinuousBatcher.kv_pinned_sessions``,
+        which treats the resulting RuntimeError as "retry later")."""
+        return list(self._sessions)
+
+    def session_count(self) -> int:
+        """Racy-read-safe session count (``len`` is atomic under the
+        GIL, unlike iteration) — what ``stats()`` mirrors from other
+        threads."""
+        return len(self._sessions)
+
+    def chain_of(self, session: str) -> list[_Node]:
+        node = self._sessions.get(session)
+        chain: list[_Node] = []
+        while node is not None and node is not self._root:
+            chain.append(node)
+            node = node.parent
+        chain.reverse()
+        return chain
+
+    @staticmethod
+    def chain_tokens(chain: list[_Node]) -> list[int]:
+        return [t for nd in chain for t in nd.chunk]
+
+    # -- stats ---------------------------------------------------------------
+    def blocks_used(self) -> int:
+        return self.n_blocks - 1 - len(self._free)
+
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    # -- jitted device ops ---------------------------------------------------
+    def load_prefix_into(self, cache, pool, block_ids, n: int, prefix_len):
+        """Pure helper traced INSIDE the engine's reuse-prefill jit
+        (``pool`` is the traced argument — never read device state off
+        ``self`` under a trace): gather ``n`` (padded) blocks into the
+        front of a fresh one-lane cache and set its index to the traced
+        ``prefix_len`` (<= ``n * block``; the scratch-padded tail lands
+        beyond it and is overwritten or masked before any query can
+        attend it)."""
+        jnp = self._jnp
+        bs = self.block
+        out = {}
+        for name in self._layers:
+            node = cache[name]
+            k = pool[name]["k"][block_ids]            # [n, Hk, D, bs]
+            k = jnp.moveaxis(k, 0, 2).reshape(
+                k.shape[1], k.shape[2], n * bs)
+            v = pool[name]["v"][block_ids]            # [n, Hk, bs, D]
+            v = jnp.moveaxis(v, 0, 1).reshape(
+                v.shape[1], n * bs, v.shape[3])
+            out[name] = {
+                "cached_key": node["cached_key"].at[0, :, :, :n * bs].set(
+                    k.astype(node["cached_key"].dtype)),
+                "cached_value": node["cached_value"].at[0, :, :n * bs, :].set(
+                    v.astype(node["cached_value"].dtype)),
+                "cache_index": jnp.full_like(node["cache_index"],
+                                             prefix_len),
+            }
+        return out
+
+    def _scatter_fn(self, n: int):
+        """jit per new-block count: copy ``n`` contiguous blocks of one
+        slot's slab (starting at traced byte position ``start``) into
+        the pool at ``block_ids``.  The pool is donated — committing
+        never copies it."""
+        key = ("scatter", n)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp = self._jax, self._jnp
+        bs = self.block
+        layers, layout = self._layers, self._layout
+
+        def scatter(pool, cache, slot, start, block_ids):
+            out = {}
+            for name in layers:
+                hk, d, _ = layout[name]
+                k_lane = jnp.take(cache[name]["cached_key"], slot, axis=0)
+                k_sl = jax.lax.dynamic_slice(k_lane, (0, 0, start),
+                                             (hk, d, n * bs))
+                k_blocks = jnp.moveaxis(k_sl.reshape(hk, d, n, bs), 2, 0)
+                v_lane = jnp.take(cache[name]["cached_value"], slot, axis=0)
+                v_sl = jax.lax.dynamic_slice(v_lane, (0, start, 0),
+                                             (hk, n * bs, d))
+                v_blocks = jnp.moveaxis(v_sl.reshape(hk, n, bs, d), 1, 0)
+                out[name] = {
+                    "k": pool[name]["k"].at[block_ids].set(k_blocks),
+                    "v": pool[name]["v"].at[block_ids].set(v_blocks),
+                }
+            return out
+
+        fn = jax.jit(scatter, donate_argnums=(0,))
+        self._jit_cache[key] = fn
+        return fn
+
+    def store_blocks(self, cache, slot: int, start_block: int,
+                     block_ids: list[int]) -> None:
+        """Write blocks ``[start_block, start_block + len(ids))`` of the
+        slot's slab into the pool (one dispatch)."""
+        if not block_ids:
+            return
+        jnp = self._jnp
+        self.pool = self._scatter_fn(len(block_ids))(
+            self.pool, cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(start_block * self.block, jnp.int32),
+            jnp.asarray(block_ids, jnp.int32))
+
+    def _gather_fn(self, n: int):
+        key = ("gather", n)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        layers = self._layers
+
+        def gather(pool, block_ids):
+            return {name: {"k": pool[name]["k"][block_ids],
+                           "v": pool[name]["v"][block_ids]}
+                    for name in layers}
+
+        fn = self._jax.jit(gather)
+        self._jit_cache[key] = fn
+        return fn
+
+    # -- migration wire format ----------------------------------------------
+    def export_chain(self, chain: list[_Node]) -> tuple[dict, bytes]:
+        """(meta, blob) for one chain: per layer (sorted), the k blocks
+        then the v blocks, raw ``tobytes()`` concatenated.  ``meta``
+        carries what the importer must agree on; tokens travel beside it
+        (the chain IS the token sequence)."""
+        ids = self._jnp.asarray([nd.block_id for nd in chain],
+                                self._jnp.int32)
+        got = self._gather_fn(len(chain))(self.pool, ids)
+        parts: list[bytes] = []
+        for name in self._layers:
+            parts.append(np.asarray(got[name]["k"]).tobytes())
+            parts.append(np.asarray(got[name]["v"]).tobytes())
+        blob = b"".join(parts)
+        meta = {"block": self.block, "n": len(chain),
+                "layers": list(self._layers),
+                "layout": {name: [hk, d, str(np.dtype(dtype))]
+                           for name, (hk, d, dtype) in self._layout.items()}}
+        return meta, blob
+
+    def import_chain(self, session: str, tokens: list[int], meta: dict,
+                     blob: bytes) -> int:
+        """Adopt a migrated chain: dedup against blocks already
+        committed here, allocate + upload the rest, pin ``session`` at
+        the tail.  Returns the number of blocks newly uploaded.  A pool
+        too full to hold the whole chain truncates the import (the
+        session resumes from the shorter prefix — still warmer than a
+        cold start)."""
+        jnp = self._jnp
+        n = int(meta["n"])
+        if int(meta["block"]) != self.block:
+            raise ValueError(
+                f"kv import block size {meta['block']} != local "
+                f"{self.block}")
+        if list(meta["layers"]) != self._layers:
+            raise ValueError("kv import layer set mismatch")
+        for name, (hk, d, dtype) in self._layout.items():
+            if list(meta["layout"][name]) != [hk, d,
+                                              str(np.dtype(dtype))]:
+                raise ValueError(f"kv import layout mismatch at {name}")
+        if len(tokens) < n * self.block:
+            raise ValueError(
+                f"kv import: {len(tokens)} tokens cannot cover "
+                f"{n} blocks of {self.block}")
+        # slice the blob back into per-layer [n, ...] block arrays
+        arrays: dict[str, dict[str, np.ndarray]] = {}
+        off = 0
+        for name in self._layers:
+            hk, d, dtype = self._layout[name]
+            item = np.dtype(dtype).itemsize
+            k_bytes = n * hk * d * self.block * item
+            arrays[name] = {
+                "k": np.frombuffer(blob, dtype, count=n * hk * d * self.block,
+                                   offset=off).reshape(n, hk, d, self.block),
+                "v": np.frombuffer(blob, dtype, count=n * hk * self.block * d,
+                                   offset=off + k_bytes
+                                   ).reshape(n, hk, self.block, d),
+            }
+            off += 2 * k_bytes
+        if off != len(blob):
+            raise ValueError(
+                f"kv import blob is {len(blob)} bytes, layout needs {off}")
+        node = self._root
+        fresh: list[tuple[int, int]] = []      # (chain idx, block id)
+        for i, chunk in enumerate(self._chunks(tokens, n)):
+            child = node.children.get(chunk)
+            if child is None:
+                child = self._extend(node, chunk)
+                if child is None:
+                    break
+                fresh.append((i, child.block_id))
+            else:                       # dedup walk touches the chain
+                self._clock += 1
+                child.last_use = self._clock
+            node = child
+        if fresh:
+            idx = [i for i, _ in fresh]
+            ids = jnp.asarray([b for _, b in fresh], jnp.int32)
+            upload = {
+                name: {"k": jnp.asarray(arrays[name]["k"][idx]),
+                       "v": jnp.asarray(arrays[name]["v"][idx])}
+                for name in self._layers}
+
+            def put(pool, ids, upload):
+                return {name: {"k": pool[name]["k"].at[ids].set(
+                                   upload[name]["k"]),
+                               "v": pool[name]["v"].at[ids].set(
+                                   upload[name]["v"])}
+                        for name in self._layers}
+
+            key = ("import", len(fresh))
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                fn = self._jax.jit(put, donate_argnums=(0,))
+                self._jit_cache[key] = fn
+            self.pool = fn(self.pool, ids, upload)
+        if node is self._root:
+            # a pool too full for even the FIRST block adopted nothing:
+            # raising lets the exporter try the next candidate instead
+            # of pinning the session to a replica with no chain
+            raise RuntimeError(
+                "kv import adopted zero blocks (pool exhausted by "
+                "pinned/unevictable chains)")
+        self.pin_session(session, node)
+        return len(fresh)

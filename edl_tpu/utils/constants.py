@@ -226,6 +226,11 @@ DATA_PREFETCH_STREAM = int(_f("EDL_TPU_DATA_PREFETCH_STREAM", 1))
 # max batch payloads pushed per get_batch_stream request: caps how long
 # one stream occupies a channel (and how much one EdlStreamError costs)
 DATA_STREAM_BATCH = int(_f("EDL_TPU_DATA_STREAM_BATCH", 8))
+# producer-side meta coalescing: report_batch_meta carries up to this
+# many freshly produced batches per leader RPC (1 = the legacy
+# call-per-batch cadence); buffered metas flush at file end and ride
+# the reattach handshake, so availability lags by at most one chunk
+DATA_PRODUCE_META_BATCH = int(_f("EDL_TPU_DATA_PRODUCE_META_BATCH", 8))
 
 # -- elastic serving gateway (edl_tpu/gateway, serving/replica) -----------
 # how often a replica refreshes its leased advert with live load stats
@@ -240,3 +245,18 @@ GATEWAY_QUARANTINE_S = _f("EDL_TPU_GATEWAY_QUARANTINE", 5.0)
 # completed-generation buffers a replica holds for gateway fetch are
 # evicted after this long without an ack (gateway died mid-fetch)
 SERVING_RESULT_TTL = _f("EDL_TPU_SERVING_RESULT_TTL", 600.0)
+
+# -- paged KV cache + session migration (serving/kv_cache.py) -------------
+# KV block size in tokens for the replica CLI's engine; 0 keeps the
+# pre-paged contiguous slabs (no prefix reuse, no migration).  Library
+# constructors take kv_block= directly.
+KV_BLOCK = int(_f("EDL_TPU_KV_BLOCK", 0))
+# pool capacity in blocks; 0 sizes it at 2x the slot pool's worth so a
+# full fleet of lanes can commit without evicting each other
+KV_POOL_BLOCKS = int(_f("EDL_TPU_KV_POOL_BLOCKS", 0))
+# prefix reuse on admission (0 = commit/migrate only, prefill cold)
+KV_REUSE = int(_f("EDL_TPU_KV_REUSE", 1))
+# push pinned session chains to an adoptive replica on drain()
+KV_MIGRATE = int(_f("EDL_TPU_KV_MIGRATE", 1))
+# max pinned session chains per replica (LRU unpin beyond this)
+KV_SESSIONS = int(_f("EDL_TPU_KV_SESSIONS", 64))

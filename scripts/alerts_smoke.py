@@ -143,6 +143,15 @@ def _data_leader_kill(store):
             seen += 1
             if i == 3:
                 srv1.stop()
+                # deterministic outage floor: keep the seat EMPTY for
+                # 5x the smoke's 20ms EDL_TPU_ALERT_MTTR_THRESHOLD
+                # before the successor serves.  Without it the observed
+                # outage is just the resilient client's first jittered
+                # backoff, which can land UNDER the threshold when the
+                # box is otherwise loaded (tier-1 running concurrently)
+                # and the rule never fires — rerun luck, not a gate.
+                time.sleep(
+                    5 * float(os.environ["EDL_TPU_ALERT_MTTR_THRESHOLD"]))
                 srv2, ep2 = serve(journal)
                 endpoint["ep"] = ep2
         assert seen > 4, f"reader finished too early ({seen} batches)"

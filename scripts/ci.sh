@@ -48,6 +48,16 @@ JAX_PLATFORMS=cpu python scripts/memstate_smoke.py
 # process's spans and merge into one ordered Perfetto-exportable timeline
 JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
 
+# kv cache smoke: the paged KV cache's three contracts — paged-vs-
+# unpaged greedy outputs byte-identical over a mixed shared-prefix +
+# divergent-session workload; the heavy-prefix bench section gates
+# prefix-hit tokens/s >= cold tokens/s with prefill-skipped frac > 0.5
+# and a real migration latency; and a SIGTERM-drain of a replica
+# PROCESS under sustained sessions loses zero accepted requests while
+# >=1 session chain migrates and resumes on the survivor WITHOUT
+# re-prefilling (pin advert + moving kv_prefill_tokens_skipped)
+JAX_PLATFORMS=cpu python scripts/kv_cache_smoke.py
+
 # chaos smoke: SIGKILL + restart the durable coord server mid-training
 # AND mid-serving — WAL replay must restore revision counter, lease
 # table and keys bit-exactly; training must resume without
@@ -146,6 +156,13 @@ assert dl <= sr, (dl, sr)
 # continuous profiling (ISSUE 13): the per-step phase ledger must cost
 # the hot loop under 2% of step time (measured directly, noise-immune)
 assert out['step_phase_overhead_pct'] < 2, out['step_phase_overhead_pct']
+# paged KV cache (ISSUE 14): on the shared-system-prompt workload the
+# prefix-hit engine must not lose to cold prefill and must actually
+# skip most of the prompt; the drain handoff must yield a latency
+pw, pc = out['serving_prefix_tokens_s'], out['serving_cold_tokens_s']
+assert pw >= pc, (pw, pc)
+assert out['serving_prefill_skipped_frac'] > 0.5, out
+assert out.get('serving_kv_migration_ms') is not None, out
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve

@@ -11,7 +11,7 @@ from edl_tpu.cluster.state import DataCheckpoint
 from edl_tpu.data import DistributedReader, PodDataServer
 from edl_tpu.data.data_server import DataService
 from edl_tpu.data.journal import DataJournal
-from edl_tpu.data.resilient import ResilientDataClient
+from edl_tpu.data.resilient import CallAborted, ResilientDataClient
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import faultinject
 from edl_tpu.utils.exceptions import (
@@ -488,6 +488,41 @@ def test_resilient_client_raises_after_budget():
         client.call("reader_status", reader="x")
     assert time.monotonic() - t0 < 10.0
     client.close()
+
+
+def test_call_aborted_after_reattach_abandon():
+    """The coalesced-meta exactly-once guard: a leader failover mid-
+    report triggers a reattach on the retry, and when that reattach
+    learns the file was re-granted elsewhere (the producer's abandon
+    flag), the retried report must NOT be replayed on the successor —
+    its spans now belong to the new owner.  call() raises CallAborted
+    before delivering."""
+    delivered = []
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register("report_batch_meta",
+                 lambda **kw: (delivered.append(kw), {"backlog": 0})[1])
+    srv.start()
+    abandoned = threading.Event()
+    eps = iter(["127.0.0.1:1", f"127.0.0.1:{srv.port}"])
+    last = {"ep": "127.0.0.1:1"}
+
+    def resolver():
+        last["ep"] = next(eps, last["ep"])
+        return last["ep"]
+
+    client = ResilientDataClient(
+        resolver, timeout=0.5, retry_deadline=10.0,
+        on_reattach=lambda raw_call: abandoned.set(),  # = abandon_file
+        name="abort-test")
+    try:
+        with pytest.raises(CallAborted):
+            client.call("report_batch_meta", reader="r", pod_id="p",
+                        endpoint="e", batches=[["b0", [[0, 0, 4]]]],
+                        _abort_if=abandoned.is_set)
+        assert delivered == []   # the successor never saw the report
+    finally:
+        client.close()
+        srv.stop()
 
 
 def test_close_bounds_stuck_producer(files, caplog):

@@ -271,6 +271,38 @@ def test_drained_raises_typed_stop(files):
         a.stop()
 
 
+def test_producer_coalesces_meta_reports(files):
+    """Producer-side batched report_batch_meta (ROADMAP item 3
+    leftover): metas ride the leader wire in chunks — far fewer
+    non-empty RPCs than batches — and delivery stays exactly-once."""
+    a = make_pod("podA", leader=True)
+    try:
+        ra = DistributedReader("rcoal", "podA", a.endpoint, a, batch_size=4,
+                               produce_meta_batch=4)
+        calls: list[int] = []
+        orig = ra._leader.call
+
+        def counted(method, **kw):
+            if method == "report_batch_meta":
+                calls.append(len(kw.get("batches") or []))
+            return orig(method, **kw)
+
+        ra._leader.call = counted
+        ra.create(files)
+        spans: list = []
+        got = drain(ra, spans)
+        assert sorted(got) == ALL
+        audit_spans(spans, 4, per_file=10)
+        reports = [c for c in calls if c > 0]
+        # 4 files x 10 records / bs 4 = 12 batches; coalescing must
+        # beat call-per-batch, and no report may exceed the chunk size
+        assert sum(reports) == 12
+        assert len(reports) < 12, f"not coalesced: {reports}"
+        assert max(reports) > 1 and max(reports) <= 4, reports
+    finally:
+        a.stop()
+
+
 def test_generation_gc(files):
     a = make_pod("podA", leader=True)
     try:

@@ -43,7 +43,8 @@ class _FakeEngine:
         self._lock = threading.Lock()
         self._stopped = False
 
-    def submit(self, ids, max_new: int) -> Future:
+    def submit(self, ids, max_new: int, session: str | None = None) -> Future:
+        del session   # fakes have no KV chains to pin
         with self._lock:
             if self._stopped:
                 raise RuntimeError("engine stopping")
@@ -449,6 +450,107 @@ def test_publish_engine_stats_sets_gauges():
     assert REGISTRY.get("edl_serving_prefill_stall_seconds").value == 1.25
     assert REGISTRY.get("edl_serving_tokens_per_s").value == 321.0
     assert REGISTRY.get("edl_serving_active_slots").value == 3.0
+
+
+# -- session KV migration on drain ------------------------------------------
+def _paged_replica(memkv, rid, cfg, params, *, kv_block=4):
+    from edl_tpu.serving import ContinuousBatcher
+
+    eng = ContinuousBatcher(cfg, params, slots=2, temperature=0.0,
+                            prefill_buckets=(8, 16), steps_per_sync=4,
+                            kv_block=kv_block, kv_pool_blocks=64)
+    return ReplicaServer(memkv, "job", eng, replica_id=rid, host="127.0.0.1",
+                         ttl=5, advert_period=0.2)
+
+
+def _session_owned_by(gw, rid):
+    return next(s for s in (f"sess-{i}" for i in range(1000))
+                if gw._fleet.ring.get_node(s) == rid)
+
+
+def _tiny_lm():
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=53, num_layers=1, embed_dim=32,
+                            num_heads=2, mlp_dim=64, max_len=64,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    return cfg, params
+
+
+def test_session_repin_lands_on_migration_target(memkv):
+    """Drain-with-migration end to end: the draining replica pushes the
+    session's KV chain to the survivor, the survivor publishes the pin,
+    the gateway routes the next turn to the PIN (not the ring owner),
+    and that turn resumes from the migrated chain instead of
+    re-prefilling — greedy parity throughout."""
+    from edl_tpu.models.generate import generate
+
+    cfg, params = _tiny_lm()
+    origin = _paged_replica(memkv, "origin", cfg, params)
+    target = _paged_replica(memkv, "target", cfg, params)
+    gw = _gateway(memkv, request_timeout_s=120.0)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        sess = _session_owned_by(gw, "origin")
+        p1 = np.asarray([7, 11, 13, 5, 9, 2], np.int32)
+        out1 = gw.generate(p1, 8, session=sess, timeout=120)
+        want1 = np.asarray(generate(cfg, params, jnp.asarray(p1[None]), 8,
+                                    temperature=0.0))[0]
+        np.testing.assert_array_equal(out1, want1)
+        assert origin._engine.stats()["kv_sessions"] == 1
+
+        assert origin.drain(timeout=30)
+        # the pin record now maps the session to the adopter
+        assert fleet.list_session_pins(memkv, "job") == {sess: "target"}
+        gw._fleet.refresh()
+        assert gw._fleet.session_pin(sess) == "target"
+
+        p2 = np.concatenate([p1, out1,
+                             np.asarray([3, 1], np.int32)])
+        out2 = gw.generate(p2, 6, session=sess, timeout=120)
+        want2 = np.asarray(generate(cfg, params, jnp.asarray(p2[None]), 6,
+                                    temperature=0.0))[0]
+        np.testing.assert_array_equal(out2, want2)
+        stats = target._engine.stats()
+        # the turn resumed warm: the migrated chain covered the prefix
+        assert stats["kv_prefix_hits"] >= 1, stats
+        assert stats["kv_prefill_tokens_skipped"] > 0, stats
+    finally:
+        gw.close()
+        origin.close()
+        target.close()
+
+
+def test_migration_refused_falls_back_to_cold_prefill(memkv):
+    """A target that cannot adopt (no paged cache — the stand-in for a
+    peer that died mid-export) refuses the push; the drain still
+    completes, no pin is published, and the session's next turn simply
+    cold-prefills on the survivor — no lost accepted request."""
+    from edl_tpu.models.generate import generate
+
+    cfg, params = _tiny_lm()
+    origin = _paged_replica(memkv, "origin", cfg, params)
+    target = _paged_replica(memkv, "target", cfg, params, kv_block=0)
+    gw = _gateway(memkv, request_timeout_s=120.0)
+    try:
+        assert gw.wait_for_replicas(2, 10)
+        sess = _session_owned_by(gw, "origin")
+        p1 = np.asarray([4, 8, 15, 16, 23, 42], np.int32)
+        out1 = gw.generate(p1, 8, session=sess, timeout=120)
+        assert origin.drain(timeout=30)       # refusal must not wedge it
+        assert fleet.list_session_pins(memkv, "job") == {}
+        gw._fleet.refresh()
+        p2 = np.concatenate([p1, out1, np.asarray([6], np.int32)])
+        out2 = gw.generate(p2, 6, session=sess, timeout=120)
+        want2 = np.asarray(generate(cfg, params, jnp.asarray(p2[None]), 6,
+                                    temperature=0.0))[0]
+        np.testing.assert_array_equal(out2, want2)
+    finally:
+        gw.close()
+        origin.close()
+        target.close()
 
 
 def test_gateway_server_wire_roundtrip(memkv):
