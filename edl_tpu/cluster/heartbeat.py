@@ -155,3 +155,39 @@ def flag_hang(store, job_id: str, stage: str, pod_id: str) -> float:
 
 def get_hang(store, job_id: str, stage: str) -> float | None:
     return read_stage_flag(store, job_id, "hang", stage)
+
+
+# -- targeted (per-pod) trainer restart ----------------------------------
+# The alert-driven remediation dispatcher (controller/remediate.py)
+# restarts ONE pod's trainers in place — kill + respawn against the
+# unchanged cluster stage, no membership change, no barrier — by
+# writing a per-pod flag the pod's launcher polls in its supervisor
+# loop.  Stage-scoped like every incident flag; the launcher acts once
+# per timestamp (baseline pattern, same as the hang flag).
+
+import json as _json
+
+
+def flag_pod_restart(store, job_id: str, stage: str, pod_id: str,
+                     reason: str = "remediation") -> float:
+    """Ask ``pod_id``'s launcher for an in-place trainer restart."""
+    t = time.time()
+    store.put(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                        f"restart_pod/{stage}/{pod_id}"),
+              _json.dumps({"ts": t, "reason": reason}).encode())
+    return t
+
+
+def read_pod_restart(store, job_id: str, stage: str, pod_id: str
+                     ) -> tuple[float, str] | None:
+    """``(timestamp, reason)`` of the pending targeted restart, or
+    None."""
+    rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                              f"restart_pod/{stage}/{pod_id}"))
+    if rec is None or not rec.value:
+        return None
+    try:
+        d = _json.loads(rec.value.decode())
+        return float(d["ts"]), str(d.get("reason", ""))
+    except (ValueError, KeyError, TypeError):
+        return None

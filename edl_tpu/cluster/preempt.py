@@ -19,38 +19,71 @@ fault_tolerance.md:20-25, extended to step granularity):
 
 The flag is STAGE-scoped: a rebuilt cluster (new stage) never sees a
 stale preemption.
+
+The flag carries a machine-readable eviction REASON so a departed
+pod's workerlog (and the survivors' recovery record) says *why* it
+died: ``sigterm`` (infrastructure preemption, the original flow),
+``descale`` (controller shrank the job), ``priority-yield`` (training
+yielded chips to a higher-priority job's demand), ``straggler-evict``
+(the remediation dispatcher evicted a slow pod on a firing
+trainer-straggler alert — controller/remediate.py).
 """
 
 from __future__ import annotations
 
+import json
+
 from edl_tpu.cluster import heartbeat
 
+# the flow the reason rides: infrastructure SIGTERM, controller
+# descale, priority arbitration yield, alert-driven straggler eviction
+REASONS = ("sigterm", "descale", "priority-yield", "straggler-evict")
 
-def flag_preempt(store, job_id: str, stage: str, pod_id: str) -> float:
+
+def flag_preempt(store, job_id: str, stage: str, pod_id: str,
+                 reason: str = "sigterm") -> float:
     """Record 'pod ``pod_id`` is being preempted at stage ``stage``'.
 
     Two records: the legacy single-slot stage flag (what trainers poll
     for the sighting, last-writer-wins) AND a per-pod marker — with
     SIMULTANEOUS multi-pod preemptions the single slot names only one
     pod, and a delta-resize survivor check based on it alone would
-    keep an overwritten departing pod alive (`is_pod_preempted`)."""
+    keep an overwritten departing pod alive (`is_pod_preempted`).  The
+    per-pod marker carries the eviction ``reason``."""
     from edl_tpu.cluster import paths
     from edl_tpu.utils import constants
     t = heartbeat.write_stage_flag(store, job_id, "preempt", stage, pod_id)
     store.put(paths.key(job_id, constants.ETCD_HEARTBEAT,
                         f"preempt_pod/{stage}/{pod_id}"),
-              repr(t).encode())
+              json.dumps({"ts": t, "reason": reason}).encode())
     return t
+
+
+def pod_preempt_info(store, job_id: str, stage: str, pod_id: str
+                     ) -> tuple[float, str] | None:
+    """``(timestamp, reason)`` of ``pod_id``'s own pending preemption
+    at ``stage``, or None.  Tolerates the pre-reason record format (a
+    bare ``repr(ts)``), read as reason ``sigterm``."""
+    from edl_tpu.cluster import paths
+    from edl_tpu.utils import constants
+    rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
+                              f"preempt_pod/{stage}/{pod_id}"))
+    if rec is None or not rec.value:
+        return None
+    raw = rec.value.decode()
+    try:
+        d = json.loads(raw)
+        if isinstance(d, dict):
+            return float(d.get("ts", 0.0)), str(d.get("reason", "sigterm"))
+        return float(d), "sigterm"     # bare number: legacy record
+    except ValueError:
+        return None
 
 
 def is_pod_preempted(store, job_id: str, stage: str, pod_id: str) -> bool:
     """True iff ``pod_id`` itself has a pending preemption at ``stage``
     — robust to several pods being preempted in the same stage."""
-    from edl_tpu.cluster import paths
-    from edl_tpu.utils import constants
-    rec = store.get(paths.key(job_id, constants.ETCD_HEARTBEAT,
-                              f"preempt_pod/{stage}/{pod_id}"))
-    return rec is not None and bool(rec.value)
+    return pod_preempt_info(store, job_id, stage, pod_id) is not None
 
 
 def get_preempt(store, job_id: str, stage: str) -> float | None:

@@ -129,6 +129,16 @@ def summarize_recovery(store, job_id: str,
             "resize_mode": mode,
             "detect_at": round(lt["detect"], 3),
         }
+        # reasoned departures (preempt flag carried an eviction reason:
+        # descale / priority-yield / straggler-evict / sigterm) — merged
+        # across every launcher half so one pod's store blip can't lose
+        # the why; edl-obs-dump timelines render it
+        evicted: dict[str, str] = {}
+        for t in launchers.values():
+            if isinstance(t.get("evicted"), dict):
+                evicted.update(t["evicted"])
+        if evicted:
+            entry["evicted"] = evicted
         for phase, begin, end in LAUNCHER_PHASES:
             if begin in lt and end in lt:
                 entry[phase] = round(max(0.0, lt[end] - lt[begin]), 3)

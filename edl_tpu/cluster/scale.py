@@ -58,3 +58,62 @@ def load_nodes_range(store, job_id: str) -> tuple[int, int] | None:
         return None
     d = json.loads(rec.value.decode())
     return int(d["min"]), int(d["max"])
+
+
+# -- multi-job arbitration records (controller/policy.py) -----------------
+def save_job_spec(store, job_id: str, kind: str = "training",
+                  priority: int | None = None, gang: bool = False) -> None:
+    """Arbitration spec for one job: ``kind`` (training / distill /
+    serving — serving jobs are counted by their replica adverts, not a
+    cluster record), ``priority`` (surplus capacity goes to higher
+    classes first; None = the kind's default, policy.KIND_PRIORITY) and
+    ``gang`` (atomic placement: min_nodes or nothing).  Published by
+    whoever owns the job's deployment; absent = a plain training job."""
+    spec = {"kind": kind, "gang": bool(gang)}
+    if priority is not None:
+        spec["priority"] = int(priority)
+    store.put(paths.key(job_id, constants.ETCD_SCALE, "spec"),
+              json.dumps(spec).encode())
+
+
+def load_job_spec(store, job_id: str) -> dict | None:
+    """``{"kind", "gang"[, "priority"]}`` or None (defaults apply)."""
+    rec = store.get(paths.key(job_id, constants.ETCD_SCALE, "spec"))
+    if rec is None:
+        return None
+    try:
+        d = json.loads(rec.value.decode())
+        return d if isinstance(d, dict) else None
+    except ValueError:
+        return None
+
+
+def save_demand(store, job_id: str, replicas: int, reason: str = "",
+                by: str = "remediation") -> None:
+    """Autoscaling demand signal: the alert-driven remediation
+    dispatcher (controller/remediate.py ``scale-out``) asks the
+    controller for this many replicas.  Timestamped — the controller's
+    autoscaler only honors a demand fresher than EDL_TPU_DEMAND_TTL,
+    so a dead dispatcher's last spike decays instead of pinning the
+    fleet scaled out forever."""
+    store.put(paths.key(job_id, constants.ETCD_SCALE, "demand"),
+              json.dumps({"replicas": int(replicas), "reason": reason,
+                          "by": by, "at": time.time()}).encode())
+
+
+def load_demand(store, job_id: str) -> dict | None:
+    """``{"replicas", "reason", "at"}`` or None."""
+    rec = store.get(paths.key(job_id, constants.ETCD_SCALE, "demand"))
+    if rec is None:
+        return None
+    try:
+        d = json.loads(rec.value.decode())
+        return {"replicas": int(d["replicas"]),
+                "reason": str(d.get("reason", "")),
+                "at": float(d.get("at", 0.0))}
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def clear_demand(store, job_id: str) -> None:
+    store.delete(paths.key(job_id, constants.ETCD_SCALE, "demand"))

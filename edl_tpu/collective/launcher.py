@@ -43,6 +43,10 @@ _RESIZES_TOTAL = obs_metrics.counter(
     ("mode",))
 _HANG_RESTARTS_TOTAL = obs_metrics.counter(
     "edl_hang_restarts_total", "Trainer hang-watchdog restart incidents")
+_TARGETED_RESTARTS_TOTAL = obs_metrics.counter(
+    "edl_targeted_restarts_total",
+    "In-place trainer restarts ordered through the per-pod remediation "
+    "flag (alert-driven, no membership change)")
 
 
 class Launcher:
@@ -77,6 +81,7 @@ class Launcher:
         self._procs: list[train_process.TrainerProc] = []
         self._hang_incident: float | None = None
         self._hang_counts: dict[str, int] = {}  # stage -> incidents seen
+        self._targeted_counts: dict[str, int] = {}  # stage -> remediation restarts
         import threading
         self._preempt_event = threading.Event()
         self._preempt_stage: str | None = None  # stage the flag was written for
@@ -158,7 +163,8 @@ class Launcher:
         # if the env-gated /metrics endpoint is serving, advertise it in
         # the coord store so edl-obs-agg discovers this launcher
         self._obs_register = obs_advert.advertise_installed(
-            self._store, job_id, "launcher", ttl=self._ttl)
+            self._store, job_id, "launcher", ttl=self._ttl,
+            extra={"pod": self._pod.pod_id})
         if self._cache_service is not None:
             # TTL-leased cache advert next to the pod resource advert:
             # the advert dying with this launcher is exactly the
@@ -275,6 +281,17 @@ class Launcher:
             # new stage, seeded from the restored DataCheckpoint
             for dead in old_pods - set(cluster.pod_ids()):
                 self._data_service.mark_pod_dead(dead)
+                # a departed pod that was preempt-flagged died ON
+                # PURPOSE: carry the reason into this resize's recovery
+                # record so timelines say WHY the membership changed
+                try:
+                    from edl_tpu.cluster import preempt
+                    pinfo = preempt.pod_preempt_info(self._store, job_id,
+                                                     old_stage, dead)
+                except Exception:  # noqa: BLE001 — reason is best-effort
+                    pinfo = None
+                if pinfo is not None:
+                    resize_times.setdefault("evicted", {})[dead] = pinfo[1]
             if delta:
                 if self._delta_commit(old_stage, old_ranking, cluster,
                                       resize_times):
@@ -319,15 +336,37 @@ class Launcher:
         # the baseline instead of acting on it, so a store blip can
         # never replay an old incident
         hang_baseline: float | None = 0.0
+        # cluster=None = pre-barrier supervision (tests drive it too):
+        # no stage exists yet for any stage-scoped incident flag
+        job_id = self._job_env.job_id if cluster is not None else ""
+        # the watchdog knob gates LOCAL staleness detection only; the
+        # hang FLAG is a coordination channel (a peer's detection, or a
+        # remediation-ordered restart) and is polled whenever a stage
+        # exists — EDL_TPU_HANG_TIMEOUT=-1 with the alert engine doing
+        # the detecting is exactly the advertised configuration, and a
+        # flagged coordinated restart must not silently no-op under it
         watchdog = constants.HANG_TIMEOUT >= 0 and cluster is not None
-        if watchdog:
-            job_id = self._job_env.job_id
+        if cluster is not None:
             try:
                 hang_baseline = heartbeat.get_hang(
                     self._store, job_id, cluster.stage) or 0.0
             except Exception:  # noqa: BLE001
                 logger.exception("hang-flag read failed")
                 hang_baseline = None
+        # targeted-restart flag (the remediation dispatcher's alert->
+        # action path, controller/remediate.py): polled REGARDLESS of
+        # the local watchdog knob — the alert engine can see a stall
+        # (step-metric silence) the local heartbeat threshold may not.
+        # Same adopt-first-value-after-a-blip baseline as the hang flag.
+        restart_baseline: float | None = 0.0
+        if cluster is not None:
+            try:
+                rinfo = heartbeat.read_pod_restart(
+                    self._store, job_id, cluster.stage, self._pod.pod_id)
+                restart_baseline = rinfo[0] if rinfo else 0.0
+            except Exception:  # noqa: BLE001
+                logger.exception("restart-flag read failed")
+                restart_baseline = None
         while True:
             if (cluster is not None and self._preempt_event.is_set()
                     and self._preempt_stage != cluster.stage):
@@ -347,6 +386,33 @@ class Launcher:
                     self._preempt_stage = cluster.stage
                 except Exception:  # noqa: BLE001 — retried next poll
                     logger.exception("preempt flag write failed; retrying")
+            if cluster is not None:
+                try:
+                    rinfo = heartbeat.read_pod_restart(
+                        self._store, job_id, cluster.stage,
+                        self._pod.pod_id)
+                except Exception:  # noqa: BLE001 — a blip is not an order
+                    rinfo = None
+                if rinfo and restart_baseline is None:
+                    restart_baseline = rinfo[0]   # first read after a blip
+                elif rinfo and rinfo[0] > restart_baseline:
+                    restart_baseline = rinfo[0]
+                    if self._count_targeted(cluster.stage):
+                        return Status.FAILED
+                    logger.warning("remediation ordered an in-place "
+                                   "trainer restart (reason=%s)", rinfo[1])
+                    _TARGETED_RESTARTS_TOTAL.inc()
+                    obs_trace.emit("launcher/targeted_restart",
+                                   stage=cluster.stage, reason=rinfo[1])
+                    self._shutdown_trainers()
+                    self._clear_heartbeat()
+                    self._host_world_service(cluster)
+                    self._procs = train_process.start_trainers(
+                        self._job_env, self._pod, cluster, self._script,
+                        self._script_args, self._log_dir(),
+                        extra_env=self._trainer_trace_env())
+                    time.sleep(self._period)
+                    continue
             local = train_process.watch_procs(self._procs)
             if local == Status.SUCCEED:
                 return Status.SUCCEED
@@ -360,6 +426,24 @@ class Launcher:
                 # jax.distributed init until its 120 s register timeout
                 if self._preempt_event.is_set():
                     logger.info("preemption checkpoint complete; departing")
+                    return Status.DESCALED
+                # no SIGTERM arrived, but the preempt flag may name THIS
+                # pod: a controller descale / priority yield or a
+                # remediation straggler eviction (reasoned flag) — the
+                # checkpoint the trainers just took IS the grace; depart
+                evict = None
+                if cluster is not None:
+                    from edl_tpu.cluster import preempt
+                    try:
+                        evict = preempt.pod_preempt_info(
+                            self._store, job_id, cluster.stage,
+                            self._pod.pod_id)
+                    except Exception:  # noqa: BLE001 — treat as peer preempt
+                        logger.exception("eviction-flag read failed")
+                if evict is not None:
+                    logger.warning("evicted (reason=%s): preemption "
+                                   "checkpoint complete; departing",
+                                   evict[1])
                     return Status.DESCALED
                 if peer_preempted_at is None:
                     peer_preempted_at = time.monotonic()
@@ -389,7 +473,10 @@ class Launcher:
                 return Status.FAILED
             if watcher.changed:
                 return None
-            if watchdog:
+            if cluster is not None:
+                # the coordinated hang flag: a peer's watchdog OR a
+                # remediation-ordered restart — not gated on the local
+                # watchdog knob (see the baseline note above)
                 try:
                     t = heartbeat.get_hang(self._store, job_id, cluster.stage)
                 except Exception:  # noqa: BLE001
@@ -452,6 +539,20 @@ class Launcher:
             logger.error("trainers hung %d times at stage %s (%d restarts "
                          "attempted); failing pod", n, stage[:8],
                          constants.HANG_MAX_RESTARTS)
+            return True
+        return False
+
+    def _count_targeted(self, stage: str) -> bool:
+        """Count a remediation-ordered restart against ``stage``; True =
+        the HANG_MAX_RESTARTS cap is exhausted — defense in depth under
+        the dispatcher's own circuit breaker, so even a broken breaker
+        cannot restart-storm one stage forever."""
+        n = self._targeted_counts.get(stage, 0) + 1
+        self._targeted_counts[stage] = n
+        if n > constants.HANG_MAX_RESTARTS:
+            logger.error("remediation restarted trainers %d times at stage "
+                         "%s; failing pod instead of restarting again",
+                         n - 1, stage[:8])
             return True
         return False
 

@@ -1,7 +1,11 @@
 """Elastic controller: the in-tree replacement for the reference's k8s
-TrainingJob controller/autoscaler (k8s/edl_controller.yaml)."""
+TrainingJob controller/autoscaler (k8s/edl_controller.yaml), grown into
+the multi-job arbiter + alert-driven remediation loop (ROADMAP 4)."""
 
+from edl_tpu.controller.autoscale import ServingAutoscaler
 from edl_tpu.controller.controller import Controller
-from edl_tpu.controller.policy import JobView, compute_desired
+from edl_tpu.controller.policy import KIND_PRIORITY, JobView, compute_desired
+from edl_tpu.controller.remediate import CircuitBreaker, RemediationDispatcher
 
-__all__ = ["Controller", "JobView", "compute_desired"]
+__all__ = ["Controller", "JobView", "compute_desired", "KIND_PRIORITY",
+           "ServingAutoscaler", "RemediationDispatcher", "CircuitBreaker"]

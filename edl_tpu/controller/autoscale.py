@@ -1,0 +1,137 @@
+"""Serving-fleet autoscaler: the controller's demand signal for
+``kind="serving"`` jobs (ROADMAP item 2c).
+
+The PR-8 rule engine already computes the windowed signals
+(``gateway-p99-slo``, ``gateway-reject-burn``); this module turns them
+into a replica TARGET the arbitration policy treats as the serving
+job's demand cap (policy.JobView.demand):
+
+- **scale-out** — two inputs, folded with max():
+
+  * the demand record (``cluster/scale.py save_demand``) the
+    remediation dispatcher writes on a firing gateway alert — the
+    store is the channel, so the dispatcher (aggregator process) and
+    the controller need no direct wiring; a record older than
+    ``EDL_TPU_DEMAND_TTL`` is ignored, so a dead dispatcher's last
+    spike decays instead of pinning the fleet out forever;
+  * an optional direct ``/alerts`` poll (``alerts_url``): when the
+    controller is pointed at the job's aggregator it reads the firing
+    set itself and steps the target by ``EDL_TPU_AUTOSCALE_STEP``
+    per firing window — the loop closes even with remediation in
+    dry-run;
+
+- **scale-in on sustained quiet** — no demand signal for
+  ``EDL_TPU_AUTOSCALE_QUIET`` seconds decays the target one replica
+  per quiet window, down to the job's ``min_nodes``.  The decay is
+  deliberately slower than the growth (one step per window vs one
+  step per firing) so a bursty workload holds its headroom.
+
+The controller applies the target through the SAME desired-size
+record + actuator as trainer pods — replicas scale exactly like
+training capacity, under the same priorities and cooldowns.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from edl_tpu.cluster import scale
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.constants import env_float
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_DEMAND_G = obs_metrics.gauge(
+    "edl_controller_serving_demand",
+    "The autoscaler's current replica target per serving job", ("job",))
+
+#: gateway-family builtin alerts that mean "the fleet needs headroom"
+GATEWAY_ALERTS = ("gateway-p99-slo", "gateway-reject-burn")
+
+
+class ServingAutoscaler:
+    """Per-serving-job replica targets from alerts + demand records."""
+
+    def __init__(self, store, alerts_url: str | None = None,
+                 step: int | None = None, quiet_s: float | None = None,
+                 demand_ttl: float | None = None, poll_timeout: float = 2.0):
+        self._store = store
+        self._alerts_url = alerts_url
+        self._step = (int(env_float("EDL_TPU_AUTOSCALE_STEP", 1))
+                      if step is None else int(step))
+        self._quiet = (env_float("EDL_TPU_AUTOSCALE_QUIET", 120.0)
+                       if quiet_s is None else float(quiet_s))
+        self._demand_ttl = (env_float("EDL_TPU_DEMAND_TTL", 120.0)
+                            if demand_ttl is None else float(demand_ttl))
+        self._poll_timeout = poll_timeout
+        # job -> (last_signal_mono, target)
+        self._state: dict[str, tuple[float, int]] = {}
+        self._alerts_cache: tuple[float, set[str]] | None = None
+
+    # -- inputs --------------------------------------------------------------
+    def _firing(self, now: float) -> set[str]:
+        """Names of firing gateway-family alerts from the aggregator's
+        /alerts endpoint (cached ~1s; empty on any failure — a dead
+        aggregator must never wedge the controller)."""
+        if self._alerts_url is None:
+            return set()
+        cached = self._alerts_cache
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        names: set[str] = set()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                self._alerts_url, timeout=self._poll_timeout).read().decode())
+            names = {str(a.get("alert")) for a in body.get("firing", [])}
+            names &= set(GATEWAY_ALERTS)
+        except Exception as e:  # noqa: BLE001 — alerts are advisory input
+            logger.debug("alerts poll failed: %s", e)
+        self._alerts_cache = (now, names)
+        return names
+
+    def _demand_record(self, job_id: str) -> int | None:
+        try:
+            rec = scale.load_demand(self._store, job_id)
+        except Exception:  # noqa: BLE001 — a store blip is not a demand
+            logger.exception("demand record read failed for %s", job_id)
+            return None
+        if rec is None:
+            return None
+        # edl-lint: disable=clock — rec["at"] is the dispatcher's
+        # wall-clock stamp read from the store; freshness across
+        # processes can only be judged wall-to-wall
+        if time.time() - rec["at"] > self._demand_ttl:
+            return None
+        return int(rec["replicas"])
+
+    # -- the decision --------------------------------------------------------
+    def desired(self, job_id: str, min_nodes: int, max_nodes: int,
+                current: int, now: float | None = None) -> int:
+        """The serving job's replica target this tick.  Monotone while
+        signals fire, decays one step per quiet window, clamped to
+        [min_nodes, max_nodes]."""
+        now = time.monotonic() if now is None else now
+        demand = self._demand_record(job_id)
+        firing = self._firing(now)
+        last, target = self._state.get(
+            job_id, (now, max(min_nodes, min(max_nodes, current))))
+        if demand is not None or firing:
+            want = target
+            if firing:
+                want = max(want, current + self._step)
+            if demand is not None:
+                want = max(want, demand)
+            target = max(min_nodes, min(max_nodes, want))
+            last = now
+        elif now - last > self._quiet and target > min_nodes:
+            target -= 1                  # one step per quiet window
+            last = now
+            logger.info("serving job %s quiet for %.0fs: scaling in to %d",
+                        job_id, self._quiet, target)
+        target = max(min_nodes, min(max_nodes, target))
+        self._state[job_id] = (last, target)
+        _DEMAND_G.labels(job=job_id).set(target)
+        return target

@@ -26,7 +26,8 @@ from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.status import Status, load_job_status
 from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
 from edl_tpu.controller.actuator import NullActuator
-from edl_tpu.controller.policy import JobView, compute_desired
+from edl_tpu.controller.autoscale import ServingAutoscaler
+from edl_tpu.controller.policy import KIND_PRIORITY, JobView, compute_desired
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import context as obs_context
 from edl_tpu.obs import trace as obs_trace
@@ -46,6 +47,10 @@ _RESIZE_COST = obs_metrics.gauge(
     "edl_controller_resize_cost_seconds",
     "Last measured stop-resume cost per job (recovery records)",
     ("job",))
+_EVICTIONS_TOTAL = obs_metrics.counter(
+    "edl_controller_evictions_total",
+    "Pods flagged for graceful (preempt-grace) eviction on a "
+    "controller shrink, by job and reason", ("job", "reason"))
 
 
 class Controller:
@@ -55,7 +60,10 @@ class Controller:
                  actuator=None, period: float = 5.0,
                  cooldown: float = 30.0,
                  cooldown_per_resize_s: float = 10.0,
-                 observe_window_s: float = 900.0):
+                 observe_window_s: float = 900.0,
+                 alerts_url: str | None = None,
+                 autoscaler: ServingAutoscaler | None = None,
+                 preempt_grace_s: float = 0.0):
         """``capacity``: schedulable pod slots across the cluster (the
         k8s node budget; the thing ``max_load_desired`` scales).
         **0 = observe**: the high-water mark of concurrently live pod
@@ -72,7 +80,20 @@ class Controller:
         job — scaled UP per job by ``cooldown_per_resize_s`` x its
         last measured stop-resume cost (recovery records), so a job
         that takes 30 s to resize flaps an order of magnitude slower
-        than one that takes 2 s."""
+        than one that takes 2 s.
+
+        Multi-job arbitration: every managed job's ``scale/spec``
+        record (kind/priority/gang — cluster/scale.py) feeds the
+        policy; ``kind="serving"`` jobs are counted by their replica
+        adverts and capped by the :class:`ServingAutoscaler`'s demand
+        (``alerts_url`` points it at the job aggregator's ``/alerts``).
+        ``preempt_grace_s`` > 0 turns a training/distill SHRINK into a
+        graceful eviction: the retiring pods (highest ranks — the same
+        pods the generator will drop) are preempt-flagged with a
+        reason (``priority-yield`` when a higher class's demand forced
+        the shrink, else ``descale``) so trainers checkpoint at an
+        agreed step and depart DESCALED; the desired record is written
+        once they leave (or the grace expires)."""
         import collections
         self._store = store
         self._capacity = capacity
@@ -89,6 +110,11 @@ class Controller:
         self._last_change: dict[str, float] = {}
         self._resize_cost_cache: dict[str, tuple[float, float]] = {}
         self._reaped: set[str] = set()
+        self._autoscaler = autoscaler or ServingAutoscaler(
+            store, alerts_url=alerts_url)
+        self._preempt_grace = float(preempt_grace_s)
+        # job -> in-flight graceful eviction {want, pods, stage, deadline}
+        self._evictions: dict[str, dict] = {}
         self._halt = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -127,6 +153,22 @@ class Controller:
             return None
         if self._terminal(job_id):
             return None
+        spec = scale.load_job_spec(self._store, job_id) or {}
+        kind = str(spec.get("kind", "training"))
+        priority = int(spec.get("priority", KIND_PRIORITY.get(kind, 0)))
+        gang = bool(spec.get("gang", False))
+        if kind == "serving":
+            # a replica fleet has no cluster record or train status:
+            # the live serving adverts ARE the membership, and the
+            # autoscaler's demand caps its surplus take
+            from edl_tpu.gateway.fleet import list_replicas
+            current = len(list_replicas(self._store, job_id))
+            view = JobView(job_id=job_id, min_nodes=rng[0],
+                           max_nodes=rng[1], current_nodes=current,
+                           kind=kind, priority=priority, gang=gang)
+            view.demand = self._autoscaler.desired(job_id, rng[0], rng[1],
+                                                   current)
+            return view
         cluster = Cluster.load_from_store(self._store, job_id)
         current = len(cluster.pods) if cluster else 0
         ts = load_train_statuses(self._store, job_id)
@@ -140,7 +182,8 @@ class Controller:
         return JobView(job_id=job_id, min_nodes=rng[0], max_nodes=rng[1],
                        current_nodes=current, scalable=scalable,
                        pending_pods=len(live - members),
-                       resize_cost_s=self._resize_cost(job_id))
+                       resize_cost_s=self._resize_cost(job_id),
+                       kind=kind, priority=priority, gang=gang)
 
     _RESIZE_COST_TTL = 60.0
 
@@ -208,14 +251,29 @@ class Controller:
         else:
             desired = compute_desired(views, self._effective_capacity(views),
                                       1.0)
-        acted: dict[str, int] = {}
         now = time.monotonic()
+        acted = self._drive_evictions(now)
         for v in views:
             want = desired[v.job_id]
+            if v.job_id in self._evictions:
+                if want >= v.current_nodes:
+                    # the pressure lifted before the record landed: the
+                    # flagged pods still depart (a preemption cannot be
+                    # unwritten — trainers may already be checkpointing)
+                    # but no shrink record follows them out
+                    logger.info("job %s: pending eviction overtaken by "
+                                "scale-up; dropping the shrink record",
+                                v.job_id)
+                    self._evictions.pop(v.job_id, None)
+                continue                 # eviction draining: hands off
             if want == v.current_nodes:
                 continue
             last = self._last_change.get(v.job_id, -float("inf"))
             if now - last < self._effective_cooldown(v):
+                continue
+            if (want < v.current_nodes and self._preempt_grace > 0
+                    and v.kind in ("training", "distill")
+                    and self._begin_eviction(v, want, views, desired, now)):
                 continue
             prev = None
             try:
@@ -246,6 +304,86 @@ class Controller:
                                from_nodes=v.current_nodes, to_nodes=want,
                                resize_cost_s=v.resize_cost_s)
         return acted
+
+    # -- graceful (preempt-grace) shrink -------------------------------------
+    def _begin_eviction(self, v: JobView, want: int, views: list[JobView],
+                        desired: dict[str, int], now: float) -> bool:
+        """Flag the retiring pods (highest ranks — the same pods the
+        generator's desired cap will drop) for preemption with a
+        machine-readable reason, so trainers checkpoint at an agreed
+        step BEFORE the shrink record yanks membership.  True = the
+        eviction is in flight (the desired record follows once the
+        pods depart or the grace expires); False = fall back to the
+        direct record write."""
+        from edl_tpu.cluster import preempt
+        try:
+            cluster = Cluster.load_from_store(self._store, v.job_id)
+        except Exception:  # noqa: BLE001 — fall back to the direct write
+            logger.exception("cluster read failed for %s", v.job_id)
+            return False
+        if cluster is None or len(cluster.pods) <= want:
+            return False
+        retiring = cluster.pod_ids()[want:]
+        # WHY the shrink: a higher class growing this tick means this
+        # job is yielding chips to it; otherwise it is a plain descale
+        reason = ("priority-yield" if any(
+            o.priority > v.priority
+            and desired.get(o.job_id, 0) > o.current_nodes
+            for o in views) else "descale")
+        try:
+            for pod in retiring:
+                preempt.flag_preempt(self._store, v.job_id, cluster.stage,
+                                     pod, reason=reason)
+        except Exception:  # noqa: BLE001 — fall back to the direct write
+            logger.exception("preempt flag write failed for %s", v.job_id)
+            return False
+        _EVICTIONS_TOTAL.labels(job=v.job_id, reason=reason).inc(
+            len(retiring))
+        logger.info("job %s: graceful shrink %d -> %d (reason=%s); "
+                    "flagged %s", v.job_id, v.current_nodes, want, reason,
+                    [p[:8] for p in retiring])
+        with obs_context.use(obs_context.new_trace(job=v.job_id)):
+            obs_trace.emit("controller/evict", job=v.job_id, reason=reason,
+                           pods=[p[:8] for p in retiring],
+                           from_nodes=v.current_nodes, to_nodes=want)
+        self._evictions[v.job_id] = {
+            "want": want, "pods": retiring, "stage": cluster.stage,
+            "deadline": now + self._preempt_grace}
+        return True
+
+    def _drive_evictions(self, now: float) -> dict[str, int]:
+        """Commit the shrink record for evictions whose pods departed
+        (or whose grace expired — the generator then drops them the
+        hard way); returns what was committed this tick."""
+        done: dict[str, int] = {}
+        for job_id, ev in list(self._evictions.items()):
+            try:
+                cluster = Cluster.load_from_store(self._store, job_id)
+                live = set(cluster.pod_ids()) if cluster else set()
+            except Exception:  # noqa: BLE001 — retry next tick
+                logger.exception("cluster read failed for %s", job_id)
+                continue
+            if (set(ev["pods"]) & live) and now < ev["deadline"]:
+                continue                 # still draining gracefully
+            if now >= ev["deadline"] and set(ev["pods"]) & live:
+                logger.warning("job %s: preempt grace expired with %s "
+                               "still in the cluster; committing the "
+                               "shrink record anyway", job_id,
+                               [p[:8] for p in set(ev["pods"]) & live])
+            want = ev["want"]
+            try:
+                scale.save_desired_nodes(self._store, job_id, want)
+            except Exception:  # noqa: BLE001 — retry next tick
+                logger.exception("desired record write failed for %s",
+                                 job_id)
+                continue
+            self._actuator.scale(job_id, want)
+            del self._evictions[job_id]
+            self._last_change[job_id] = now
+            done[job_id] = want
+            _DECISIONS_TOTAL.labels(job=job_id, direction="down").inc()
+            _DESIRED_NODES.labels(job=job_id).set(want)
+        return done
 
     def _reap_finished(self, jobs: list[str]) -> None:
         """Scale terminal jobs' workloads to zero, once — the reference

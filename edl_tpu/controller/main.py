@@ -38,6 +38,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="when set, also `kubectl scale` the job's "
                         "StatefulSet in this namespace")
     p.add_argument("--kubectl", default="kubectl")
+    p.add_argument("--alerts_endpoint", default="",
+                   help="a job aggregator's host:port; the serving "
+                        "autoscaler polls its /alerts for firing "
+                        "gateway SLO rules (demand records from the "
+                        "remediation dispatcher work without it)")
+    p.add_argument("--preempt_grace", type=float, default=0.0,
+                   help="> 0: shrink training/distill jobs through the "
+                        "preemption-grace path (flag + checkpoint + "
+                        "DESCALED departure) instead of yanking the "
+                        "desired record; the value bounds the wait")
     return p
 
 
@@ -61,7 +71,10 @@ def run(argv=None) -> int:
                      max_load_desired=args.max_load_desired,
                      job_ids=args.job_id, actuator=actuator,
                      period=args.period, cooldown=args.cooldown,
-                     cooldown_per_resize_s=args.cooldown_per_resize_s)
+                     cooldown_per_resize_s=args.cooldown_per_resize_s,
+                     alerts_url=(f"http://{args.alerts_endpoint}/alerts"
+                                 if args.alerts_endpoint else None),
+                     preempt_grace_s=args.preempt_grace)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

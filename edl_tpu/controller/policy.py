@@ -1,4 +1,5 @@
-"""Autoscaling policy: cluster capacity -> per-job desired pod counts.
+"""Arbitration policy: one cluster capacity pool -> per-job desired
+pod counts across HETEROGENEOUS job kinds.
 
 The reference controller's contract (k8s/edl_controller.yaml:21,
 ``-max_load_desired 0.9``; doc/usage.md): keep the cluster filled to at
@@ -7,28 +8,54 @@ budget fairly across running elastic jobs, each clamped to its own
 ``nodes_range``.  This module is the PURE half — no store, no k8s —
 so the policy is unit-testable against fabricated job views.
 
-Rules (reference behavior + the repo's own scaling gates):
+The multi-job extension (ROADMAP item 4 — elasticity as a *cluster
+utilization* story): training jobs, the distill teacher fleet, and
+serving replica fleets are arbitrated against ONE pool with
+
+- **priorities** — surplus capacity is handed out by priority class
+  (serving > distill > training by default, ``JobView.priority``);
+  a higher class's demand squeezes lower classes down toward their
+  floors — training yields chips to serving under traffic and
+  reclaims them when the demand signal decays;
+- **floors** — every job's ``min_nodes`` comes off the top before any
+  surplus is split, so no job ever starves (a floor is granted even
+  over budget, the original single-job rule);
+- **gang scheduling** — a ``gang=True`` job is placed atomically: its
+  floor is granted whole or the job gets exactly 0 — a partial gang
+  is never stranded holding chips it cannot use;
+- **demand caps** — a job with ``demand`` set (the serving autoscaler's
+  replica target, controller/autoscale.py) takes surplus only up to
+  that demand, leaving the rest for lower classes, instead of growing
+  to its fair share of everything.
+
+Rules retained from the single-kind policy:
 
 - budget = floor(capacity * max_load_desired), at least one pod;
-- fair share: each active job gets budget // n_jobs, remainder first
-  to jobs with PENDING pods (a registered-but-unplaced replica means
-  the infra already scheduled the hardware — growing that job is a
-  free join, no actuator round-trip), then earliest by job_id — the
-  reference's fragment-avoiding fair division, load-informed;
+- within one priority class, fair division: each job gets
+  class_budget // n, remainder first to jobs with PENDING pods (a
+  registered-but-unplaced replica means the infra already scheduled
+  the hardware — growing that job is a free join), then earliest by
+  job_id;
 - clamp to [min_nodes, max_nodes] per job;
 - a job whose train status is not scalable (NEARTHEEND — the
   anti-meaningless-scaling rule, train_status.py) keeps its current
-  size;
+  size and its pods keep consuming the budget;
 - never scale a terminal (SUCCEED/FAILED) job — it leaves the view.
 
 The policy stays PURE: every observed signal (live pod counts, pending
-replicas, measured resize cost) arrives in the JobView / arguments;
-the controller does the observing.
+replicas, autoscaler demand, measured resize cost) arrives in the
+JobView / arguments; the controller does the observing.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+
+# default priority per job kind when the job's spec doesn't set one:
+# serving fronts users (latency budget), the distill teacher fleet
+# feeds students (throughput budget), training absorbs what's left —
+# the paper's "training yields chips to serving" ordering
+KIND_PRIORITY = {"serving": 100, "distill": 50, "training": 0}
 
 
 @dataclass
@@ -47,6 +74,19 @@ class JobView:
     # 0 = never measured.  The controller scales each job's resize
     # cooldown with this, so expensive-to-resize jobs flap less.
     resize_cost_s: float = 0.0
+    # -- multi-job arbitration ------------------------------------------
+    kind: str = "training"    # training | distill | serving
+    priority: int = 0         # higher wins surplus capacity first
+    gang: bool = False        # atomic placement: min_nodes or nothing
+    # autoscaler replica target (serving): caps this job's surplus take
+    # at clamp(demand, min, max); None = fair share of the class budget
+    demand: int | None = None
+
+    def cap(self) -> int:
+        """Upper clamp for this job's grant."""
+        if self.demand is None:
+            return self.max_nodes
+        return max(self.min_nodes, min(self.max_nodes, self.demand))
 
 
 def compute_desired(jobs: list[JobView], capacity: int,
@@ -69,14 +109,59 @@ def compute_desired(jobs: list[JobView], capacity: int,
             budget -= job.current_nodes
     if not flexible:
         return out
-    base, rem = divmod(max(0, budget), len(flexible))
-    # remainder pods go first to jobs that already have a pending
-    # replica registered (free join: the hardware is up and waiting),
-    # then earliest job_id; stable within each class
-    order = sorted(range(len(flexible)),
-                   key=lambda i: (0 if flexible[i].pending_pods > 0 else 1, i))
-    gets_extra = set(order[:rem])
-    for i, job in enumerate(flexible):
-        share = base + (1 if i in gets_extra else 0)
-        out[job.job_id] = max(job.min_nodes, min(job.max_nodes, share))
+    budget = max(0, budget)
+
+    # pass 1 — floors, highest priority first: min_nodes comes off the
+    # top so no job starves.  A gang job whose whole floor no longer
+    # fits is granted exactly 0 (all-or-nothing — never a partial gang
+    # stranding chips); a non-gang floor is sacred even over budget
+    # (the original single-job rule: the job's own min wins).
+    floor: dict[str, int] = {}
+    for job in sorted(flexible, key=lambda j: (-j.priority, j.job_id)):
+        if job.gang and job.min_nodes > budget:
+            floor[job.job_id] = 0
+            out[job.job_id] = 0
+            continue
+        floor[job.job_id] = job.min_nodes
+        budget -= job.min_nodes
+    budget = max(0, budget)
+
+    # pass 2 — surplus by priority class, highest first; within a class
+    # the fair division: class_budget // n each, remainder first to
+    # jobs with pending replicas (free join), then earliest; stable.
+    classes: dict[int, list[JobView]] = {}
+    for job in flexible:
+        if job.gang and floor[job.job_id] == 0:
+            continue  # denied gang: granted exactly 0, takes no surplus
+        classes.setdefault(job.priority, []).append(job)
+    for prio in sorted(classes, reverse=True):
+        members = classes[prio]          # job_id-sorted (flexible is)
+        headroom = sum(max(0, j.cap() - floor[j.job_id]) for j in members)
+        take = min(budget, headroom)
+        budget -= take
+        class_budget = sum(floor[j.job_id] for j in members) + take
+        base, rem = divmod(class_budget, len(members))
+        order = sorted(range(len(members)),
+                       key=lambda i: (0 if members[i].pending_pods > 0
+                                      else 1, i))
+        gets_extra = set(order[:rem])
+        for i, job in enumerate(members):
+            share = base + (1 if i in gets_extra else 0)
+            out[job.job_id] = max(floor[job.job_id], min(job.cap(), share))
+        # waterfill the remainder: a member clamped down by its demand
+        # cap must not strand capacity its classmates still have
+        # headroom for (slots a serving job stopped asking for belong
+        # to whoever can use them, in-class first, lower classes next)
+        leftover = class_budget - sum(out[j.job_id] for j in members)
+        while leftover > 0:
+            takers = [members[i] for i in order
+                      if out[members[i].job_id] < members[i].cap()]
+            if not takers:
+                break
+            for job in takers:
+                if leftover <= 0:
+                    break
+                out[job.job_id] += 1
+                leftover -= 1
+        budget += max(0, leftover)       # truly unusable: next class's
     return out

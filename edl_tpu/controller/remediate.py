@@ -1,0 +1,389 @@
+"""Alert-driven remediation: the dispatcher that turns a firing alert
+into an ACTION, behind safety rails.
+
+PR 12 gave the rule engine an action hook and one read-only action
+(``profile``).  This module grows it into the self-driving loop
+(ROADMAP item 4): the aggregator registers these handlers with its
+:class:`~edl_tpu.obs.rules.RuleEngine`, and a firing transition becomes
+
+- ``restart`` (``trainer-hang``) — a targeted restart of the hung
+  job's trainers: a single-pod job gets a per-pod restart flag
+  (``cluster/heartbeat.py flag_pod_restart``; its launcher kills +
+  respawns the trainers in place, no membership change); a multi-pod
+  job — one shared collective world, where killing one pod's trainers
+  unilaterally just crashes the peers — takes the coordinated hang
+  flag (kill + instant re-barrier at the unchanged stage).  Either
+  way, OTHER jobs on the cluster are untouched;
+- ``evict`` (``trainer-straggler``) — the slow pod leaves through the
+  preemption-grace path (``cluster/preempt.py``, reason
+  ``straggler-evict``): trainers checkpoint at an agreed step, the
+  evicted pod departs DESCALED, survivors recover with no span lost.
+  Refused (``no_capacity``) when the job is already at ``min_nodes`` —
+  remediation must never starve the job it is healing;
+- ``scale-out`` (``gateway-p99-slo`` / ``gateway-reject-burn``) — a
+  demand record (``cluster/scale.py save_demand``) asks the controller
+  for more serving replicas; the controller's autoscaler
+  (controller/autoscale.py) honors it and scales the fleet like
+  trainer pods, and scales back in on sustained quiet.
+
+An actuator wired to an alert is a NEW failure mode, so every action
+runs behind rails:
+
+- **per-(rule, action) cooldown** (``EDL_TPU_REMEDIATE_COOLDOWN``) —
+  one alert transition = at most one action per window;
+- **circuit breaker** per action (``EDL_TPU_REMEDIATE_BREAKER_N``
+  executions inside ``EDL_TPU_REMEDIATE_BREAKER_WINDOW`` seconds trips
+  it OPEN for ``EDL_TPU_REMEDIATE_BREAKER_RESET`` seconds): a flapping
+  rule cannot restart-storm a healthy job.  Open surfaces as the
+  ``edl_remediation_breaker_open`` gauge, which the builtin
+  ``remediation-breaker-open`` rule turns into its own alert.  After
+  the reset the breaker HALF-OPENS: one trial action is allowed; a
+  re-trigger inside the window re-opens it, a quiet window closes it;
+- **dry-run** (``EDL_TPU_REMEDIATE=0``) — the dispatcher resolves
+  targets and records what it WOULD do (outcome ``dryrun``) without
+  touching the store;
+- **audit** — every trigger lands in the durable incident log
+  (``action/<name>`` records joined to the job's current generation
+  trace, next to the alert's own record) and in the in-memory
+  recent-actions ring served on ``/alerts`` (the ``edl-obs-top``
+  "recent actions" pane); executions count into
+  ``edl_alert_actions_total{action,outcome}`` with the new
+  ``cooldown`` / ``breaker_open`` / ``dryrun`` / ``noop`` outcomes.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.constants import env_float
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_BREAKER_G = obs_metrics.gauge(
+    "edl_remediation_breaker_open",
+    "1 while the named remediation action's circuit breaker is OPEN "
+    "(flapping rule; actions suppressed until half-open)", ("action",))
+_BREAKER_TRIPS = obs_metrics.counter(
+    "edl_remediation_breaker_trips_total",
+    "Circuit-breaker open transitions, by action", ("action",))
+
+
+class CircuitBreaker:
+    """Per-action breaker: ``allow()`` records an execution or denies.
+
+    closed --(N executions inside window)--> open --(reset_s)-->
+    half-open --(one trial; re-trigger inside window)--> open
+             \\--(window of quiet)--> closed
+    """
+
+    def __init__(self, max_actions: int = 3, window_s: float = 120.0,
+                 reset_s: float = 300.0):
+        self.max_actions = max(1, int(max_actions))
+        self.window_s = float(window_s)
+        self.reset_s = float(reset_s)
+        self.state = "closed"
+        self._times: collections.deque[float] = collections.deque()
+        self._open_at = 0.0
+
+    def allow(self, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        while self._times and self._times[0] <= now - self.window_s:
+            self._times.popleft()
+        if self.state == "open":
+            if now - self._open_at < self.reset_s:
+                return False
+            # half-open: the window starts empty; ONE trial may run
+            self.state = "half_open"
+            self._times.clear()
+        elif self.state == "half_open":
+            if self._times:
+                # the trial's window hasn't drained and the rule fired
+                # again: still flapping — re-open without executing
+                self.state = "open"
+                self._open_at = now
+                return False
+            self.state = "closed"      # trial survived a quiet window
+        if len(self._times) >= self.max_actions:
+            self.state = "open"
+            self._open_at = now
+            return False
+        self._times.append(now)
+        return True
+
+
+class RemediationDispatcher:
+    """The action handlers + rails; host-agnostic (needs only the coord
+    store and the job id), normally owned by the job's aggregator."""
+
+    ACTIONS = ("restart", "evict", "scale-out")
+
+    def __init__(self, store, job_id: str, incident_log=None,
+                 trace_provider=None, enabled: bool | None = None,
+                 cooldown_s: float | None = None,
+                 breaker_n: int | None = None,
+                 breaker_window_s: float | None = None,
+                 breaker_reset_s: float | None = None,
+                 scale_step: int | None = None, recent_cap: int = 64):
+        self.store = store
+        self.job_id = job_id
+        self.incidents = incident_log
+        self._trace_provider = trace_provider
+        self.enabled = (os.environ.get("EDL_TPU_REMEDIATE", "1") != "0"
+                        if enabled is None else bool(enabled))
+        self.cooldown_s = (env_float("EDL_TPU_REMEDIATE_COOLDOWN", 30.0)
+                           if cooldown_s is None else float(cooldown_s))
+        n = (int(env_float("EDL_TPU_REMEDIATE_BREAKER_N", 3))
+             if breaker_n is None else int(breaker_n))
+        window = (env_float("EDL_TPU_REMEDIATE_BREAKER_WINDOW", 300.0)
+                  if breaker_window_s is None else float(breaker_window_s))
+        reset = (env_float("EDL_TPU_REMEDIATE_BREAKER_RESET", 600.0)
+                 if breaker_reset_s is None else float(breaker_reset_s))
+        self._scale_step = (int(env_float("EDL_TPU_AUTOSCALE_STEP", 1))
+                            if scale_step is None else int(scale_step))
+        self._breakers = {a: CircuitBreaker(n, window, reset)
+                          for a in self.ACTIONS}
+        self._last: dict[tuple[str, str], float] = {}
+        self._recent: collections.deque[dict] = collections.deque(
+            maxlen=recent_cap)
+        self._lock = threading.Lock()
+
+    # -- engine integration --------------------------------------------------
+    def handlers(self) -> dict:
+        """``{action_name: handler}`` for RuleEngine(actions=...)."""
+        return {a: (lambda rule, group, value, _a=a:
+                    self.dispatch(_a, rule, group, value))
+                for a in self.ACTIONS}
+
+    def recent(self) -> list[dict]:
+        """The recent alert->action ring, oldest first (the
+        ``/alerts`` ``actions`` list; edl-obs-top renders it)."""
+        with self._lock:
+            return list(self._recent)
+
+    def breakers(self) -> dict[str, str]:
+        with self._lock:
+            return {a: b.state for a, b in self._breakers.items()}
+
+    # -- the dispatch path ---------------------------------------------------
+    def dispatch(self, action: str, rule, group: str, value: float,
+                 now: float | None = None) -> str:
+        """Rails, then the action; returns the outcome string the
+        engine counts.  Never raises past the engine's own catch."""
+        now = time.monotonic() if now is None else now
+        detail: dict = {}
+        if not self.enabled:
+            # dry-run observes ONLY: no rail state moves — a rehearsal
+            # must never trip the breaker (and page the operator with
+            # a critical alert) over actions that would not execute
+            try:
+                detail = self._plan(action, rule, group)
+            except Exception as e:  # noqa: BLE001 — a dry run must never fail
+                logger.debug("dry-run plan for %s failed: %s", action, e)
+            return self._record(action, rule, group, "dryrun", detail)
+        denied: tuple[str, bool] | None = None     # (outcome, incident?)
+        # rails under the lock; the audit write happens OUTSIDE it
+        # (incident records are file + store I/O)
+        with self._lock:
+            last = self._last.get((rule.name, action))
+            if last is not None and now - last < self.cooldown_s:
+                denied = ("cooldown", False)
+            else:
+                breaker = self._breakers[action]
+                before = breaker.state
+                allowed = breaker.allow(now)
+                self._breaker_transition(action, breaker, before)
+                if not allowed:
+                    denied = ("breaker_open", before != "open")
+                else:
+                    self._last[(rule.name, action)] = now
+        if denied is not None:
+            return self._record(action, rule, group, denied[0], detail,
+                                incident=denied[1])
+        try:
+            outcome, detail = self._execute(action, rule, group)
+        except Exception:  # noqa: BLE001 — engine counts "error"
+            self._record(action, rule, group, "error", detail)
+            raise
+        return self._record(action, rule, group, outcome, detail)
+
+    def _breaker_transition(self, action: str, breaker: CircuitBreaker,
+                            before: str) -> None:
+        """Gauge + log + trip counter on state changes (lock held)."""
+        if breaker.state == before:
+            return
+        _BREAKER_G.labels(action=action).set(
+            1.0 if breaker.state == "open" else 0.0)
+        if breaker.state == "open":
+            _BREAKER_TRIPS.labels(action=action).inc()
+            logger.error("remediation breaker OPEN for %r: %d actions "
+                         "inside %.0fs — a flapping rule is suppressed "
+                         "for %.0fs", action, breaker.max_actions,
+                         breaker.window_s, breaker.reset_s)
+        else:
+            logger.warning("remediation breaker for %r: %s -> %s", action,
+                           before, breaker.state)
+
+    def _record(self, action: str, rule, group: str, outcome: str,
+                detail: dict, incident: bool = True) -> str:
+        rec = {"ts": time.time(), "rule": rule.name, "action": action,
+               "group": group, "outcome": outcome}
+        if detail:
+            rec["detail"] = detail
+        with self._lock:
+            self._recent.append(rec)
+            breaker_state = self._breakers[action].state
+        rec["breaker"] = breaker_state
+        log = logger.info if outcome in ("ok", "noop") else logger.warning
+        log("remediation %s -> %s [%s]%s (breaker %s)", rule.name, action,
+            outcome, f" {detail}" if detail else "", breaker_state)
+        if incident and self.incidents is not None:
+            trace_id = None
+            if self._trace_provider is not None:
+                try:
+                    trace_id = self._trace_provider()
+                except Exception as e:  # noqa: BLE001 — audit is best-effort
+                    logger.debug("action trace lookup failed: %s", e)
+            try:
+                self.incidents.write_action(action, rule, group, outcome,
+                                            detail, trace_id=trace_id)
+            except Exception:  # noqa: BLE001 — audit must not stop actions
+                logger.exception("action incident record failed")
+        return outcome
+
+    # -- target resolution (shared by execute and dry-run) -------------------
+    def _cluster(self):
+        from edl_tpu.cluster.cluster import Cluster
+        return Cluster.load_from_store(self.store, self.job_id)
+
+    def _pod_of_instance(self, group: str) -> str | None:
+        """Map an alert group (a /metrics instance endpoint) to the pod
+        that advertised it (the ``pod`` advert extra)."""
+        if not group:
+            return None
+        from edl_tpu.obs import advert as obs_advert
+        for payload in obs_advert.list_metrics_targets(
+                self.store, self.job_id).values():
+            if str(payload.get("endpoint")) == group and payload.get("pod"):
+                return str(payload["pod"])
+        return None
+
+    def _stale_pods(self, cluster, window_s: float) -> list[str]:
+        """Cluster pods whose liveness beat exists and is stale — the
+        per-pod blame the summed trainer-hang signal can't assign.  The
+        trainer-published threshold wins; a pod that never published
+        one is judged against the alert rule's own window."""
+        from edl_tpu.cluster import heartbeat
+        stale = []
+        for pod_id in cluster.pod_ids():
+            try:
+                info = heartbeat.last_beat_info(self.store, self.job_id,
+                                                pod_id)
+            except Exception:  # noqa: BLE001 — a blip is not a hang
+                logger.debug("beat read failed for %s", pod_id,
+                             exc_info=True)
+                continue
+            if info is None:
+                continue
+            ts, published = info
+            threshold = heartbeat.stale_threshold(published) or window_s
+            # edl-lint: disable=clock — ts is the trainer's wall-clock
+            # beat read from the store; cross-process staleness can
+            # only be judged wall-to-wall (launcher._hung precedent)
+            if time.time() - ts > threshold:
+                stale.append(pod_id)
+        return stale
+
+    def _plan(self, action: str, rule, group: str) -> dict:
+        """Dry-run: what _execute would target, read-only."""
+        if action == "restart":
+            cluster = self._cluster()
+            if cluster is None:
+                return {"target": None}
+            mode = "targeted" if len(cluster.pods) == 1 else "coordinated"
+            return {"mode": mode, "pods": cluster.pod_ids(),
+                    "stage": cluster.stage,
+                    "stale": self._stale_pods(cluster, rule.window)}
+        if action == "evict":
+            return {"pod": self._pod_of_instance(group)}
+        if action == "scale-out":
+            from edl_tpu.gateway.fleet import list_replicas
+            live = len(list_replicas(self.store, self.job_id))
+            return {"replicas": live + self._scale_step}
+        return {}
+
+    # -- the actions ---------------------------------------------------------
+    def _execute(self, action: str, rule, group: str) -> tuple[str, dict]:
+        if action == "restart":
+            return self._act_restart(rule)
+        if action == "evict":
+            return self._act_evict(rule, group)
+        if action == "scale-out":
+            return self._act_scale_out(rule)
+        return "noop", {"error": f"unknown action {action!r}"}
+
+    def _act_restart(self, rule) -> tuple[str, dict]:
+        """trainer-hang: a SINGLE-pod job's trainers restart in place
+        via the per-pod flag (kill + respawn, no membership change).
+        A multi-pod job ALWAYS takes the coordinated hang flag — the
+        pods share one collective world, and killing one pod's
+        trainers unilaterally just crashes the peers with no
+        membership change to recover through (cluster/heartbeat.py's
+        invariant; the coordinated restart is one kill + instant
+        re-barrier at the unchanged stage).  The stale-beat pods still
+        ride the audit detail so the operator sees who was blamed."""
+        from edl_tpu.cluster import heartbeat
+        cluster = self._cluster()
+        if cluster is None or not cluster.pods:
+            return "noop", {"error": "no cluster record"}
+        if len(cluster.pods) == 1:
+            pod = cluster.pods[0].pod_id
+            heartbeat.flag_pod_restart(self.store, self.job_id,
+                                       cluster.stage, pod, reason=rule.name)
+            return "ok", {"mode": "targeted", "pods": [pod],
+                          "stage": cluster.stage}
+        heartbeat.flag_hang(self.store, self.job_id, cluster.stage,
+                            f"remediation:{rule.name}")
+        return "ok", {"mode": "coordinated", "stage": cluster.stage,
+                      "stale": self._stale_pods(cluster, rule.window)}
+
+    def _act_evict(self, rule, group: str) -> tuple[str, dict]:
+        """trainer-straggler: the slow pod leaves through the
+        preemption-grace path, reason ``straggler-evict``."""
+        from edl_tpu.cluster import preempt, scale
+        pod_id = self._pod_of_instance(group)
+        if pod_id is None:
+            return "noop", {"error": f"no pod advert for group {group!r}"}
+        cluster = self._cluster()
+        if cluster is None or cluster.get_pod(pod_id) is None:
+            return "noop", {"error": f"pod {pod_id[:8]} not in the cluster"}
+        rng = scale.load_nodes_range(self.store, self.job_id)
+        min_nodes = rng[0] if rng else 1
+        if len(cluster.pods) - 1 < max(1, min_nodes):
+            # the rail: healing must not starve the job below its floor
+            return "no_capacity", {"pod": pod_id,
+                                   "min_nodes": max(1, min_nodes)}
+        preempt.flag_preempt(self.store, self.job_id, cluster.stage, pod_id,
+                             reason="straggler-evict")
+        return "ok", {"pod": pod_id, "stage": cluster.stage,
+                      "reason": "straggler-evict"}
+
+    def _act_scale_out(self, rule) -> tuple[str, dict]:
+        """gateway SLO burn: ask the controller for one more serving
+        replica via the demand record (the controller's autoscaler
+        clamps to the job's nodes_range and scales back on quiet)."""
+        from edl_tpu.cluster import scale
+        from edl_tpu.gateway.fleet import list_replicas
+        live = len(list_replicas(self.store, self.job_id))
+        rng = scale.load_nodes_range(self.store, self.job_id)
+        want = live + self._scale_step
+        if rng is not None and want > rng[1]:
+            want = rng[1]
+        if want <= live:
+            return "noop", {"replicas": live, "error": "already at max"}
+        scale.save_demand(self.store, self.job_id, want, reason=rule.name)
+        return "ok", {"replicas": want, "from": live}

@@ -261,8 +261,19 @@ class Gateway:
 
         def load(rid: str):
             p = cands[rid]
+            # primary: least loaded (advert stats corrected by our own
+            # leg counts).  Among otherwise-comparable replicas, prefer
+            # the warmer paged-KV cache: a higher advertised prefix hit
+            # rate, then more free KV blocks — a request landing on a
+            # warm replica skips most of its prefill (serving/kv_cache)
+            try:
+                kv_hit = -float(p.get("kv_prefix_hit_rate") or 0.0)
+                kv_free = -int(p.get("kv_blocks_free") or 0)
+            except (TypeError, ValueError):
+                kv_hit, kv_free = 0.0, 0
             return (int(p.get("queue_depth", 0)) + inflight.get(rid, 0)
-                    - int(p.get("free_slots", 0)), inflight.get(rid, 0), rid)
+                    - int(p.get("free_slots", 0)), inflight.get(rid, 0),
+                    kv_hit, kv_free, rid)
 
         rid = min(cands, key=load)
         return rid, cands[rid]

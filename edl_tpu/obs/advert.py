@@ -43,12 +43,18 @@ def _prefix(job_id: str) -> str:
 def advertise_metrics(store, job_id: str, component: str, endpoint: str,
                       name: str | None = None,
                       ttl: float = constants.ETCD_TTL,
-                      session: CoordSession | None = None):
+                      session: CoordSession | None = None,
+                      extra: dict | None = None):
     """TTL-leased /metrics advert; returns a handle to ``stop()``.
-    With ``session`` the advert rides that shared self-healing lease."""
+    With ``session`` the advert rides that shared self-healing lease.
+    ``extra`` fields ride the payload — trainers/launchers publish
+    ``{"pod": <pod_id>}`` so alert groups (instance endpoints) map back
+    to the pod a remediation action must target."""
     name = name or f"{component}-{os.getpid()}"
     payload = {"endpoint": endpoint, "component": component,
                "pid": os.getpid(), "ts": time.time()}
+    if extra:
+        payload.update(extra)
     return leased_register(
         store, paths.key(job_id, constants.ETCD_OBS, f"metrics/{name}"),
         json.dumps(payload).encode(), ttl=ttl, session=session)
@@ -105,7 +111,8 @@ def current_job_trace(store, job_id: str) -> dict | None:
 
 def advertise_installed(store, job_id: str, component: str,
                         ttl: float = constants.ETCD_TTL,
-                        session: CoordSession | None = None
+                        session: CoordSession | None = None,
+                        extra: dict | None = None
                         ) -> Register | SessionKey | None:
     """Advertise this process's env-gated /metrics endpoint (if one is
     serving) in the coord store.  Best-effort, never raises."""
@@ -116,7 +123,7 @@ def advertise_installed(store, job_id: str, component: str,
         return None
     try:
         return advertise_metrics(store, job_id, component, srv.endpoint,
-                                 ttl=ttl, session=session)
+                                 ttl=ttl, session=session, extra=extra)
     except Exception:  # noqa: BLE001 — metrics must never fail a job
         logger.exception("metrics advert failed for %s", component)
         return None

@@ -204,19 +204,29 @@ class Aggregator:
         # into the TSDB, so the goodput-regression rule sees it
         self.goodput = obs_goodput.GoodputLedger()
         # alert action hooks: "profile" captures a profiler trace on
-        # the alerting instance.  Read-only hosts (edl-obs-top's
-        # embedded aggregator) disable actions; EDL_TPU_PROFILE_ON_ALERT=0
-        # turns the capture action off fleet-wide
+        # the alerting instance; "restart"/"evict"/"scale-out" are the
+        # remediation dispatcher's actuators (controller/remediate.py,
+        # behind cooldowns + a circuit breaker; EDL_TPU_REMEDIATE=0
+        # observes-only).  Read-only hosts (edl-obs-top's embedded
+        # aggregator) disable actions entirely; EDL_TPU_PROFILE_ON_ALERT=0
+        # turns just the capture action off fleet-wide
+        incident_log = obs_rules.IncidentLog(incident_dir, "obs-agg", job_id)
         actions = None
-        if (enable_actions
-                and os.environ.get("EDL_TPU_PROFILE_ON_ALERT", "1") != "0"):
-            actions = {"profile": self._profile_action}
+        self.remediator = None
+        if enable_actions:
+            actions = {}
+            if os.environ.get("EDL_TPU_PROFILE_ON_ALERT", "1") != "0":
+                actions["profile"] = self._profile_action
+            from edl_tpu.controller.remediate import RemediationDispatcher
+            self.remediator = RemediationDispatcher(
+                store, job_id, incident_log=incident_log,
+                trace_provider=self._job_trace_id)
+            actions.update(self.remediator.handlers())
         self._action_last: dict[str, float] = {}
         self.engine = obs_rules.RuleEngine(
             self.tsdb,
             obs_rules.load_rules() if rules is None else rules,
-            incident_log=obs_rules.IncidentLog(incident_dir, "obs-agg",
-                                               job_id),
+            incident_log=incident_log,
             trace_provider=self._job_trace_id, actions=actions)
         self._lock = threading.Lock()
         # single-flight gate for the scrape fan-out: collect() holds it
@@ -479,6 +489,16 @@ class Aggregator:
         threading.Thread(target=run, daemon=True,
                          name=f"edl-profile-action:{rule.name}").start()
 
+    def alerts_json(self) -> dict:
+        """The ``/alerts`` body: the rule engine's state plus the
+        remediation dispatcher's recent alert->action outcomes and
+        per-action breaker states (the edl-obs-top actions pane)."""
+        body = self.engine.to_json()
+        if self.remediator is not None:
+            body["actions"] = self.remediator.recent()
+            body["breakers"] = self.remediator.breakers()
+        return body
+
     def _recovery_summary(self):
         """``summarize_recovery`` behind a cache + a scoped deadline:
         /healthz is a health probe — a slow coord store must cost it at
@@ -627,7 +647,7 @@ class AggregatorServer:
                                 .encode("utf-8"))
                         ctype = "application/json"
                     elif path == "/alerts":
-                        body = (json.dumps(agg.engine.to_json())
+                        body = (json.dumps(agg.alerts_json())
                                 .encode("utf-8"))
                         ctype = "application/json"
                     elif path == "/profile":

@@ -79,6 +79,8 @@ def render_report(report: dict) -> str:
                 else "")
         lines.append(f"  resize {s['stage']} @ {s['detect_at']:.3f}"
                      f"{done}{mode}{src}")
+        for pod, reason in sorted(s.get("evicted", {}).items()):
+            lines.append(f"    evicted {pod[:12]:<20} reason={reason}")
         for phase in PHASE_ORDER:
             if phase in s:
                 lines.append(f"    {phase:<24} {s[phase]:>9.3f}s")

@@ -66,7 +66,9 @@ _RECORDED_G = obs_metrics.gauge(
 _ACTIONS_TOTAL = obs_metrics.counter(
     "edl_alert_actions_total",
     "Alert action hooks invoked on firing transitions, by action and "
-    "outcome (ok / error / no_handler)", ("action", "outcome"))
+    "outcome (ok / noop / error / no_handler, plus the remediation "
+    "rails' cooldown / breaker_open / dryrun / no_capacity)",
+    ("action", "outcome"))
 
 KINDS = ("gauge", "rate", "stalled", "quantile", "outlier")
 _OPS = {">": lambda v, t: v > t, "<": lambda v, t: v < t,
@@ -102,11 +104,19 @@ class Rule:
     labels: dict = dataclasses.field(default_factory=dict)
     summary: str = ""
     record: str | None = None
-    # action hook: the name of a handler the engine host registered
-    # (RuleEngine ``actions=``) to run on each FIRING transition —
-    # "profile" asks the aggregator to capture a profiler trace on the
-    # alerting instance (the first alert->action plumbing, ROADMAP 4)
+    # action hook(s): comma-separated names of handlers the engine host
+    # registered (RuleEngine ``actions=``) to run on each FIRING
+    # transition — "profile" captures a profiler trace on the alerting
+    # instance (PR 12); "restart"/"evict"/"scale-out" are the
+    # remediation dispatcher's actuators (controller/remediate.py).
+    # A handler's string return value is its OUTCOME (counted into
+    # edl_alert_actions_total); None/empty reads as "ok".
     action: str | None = None
+
+    def action_names(self) -> list[str]:
+        if not self.action:
+            return []
+        return [a.strip() for a in str(self.action).split(",") if a.strip()]
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -192,13 +202,14 @@ def builtin_rules() -> list[Rule]:
              metric="edl_train_step_seconds_count",
              match={"component": "trainer"}, op="<=", threshold=0.0,
              window=60.0 * s, for_s=15.0 * s, severity="critical",
+             action="restart",
              summary="no train-step progress across live trainer targets",
              record="trainer_steps_per_s"),
         Rule("trainer-straggler", kind="outlier",
              metric="edl_train_step_seconds",
              match={"component": "trainer"}, by="instance",
              op=">", threshold=2.0, window=60.0 * s, for_s=30.0 * s,
-             min_series=3, severity="warning", action="profile",
+             min_series=3, severity="warning", action="profile,evict",
              summary="pod step latency > 2x the fleet median"),
         Rule("data-starvation", kind="rate",
              metric="edl_data_spans_requeued_total",
@@ -224,13 +235,13 @@ def builtin_rules() -> list[Rule]:
         Rule("gateway-p99-slo", kind="quantile",
              metric="edl_gateway_request_seconds", q=0.99,
              op=">", threshold=p99_slo, window=120.0 * s, for_s=30.0 * s,
-             severity="critical", action="profile",
+             severity="critical", action="profile,scale-out",
              summary="gateway p99 over the latency SLO",
              record="gateway_p99_s"),
         Rule("gateway-reject-burn", kind="rate",
              metric="edl_gateway_rejects_total",
              op=">", threshold=1.0, window=60.0 * s, for_s=15.0 * s,
-             severity="warning",
+             severity="warning", action="scale-out",
              summary="sustained admission rejects: the fleet is saturated"),
         Rule("hang-restarts", kind="rate",
              metric="edl_hang_restarts_total",
@@ -248,6 +259,17 @@ def builtin_rules() -> list[Rule]:
              severity="warning",
              summary="the job is spending most of its wall-clock on "
                      "resizes/restores/hangs/idle instead of training"),
+        # the remediation dispatcher's breaker gauge rides the
+        # aggregator's own registry onto the merged page, so a tripped
+        # breaker (a flapping rule being suppressed) alerts like any
+        # other signal instead of failing silent
+        Rule("remediation-breaker-open", kind="gauge",
+             metric="edl_remediation_breaker_open", by="action",
+             op=">", threshold=0.5, window=120.0 * s,
+             severity="critical",
+             summary="a remediation action's circuit breaker is OPEN: "
+                     "a flapping rule is being suppressed; the job is "
+                     "NOT self-healing until it half-opens"),
     ]
 
 
@@ -334,6 +356,36 @@ class IncidentLog:
         if trace_id:
             rec["trace_id"] = trace_id
         _INCIDENTS_TOTAL.labels(state=state).inc()
+        self._append(rec)
+        return rec
+
+    def write_action(self, action: str, rule, group: str, outcome: str,
+                     detail: dict | None = None,
+                     trace_id: str | None = None,
+                     at: float | None = None) -> dict:
+        """A remediation-action audit record (``action/<name>``): the
+        alert that triggered it, the outcome the rails produced, and
+        the generation trace it belongs to — durable next to the
+        alert's own incident record, so ``edl-obs-dump --merge`` shows
+        the full alert -> action -> recovery handoff on one timeline."""
+        rec = {"ts": round(time.time() if at is None else at, 6),
+               "name": f"action/{action}",
+               "component": self.component,
+               "state": outcome, "rule": rule.name,
+               "severity": getattr(rule, "severity", "info")}
+        if self.job_id:
+            rec["job"] = self.job_id
+        if group:
+            rec["group"] = group
+        if detail:
+            rec["detail"] = detail
+        if trace_id:
+            rec["trace_id"] = trace_id
+        _INCIDENTS_TOTAL.labels(state=f"action_{outcome}").inc()
+        self._append(rec)
+        return rec
+
+    def _append(self, rec: dict) -> None:
         wrote = False
         if self.path:
             try:
@@ -352,7 +404,6 @@ class IncidentLog:
             obs_trace.emit(rec["name"],
                            **{k: v for k, v in rec.items()
                               if k not in ("ts", "name")})
-        return rec
 
 
 class _AlertState:
@@ -465,22 +516,27 @@ class RuleEngine:
         return firing
 
     def _run_action(self, rule: Rule, group: str, value: float) -> None:
-        """Invoke the rule's action hook on a firing transition —
+        """Invoke the rule's action hook(s) on a firing transition —
         OUTSIDE the engine lock (handlers do network I/O: the profile
-        action GETs the target's /profile endpoint).  Failures are
-        counted and logged; an action can never take down alerting."""
-        handler = self.actions.get(rule.action)
-        if handler is None:
-            _ACTIONS_TOTAL.labels(action=rule.action,
-                                  outcome="no_handler").inc()
-            return
-        try:
-            handler(rule, group, value)
-            _ACTIONS_TOTAL.labels(action=rule.action, outcome="ok").inc()
-        except Exception:  # noqa: BLE001 — an action must not stop alerting
-            logger.exception("alert action %s for rule %s failed",
-                             rule.action, rule.name)
-            _ACTIONS_TOTAL.labels(action=rule.action, outcome="error").inc()
+        action GETs the target's /profile endpoint, the remediation
+        actions write store flags).  A handler's string return value is
+        its outcome; failures are counted and logged; an action can
+        never take down alerting."""
+        for name in rule.action_names():
+            handler = self.actions.get(name)
+            if handler is None:
+                _ACTIONS_TOTAL.labels(action=name,
+                                      outcome="no_handler").inc()
+                continue
+            try:
+                outcome = handler(rule, group, value)
+                _ACTIONS_TOTAL.labels(
+                    action=name,
+                    outcome=str(outcome) if outcome else "ok").inc()
+            except Exception:  # noqa: BLE001 — an action must not stop alerting
+                logger.exception("alert action %s for rule %s failed",
+                                 name, rule.name)
+                _ACTIONS_TOTAL.labels(action=name, outcome="error").inc()
 
     def _resolve(self, rule: Rule, group: str, st: _AlertState,
                  transitions: list) -> None:

@@ -120,6 +120,22 @@ def render_top(health: dict, alerts: dict | None = None,
         pending = (alerts or {}).get("pending", [])
         lines.append(f"  alerts: none firing"
                      f"{f', {len(pending)} pending' if pending else ''}")
+    # recent alert->action outcomes (the remediation dispatcher's audit
+    # ring, served on /alerts) + any non-closed circuit breaker
+    actions = (alerts or {}).get("actions") or []
+    breakers = {a: s for a, s in ((alerts or {}).get("breakers")
+                                  or {}).items() if s != "closed"}
+    if actions or breakers:
+        suffix = ("  breakers: " + " ".join(
+            f"{a}={s}" for a, s in sorted(breakers.items()))
+            if breakers else "")
+        lines.append(f"  recent actions ({len(actions)}):{suffix}")
+        for a in list(actions)[-8:][::-1]:         # newest first
+            when = time.strftime("%H:%M:%S", time.localtime(a.get("ts", 0)))
+            grp = f"  {a['group']}" if a.get("group") else ""
+            lines.append(f"    {when} {a.get('rule', '?')} -> "
+                         f"{a.get('action', '?')} [{a.get('outcome', '?')}]"
+                         f"{grp}")
     return "\n".join(lines)
 
 
@@ -167,7 +183,7 @@ def main(argv: list[str] | None = None) -> int:
     def frame() -> str:
         if agg is not None:
             agg.scrape_once()
-            return render_top(agg.job_summary(), agg.engine.to_json())
+            return render_top(agg.job_summary(), agg.alerts_json())
         base = f"http://{args.endpoint}"
         health = _fetch_json(base + "/healthz", timeout=10)
         try:

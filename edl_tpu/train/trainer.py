@@ -182,7 +182,7 @@ class ElasticTrainer:
         if store is not None and tenv is not None and tenv.pod_id:
             from edl_tpu.obs import advert as obs_advert
             self._obs_register = obs_advert.advertise_installed(
-                store, tenv.job_id, "trainer")
+                store, tenv.job_id, "trainer", extra={"pod": tenv.pod_id})
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
         self.rules = self.cfg.rules
         self.adjust = AdjustRegistry()
@@ -975,7 +975,23 @@ class ElasticTrainer:
                            "unwinding into a live reshard")
             raise _LiveReshard(_ReshardPayload(mode="shrink",
                                                local=survive, step=step))
-        logger.warning("preempt: exiting %d", _c.PREEMPT_EXIT_CODE)
+        # the workerlog must say WHY this pod died: its own per-pod
+        # preempt record carries the eviction reason (sigterm /
+        # descale / priority-yield / straggler-evict); a pod exiting on
+        # a PEER's preemption agreement has no record of its own
+        reason = "peer-preempt"
+        if self.store is not None:
+            try:
+                from edl_tpu.cluster import preempt
+                info = preempt.pod_preempt_info(
+                    self.store, self.tenv.job_id, self.tenv.cluster_stage,
+                    self.tenv.pod_id)
+                if info is not None:
+                    reason = info[1]
+            except Exception as e:  # noqa: BLE001 — reason is best-effort
+                logger.debug("preempt reason read failed: %s", e)
+        logger.warning("preempt: exiting %d (reason=%s)",
+                       _c.PREEMPT_EXIT_CODE, reason)
         # os._exit, NOT SystemExit: normal teardown runs jax's atexit
         # distributed shutdown, whose barrier hangs the coordinator-
         # hosting rank once a peer (exiting by the same agreement, a

@@ -59,6 +59,17 @@ def _f(env: str, default: float) -> float:
     return float(_os.environ.get(env, default))
 
 
+def env_float(env: str, default: float) -> float:
+    """Runtime (not import-time) env float with a tolerant fallback: a
+    malformed value reads as the default instead of raising — for
+    knobs read lazily inside long-lived services (controller
+    autoscaler/remediation), where one typo must not kill the loop."""
+    try:
+        return float(_os.environ.get(env, default))
+    except ValueError:
+        return default
+
+
 ETCD_TTL = _f("EDL_TPU_TTL", 15)                  # registration lease TTL (s)
 TTL_REFRESH_FRACTION = 0.5                        # refresh at ttl/2
 GENERATOR_PERIOD = _f("EDL_TPU_GENERATOR_PERIOD", 3.0)

@@ -113,6 +113,20 @@ JAX_PLATFORMS=cpu python scripts/alerts_smoke.py
 # Perfetto counter tracks alongside the span rows
 JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
 
+# remediation smoke: the self-driving cluster loop — three job kinds
+# (real launchers + instrumented trainers, a gang distill pod, a
+# fake-engine replica fleet behind a real gateway) arbitrated by ONE
+# controller, with the alert->action dispatcher armed: a straggler is
+# evicted through the preemption-grace path (workerlog + recovery
+# record carry reason=straggler-evict), a wedged trainer is healed by
+# a TARGETED in-place restart (launcher pid + cluster stage unchanged,
+# healthy jobs untouched), a gateway load spike fires reject-burn and
+# scales the replica fleet out with zero lost accepted requests,
+# serving demand makes training yield a pod (reason=priority-yield)
+# and reclaim it on quiet, and the per-job incident logs show every
+# alert -> action -> recovery handoff
+JAX_PLATFORMS=cpu python scripts/remediation_smoke.py
+
 # transfer smoke: the streaming data plane's microbench (loopback,
 # small payload, subprocess holders) — pipelined/striped fetch must not
 # regress below the serial baseline, and the MiB/s numbers land in the

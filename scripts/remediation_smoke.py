@@ -1,0 +1,489 @@
+"""CI smoke: the self-driving cluster loop end to end (ISSUE 15).
+
+THREE job kinds on one capacity pool, arbitrated by one in-process
+Controller against an in-process coordination server, each job watched
+by a real Aggregator scrape loop running the BUILT-IN ruleset (windows
+shrunk via ``EDL_TPU_ALERT_SCALE``) with the remediation dispatcher
+armed:
+
+- **train** — three REAL launcher processes (``edl_tpu.collective
+  .launch``) running the instrumented inert trainer
+  (tests/helpers/metrics_trainer.py: live step histogram + heartbeat
+  + preempt-flag compliance), one pod 6x slower than the fleet;
+- **distill** — one launcher pod (gang spec), whose trainer can be
+  wedged through a stall file (steps AND beats stop, process alive);
+- **svc** — fake-engine replica processes behind a real in-process
+  Gateway with a tight admission rate.
+
+The proof, phase by phase:
+
+1. **arbitration baseline** — the controller reconciles all three
+   kinds without flapping anyone;
+2. **straggler -> evict** — the builtin ``trainer-straggler`` rule
+   fires on the slow pod's instance; the dispatcher evicts it through
+   the preemption-grace path; the pod's workerlog says WHY it died
+   (``reason=straggler-evict``), the survivors' recovery record
+   carries the eviction reason, and the job keeps running;
+3. **hang -> targeted restart** — the distill trainer wedges; the
+   ``trainer-hang`` rule fires; the dispatcher's restart flag respawns
+   the pod's trainers IN PLACE: launcher pid unchanged, cluster stage
+   unchanged — no stop-resume touches any healthy pod;
+4. **gateway spike -> scale-out** — a load spike over the admission
+   rate fires ``gateway-reject-burn``; the dispatcher writes a demand
+   record; the controller scales the replica fleet out (visible in
+   the advert table) and EVERY accepted request completes (zero lost);
+5. **priority yield + reclaim** — serving demand squeezes the
+   training job, which yields a pod through the graceful-preemption
+   path (``reason=priority-yield``); when the demand decays on quiet
+   the autoscaler scales the fleet back in and training RECLAIMS the
+   chips (the controller's actuator spawns replacement launchers);
+6. **audit** — the per-job incident logs show each
+   alert -> action -> recovery handoff.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/remediation_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_TMP = tempfile.mkdtemp(prefix="edl-remed-")
+os.environ.setdefault("EDL_TPU_TRACE_DIR", os.path.join(_TMP, "trace"))
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+os.environ.setdefault("EDL_TPU_ALERT_SCALE", "0.1")
+os.environ.setdefault("EDL_TPU_REMEDIATE_COOLDOWN", "2")
+os.environ.setdefault("EDL_TPU_AUTOSCALE_QUIET", "8")
+os.environ.setdefault("EDL_TPU_DEMAND_TTL", "30")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+_TRAINER = os.path.join(_REPO, "tests", "helpers", "metrics_trainer.py")
+
+FAST = {
+    "EDL_TPU_TTL": "1",
+    "EDL_TPU_GENERATOR_PERIOD": "0.2",
+    "EDL_TPU_WATCHER_PERIOD": "0.2",
+    "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+    "EDL_TPU_BARRIER_TIMEOUT": "60",
+    "EDL_TPU_RESIZE_BARRIER_TIMEOUT": "30",
+    # the launchers' OWN hang watchdog is OFF: the smoke proves the
+    # ALERT loop (aggregator rule -> dispatcher -> per-pod flag) does
+    # the healing, not the local heartbeat threshold
+    "EDL_TPU_HANG_TIMEOUT": "-1",
+}
+
+_REPLICA_CHILD = r"""
+import signal, sys, threading, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from concurrent.futures import Future
+from edl_tpu.coord.client import connect
+from edl_tpu.serving.replica import ReplicaServer
+
+class FakeEngine:
+    slots = 8
+    def submit(self, ids, max_new, session=None):
+        fut = Future()
+        def run():
+            time.sleep(0.02)
+            fut.set_result(np.arange(max_new, dtype=np.int32) + int(ids[0]))
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+    def stats(self):
+        return {{"slots": 8, "active_slots": 0, "queue_depth": 0,
+                 "prefill_stall_s": 0.0, "tokens_per_s": 100.0,
+                 "max_prompt_len": 63, "draining": False}}
+    def drain(self, timeout=None):
+        return True
+    def stop(self):
+        pass
+
+coord_ep, rid = sys.argv[1], sys.argv[2]
+store = connect(coord_ep)
+srv = ReplicaServer(store, "svc", FakeEngine(), replica_id=rid,
+                    host="127.0.0.1", ttl=2.0, advert_period=0.25,
+                    migrate_sessions=False)
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *_: stop.set())
+print("replica up", rid, flush=True)
+stop.wait()
+srv.stop()
+"""
+
+
+def _wait(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:  # noqa: BLE001 — condition may race a restart
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def _read_incidents(job_dir):
+    out = []
+    if not os.path.isdir(job_dir):
+        return out
+    for name in os.listdir(job_dir):
+        if not name.startswith("incidents-"):
+            continue
+        with open(os.path.join(job_dir, name), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    return out
+
+
+def _has(incidents, name, state=None):
+    return any(r.get("name") == name
+               and (state is None or r.get("state") == state)
+               for r in incidents)
+
+
+def _grep_logs(root, needle):
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            try:
+                with open(p, errors="replace") as f:
+                    if needle in f.read():
+                        return p
+            except OSError:
+                continue
+    return None
+
+
+class Pool:
+    """The out-of-band actuator: spawn/kill launcher + replica
+    processes to match the controller's desired sizes."""
+
+    def __init__(self, coord_ep, tmp):
+        self.coord_ep = coord_ep
+        self.tmp = tmp
+        self.launchers = {}      # name -> Popen
+        self.replicas = {}       # rid -> Popen
+        self._n = 0
+
+    def spawn_launcher(self, job, name, nodes_range, extra_env=None):
+        env = dict(os.environ)
+        env.update(FAST)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["EDL_TPU_DEMO_MARKER"] = os.path.join(self.tmp,
+                                                  f"marker-{job}.txt")
+        env.update(extra_env or {})
+        log = open(os.path.join(self.tmp, f"launcher-{job}-{name}.log"),
+                   "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.collective.launch",
+             "--job_id", job, "--coord_endpoints", self.coord_ep,
+             "--nodes_range", nodes_range, "--nproc_per_node", "1",
+             "--log_dir", os.path.join(self.tmp, f"log-{job}-{name}"),
+             _TRAINER],
+            env=env, cwd=self.tmp, stdout=log, stderr=subprocess.STDOUT)
+        proc._logfile = log  # noqa: SLF001
+        self.launchers[f"{job}-{name}"] = proc
+        return proc
+
+    def spawn_replica(self, rid):
+        env = dict(os.environ, EDL_TPU_METRICS_PORT="")
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c",
+             _REPLICA_CHILD.format(repo=_REPO), self.coord_ep, rid],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "replica up" in line:
+                self.replicas[rid] = proc
+                return proc
+            if not line and proc.poll() is not None:
+                raise AssertionError(f"replica {rid} died before announcing")
+        raise AssertionError(f"replica {rid} never announced")
+
+    def alive_launchers(self, job):
+        return [n for n, p in self.launchers.items()
+                if n.startswith(job + "-") and p.poll() is None]
+
+    def alive_replicas(self):
+        return [r for r, p in self.replicas.items() if p.poll() is None]
+
+    # the controller's Actuator surface
+    def scale(self, job_id, replicas):
+        if job_id == "svc":
+            live = self.alive_replicas()
+            for i in range(len(live), replicas):
+                self._n += 1
+                self.spawn_replica(f"r{self._n}")
+            for rid in live[replicas:]:
+                self.replicas[rid].send_signal(signal.SIGTERM)
+        elif job_id == "train":
+            live = self.alive_launchers("train")
+            for i in range(len(live), replicas):
+                self._n += 1
+                self.spawn_launcher("train", f"re{self._n}", "1:3",
+                                    {"EDL_TPU_SMOKE_STEP_S": "0.05"})
+        return True
+
+    def kill_all(self):
+        for p in list(self.launchers.values()) + list(self.replicas.values()):
+            if p.poll() is None:
+                p.kill()
+        for p in self.launchers.values():
+            try:
+                p._logfile.close()  # noqa: SLF001
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+def main() -> None:
+    from edl_tpu import obs
+    from edl_tpu.cluster import scale as scale_mod
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.recovery import summarize_recovery
+    from edl_tpu.coord.client import connect
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.controller import Controller
+    from edl_tpu.gateway import Gateway, GatewayConfig
+    from edl_tpu.gateway.fleet import list_replicas
+    from edl_tpu.obs import advert as obs_advert
+    from edl_tpu.obs.agg import Aggregator, AggregatorServer
+    from edl_tpu.utils.exceptions import EdlOverloadedError
+
+    obs.install_from_env("gateway")
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = connect(coord_ep)
+    pool = Pool(coord_ep, _TMP)
+    inc_dir = {j: os.path.join(_TMP, "incidents", j)
+               for j in ("train", "distill", "svc")}
+    stall_file = os.path.join(_TMP, "stall-distill")
+
+    aggs, agg_srv, gw, ctl = [], None, None, None
+    try:
+        # -- boot the three job kinds ------------------------------------
+        scale_mod.save_job_spec(store, "train", kind="training")
+        scale_mod.save_job_spec(store, "distill", kind="distill", gang=True)
+        scale_mod.save_job_spec(store, "svc", kind="serving")
+        scale_mod.save_nodes_range(store, "svc", 1, 4)
+        for name, step in (("a", "0.05"), ("b", "0.05"), ("c", "0.3")):
+            pool.spawn_launcher("train", name, "1:3",
+                                {"EDL_TPU_SMOKE_STEP_S": step})
+        pool.spawn_launcher("distill", "d0", "1:1",
+                            {"EDL_TPU_SMOKE_STEP_S": "0.05",
+                             "EDL_TPU_SMOKE_STALL_FILE": stall_file})
+        pool.spawn_replica("r0")
+        pool.spawn_replica("r1")
+        obs_advert.advertise_installed(store, "svc", "gateway")
+
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) == 3, 60, "train cluster of 3")
+        _wait(lambda: Cluster.load_from_store(store, "distill") is not None,
+              60, "distill cluster")
+        _wait(lambda: len(list_replicas(store, "svc")) == 2, 30,
+              "2 replica adverts")
+
+        # one aggregator + armed dispatcher per job (the svc one behind
+        # HTTP so /alerts carries the recent-actions audit)
+        for job in ("train", "distill"):
+            agg = Aggregator(store, job, cache_s=0.0, scrape_interval=0.25,
+                             incident_dir=inc_dir[job])
+            agg.start_loop()
+            aggs.append(agg)
+        agg_srv = AggregatorServer(store, "svc", host="127.0.0.1",
+                                   cache_s=0.0, scrape_interval=0.25,
+                                   incident_dir=inc_dir["svc"]).start()
+
+        gw = Gateway(store, "svc", GatewayConfig(
+            max_inflight=8, max_queue=16, rate=4.0, burst=4.0,
+            request_timeout_s=30.0, wait_slice_s=0.05, poll_period_s=0.1))
+
+        ctl = Controller(store, capacity=6, max_load_desired=1.0,
+                         actuator=pool, cooldown=1.0,
+                         cooldown_per_resize_s=0.0,
+                         preempt_grace_s=30.0, period=0.5,
+                         alerts_url=f"http://{agg_srv.endpoint}/alerts")
+        assert sorted(ctl.discover_jobs()) == ["distill", "svc", "train"] \
+            or set(ctl.discover_jobs()) == {"train", "distill", "svc"}
+        ctl.start()
+
+        # -- 1: arbitration baseline — nobody flaps ----------------------
+        time.sleep(3.0)
+        assert len(Cluster.load_from_store(store, "train").pods) == 3
+        assert len(pool.alive_replicas()) == 2
+        print("smoke 1: three job kinds under one controller, "
+              "baseline stable (train=3 distill=1 svc=2 of capacity 6)")
+
+        # -- 2: straggler -> evict through the preemption path -----------
+        _wait(lambda: _has(_read_incidents(inc_dir["train"]),
+                           "alert/trainer-straggler", "firing"),
+              90, "trainer-straggler to fire on the slow pod")
+        _wait(lambda: _has(_read_incidents(inc_dir["train"]),
+                           "action/evict", "ok"),
+              30, "the evict action to run")
+        # the slow launcher (train-c) departs DESCALED (exit 0) — not a
+        # crash; the controller is free to RECLAIM the freed slot with a
+        # replacement pod afterwards, so pod count is not the signal
+        _wait(lambda: pool.launchers["train-c"].poll() == 0, 90,
+              "the evicted launcher to exit 0 (DESCALED, not a crash)")
+        _wait(lambda: _grep_logs(_TMP, "reason=straggler-evict") is not None,
+              30, "the evicted pod's workerlog to carry the reason")
+        _wait(lambda: any(s.get("evicted")
+                          and "straggler-evict" in s["evicted"].values()
+                          for s in summarize_recovery(store, "train")),
+              30, "the recovery record to carry the eviction reason")
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) >= 2, 60,
+              "the surviving train pods to keep running")
+        print("smoke 2: straggler evicted via preemption grace "
+              "(workerlog + recovery record carry reason=straggler-evict), "
+              "survivors kept training")
+
+        # -- 3: hang -> targeted in-place restart ------------------------
+        d_launcher = pool.launchers["distill-d0"]
+        d_pid = d_launcher.pid
+        d_stage = Cluster.load_from_store(store, "distill").stage
+        # cross-job blast radius: every train launcher alive NOW must
+        # still be alive after the distill job heals
+        train_alive = [pool.launchers[n] for n in
+                       pool.alive_launchers("train")]
+        marker = os.path.join(_TMP, "marker-distill.txt")
+        starts_before = sum(1 for _ in open(marker))
+        with open(stall_file, "w") as f:
+            f.write("wedged\n")
+        _wait(lambda: _has(_read_incidents(inc_dir["distill"]),
+                           "alert/trainer-hang", "firing"),
+              90, "trainer-hang to fire on the wedged distill trainer")
+        _wait(lambda: _has(_read_incidents(inc_dir["distill"]),
+                           "action/restart", "ok"),
+              30, "the restart action to run")
+        os.remove(stall_file)
+        _wait(lambda: sum(1 for _ in open(marker)) > starts_before, 60,
+              "the distill trainer to be respawned in place")
+        assert d_launcher.poll() is None and d_launcher.pid == d_pid, \
+            "the launcher process must survive a targeted restart"
+        assert Cluster.load_from_store(store, "distill").stage == d_stage, \
+            "a targeted restart must not change the cluster stage"
+        assert all(p.poll() is None for p in train_alive), \
+            "a distill restart must not touch the healthy train job"
+        _wait(lambda: _has(_read_incidents(inc_dir["distill"]),
+                           "alert/trainer-hang", "resolved"),
+              60, "trainer-hang to resolve after the restart")
+        rec = [r for r in _read_incidents(inc_dir["distill"])
+               if r["name"] == "action/restart" and r["state"] == "ok"]
+        assert rec and rec[0].get("detail", {}).get("mode") == "targeted", rec
+        print(f"smoke 3: trainer-hang healed by a targeted in-place "
+              f"restart (launcher pid {d_pid} unchanged, stage unchanged, "
+              f"alert resolved)")
+
+        # -- 4: gateway spike -> scale-out, zero lost accepted ------------
+        futures, rejects = [], 0
+        t_end = time.time() + 12.0
+        while time.time() < t_end:
+            try:
+                futures.append(gw.submit([7], 4))
+            except EdlOverloadedError:
+                rejects += 1
+            time.sleep(0.08)                    # ~12 req/s vs rate 4/s
+        assert rejects > 0, "the spike never saturated admission"
+        _wait(lambda: _has(_read_incidents(inc_dir["svc"]),
+                           "alert/gateway-reject-burn", "firing"),
+              60, "gateway-reject-burn to fire")
+        _wait(lambda: _has(_read_incidents(inc_dir["svc"]),
+                           "action/scale-out", "ok"),
+              30, "the scale-out action to run")
+        _wait(lambda: len(list_replicas(store, "svc")) >= 3, 90,
+              "the scaled-out replica to appear in the advert table")
+        lost = 0
+        for fut in futures:
+            if fut.exception(timeout=60) is not None:
+                lost += 1
+        assert lost == 0, f"{lost}/{len(futures)} accepted requests lost"
+        alerts_body = json.loads(__import__("urllib.request", fromlist=["r"])
+                                 .urlopen(f"http://{agg_srv.endpoint}/alerts",
+                                          timeout=10).read().decode())
+        acts = alerts_body.get("actions", [])
+        assert any(a["action"] == "scale-out" and a["outcome"] == "ok"
+                   for a in acts), acts
+        assert alerts_body.get("breakers", {}).get("scale-out") == "closed"
+        print(f"smoke 4: spike absorbed — {len(futures)} accepted requests "
+              f"all completed ({rejects} shed at admission), fleet scaled "
+              f"out to {len(list_replicas(store, 'svc'))} replicas, "
+              f"audit on /alerts")
+
+        # -- 5: priority yield + reclaim ---------------------------------
+        train_cluster = Cluster.load_from_store(store, "train")
+        scale_mod.save_demand(store, "svc", 4, reason="gateway-p99-slo")
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) == 1, 90,
+              "training to yield a pod to serving demand")
+        _wait(lambda: _grep_logs(_TMP, "reason=priority-yield") is not None,
+              30, "the yielded pod's workerlog to carry priority-yield")
+        _wait(lambda: len(pool.alive_replicas()) >= 4, 90,
+              "the fleet to scale out to the demanded 4")
+        # quiet: the demand record ages out, the autoscaler decays the
+        # fleet and training reclaims the chips (replacement launchers)
+        scale_mod.clear_demand(store, "svc")
+        _wait(lambda: len(pool.alive_replicas()) <= 2, 120,
+              "the fleet to scale back in on sustained quiet")
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) >= 2, 120,
+              "training to reclaim capacity after the spike")
+        print("smoke 5: training yielded to serving demand "
+              "(reason=priority-yield) and reclaimed the chips on quiet")
+
+        # -- 6: the audit trail ------------------------------------------
+        chains = {
+            "train": [("alert/trainer-straggler", "firing"),
+                      ("action/evict", "ok")],
+            "distill": [("alert/trainer-hang", "firing"),
+                        ("action/restart", "ok"),
+                        ("alert/trainer-hang", "resolved")],
+            "svc": [("alert/gateway-reject-burn", "firing"),
+                    ("action/scale-out", "ok")],
+        }
+        for job, chain in chains.items():
+            recs = _read_incidents(inc_dir[job])
+            for name, state in chain:
+                assert _has(recs, name, state), \
+                    f"{job}: missing {name}/{state} in the incident log"
+        print("smoke 6: incident logs show every alert -> action -> "
+              "recovery handoff")
+    except BaseException:
+        sys.stdout.flush()
+        for root, _dirs, files in os.walk(_TMP):
+            for fn in files:
+                if fn.endswith(".log"):
+                    p = os.path.join(root, fn)
+                    print(f"==== {p} ====")
+                    print(open(p, errors="replace").read()[-4000:])
+        raise
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        if gw is not None:
+            gw.close()
+        for agg in aggs:
+            agg.stop_loop()
+        if agg_srv is not None:
+            agg_srv.stop()
+        pool.kill_all()
+        store.close()
+        coord.stop()
+    print("remediation smoke OK")
+
+
+if __name__ == "__main__":
+    main()

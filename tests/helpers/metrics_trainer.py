@@ -1,0 +1,106 @@
+"""Instrumented inert trainer for the remediation smoke.
+
+Runs as a REAL launcher's training script (reads the TrainerEnv ABI,
+like demo_trainer.py) but behaves like a live trainer as far as the
+observability loop is concerned:
+
+- serves a /metrics endpoint with a live ``edl_train_step_seconds``
+  histogram and a TTL-leased obs advert carrying the POD id (so the
+  remediation dispatcher can map an alerting instance back to the pod
+  it must act on);
+- writes per-step liveness beats with a small published threshold;
+- steps every ``EDL_TPU_SMOKE_STEP_S`` seconds (the straggler fixture
+  sets a slower pace on one pod);
+- STALLS — stops stepping AND beating, process alive, exactly like a
+  wedged collective — while ``EDL_TPU_SMOKE_STALL_FILE`` exists;
+- polls the stage preempt flag and exits ``PREEMPT_EXIT_CODE`` after a
+  token "checkpoint", logging the per-pod eviction reason, exactly
+  like the real trainer's non-delta preemption flow;
+- appends one line per start to ``EDL_TPU_DEMO_MARKER`` so the smoke
+  can count in-place restarts.
+
+It never exits on its own — the smoke ends the jobs by killing the
+launchers (or evicting the pods).
+"""
+
+import os
+import sys
+import time
+
+from edl_tpu.cluster import heartbeat, preempt
+from edl_tpu.cluster.env import TrainerEnv
+from edl_tpu.coord.client import connect
+from edl_tpu.obs import advert as obs_advert
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.metrics import Registry
+from edl_tpu.utils import constants
+
+
+def main() -> None:
+    te = TrainerEnv()
+    marker = os.environ.get("EDL_TPU_DEMO_MARKER", "")
+    if marker:
+        with open(marker, "a") as f:
+            f.write(f"start pod={te.pod_id} stage={te.cluster_stage}\n")
+    step_s = float(os.environ.get("EDL_TPU_SMOKE_STEP_S", "0.05"))
+    stall_file = os.environ.get("EDL_TPU_SMOKE_STALL_FILE", "")
+    threshold = float(os.environ.get("EDL_TPU_SMOKE_BEAT_THRESHOLD", "3"))
+
+    reg = Registry()
+    steps = reg.histogram("edl_train_step_seconds", "per-step wall time")
+    srv = MetricsServer(reg, host="127.0.0.1").start()
+    store = connect(te.coord_endpoints)
+    handle = obs_advert.advertise_metrics(
+        store, te.job_id, "trainer", srv.endpoint,
+        name=f"trainer-{te.pod_id[:8]}-{os.getpid()}",
+        extra={"pod": te.pod_id})
+    print(f"metrics trainer up pod={te.pod_id[:8]} "
+          f"stage={te.cluster_stage[:8]} metrics={srv.endpoint} "
+          f"step_s={step_s}", flush=True)
+
+    last_beat = 0.0
+    last_poll = 0.0
+    while True:
+        stalled = stall_file and os.path.exists(stall_file)
+        if not stalled:
+            time.sleep(step_s)
+            steps.observe(step_s)
+            now = time.monotonic()
+            if now - last_beat > min(1.0, threshold / 3.0):
+                last_beat = now
+                try:
+                    heartbeat.beat(store, te.job_id, te.pod_id,
+                                   threshold=threshold)
+                except Exception as e:  # noqa: BLE001 — a blip is not fatal
+                    print(f"beat failed: {e}", flush=True)
+        else:
+            time.sleep(0.2)     # wedged: no steps, no beats
+        now = time.monotonic()
+        if now - last_poll > 0.5:
+            last_poll = now
+            try:
+                flagged = preempt.get_preempt(store, te.job_id,
+                                              te.cluster_stage)
+            except Exception:  # noqa: BLE001 — a blip is not a preempt
+                flagged = None
+            if flagged is not None:
+                # token "checkpoint at the agreed step", then the
+                # non-delta flow: every pod's trainers exit together
+                time.sleep(0.1)
+                reason = "peer-preempt"
+                try:
+                    info = preempt.pod_preempt_info(
+                        store, te.job_id, te.cluster_stage, te.pod_id)
+                    if info is not None:
+                        reason = info[1]
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    print(f"reason read failed: {e}", flush=True)
+                print(f"preempt: exiting {constants.PREEMPT_EXIT_CODE} "
+                      f"(reason={reason})", flush=True)
+                handle.stop()
+                sys.stdout.flush()
+                os._exit(constants.PREEMPT_EXIT_CODE)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,9 +40,13 @@ class FakeActuator:
 def test_policy_fair_share_and_clamps():
     jobs = [JobView("a", 1, 8, 2), JobView("b", 2, 3, 2),
             JobView("c", 1, 2, 1)]
-    # capacity 10 @ 0.9 -> budget 9 -> shares 3/3/3, clamped per range
+    # capacity 10 @ 0.9 -> budget 9 -> shares 3/3/3, clamped per range;
+    # the slot b and c's max-clamps free waterfills to a (the budget is
+    # a FILL target — clamped members must not strand capacity a
+    # classmate can use)
     out = compute_desired(jobs, capacity=10, max_load_desired=0.9)
-    assert out == {"a": 3, "b": 3, "c": 2}
+    assert out == {"a": 4, "b": 3, "c": 2}
+    assert sum(out.values()) == 9
 
 
 def test_policy_remainder_goes_to_earliest_jobs():
@@ -363,6 +367,148 @@ def test_observed_capacity_highwater_decays(memkv):
                                    now=t0 + 102) == 2  # 2 is still in-window
     assert ctl._effective_capacity([JobView("j", 1, 16, 0)],
                                    now=t0 + 300) == 1  # floor
+
+
+# -- multi-job arbitration (ISSUE 15) ----------------------------------------
+def test_policy_priority_classes_split_surplus_top_down():
+    """Surplus goes to the highest class first; lower classes keep
+    their floors — training yields to serving, no job starves."""
+    jobs = [JobView("serve", 1, 8, 2, kind="serving", priority=100),
+            JobView("train", 1, 8, 5, kind="training", priority=0)]
+    out = compute_desired(jobs, capacity=6, max_load_desired=1.0)
+    assert out == {"serve": 5, "train": 1}          # serving takes the surplus
+    # with a demand cap the serving job takes only what it asked for
+    jobs[0].demand = 3
+    out = compute_desired(jobs, capacity=6, max_load_desired=1.0)
+    assert out == {"serve": 3, "train": 3}          # training reclaims
+    # demand decays to min: training reclaims everything above its floor
+    jobs[0].demand = 1
+    out = compute_desired(jobs, capacity=6, max_load_desired=1.0)
+    assert out == {"serve": 1, "train": 5}
+
+
+def test_policy_gang_all_or_nothing_under_shrinking_capacity():
+    gang = JobView("distill", 4, 4, 4, kind="distill", priority=50,
+                   gang=True)
+    train = JobView("train", 1, 8, 3, kind="training", priority=0)
+    out = compute_desired([gang, train], capacity=8, max_load_desired=1.0)
+    assert out == {"distill": 4, "train": 4}        # gang placed whole
+    # capacity shrinks below the gang: it gets EXACTLY 0, never 1-3 —
+    # a partial gang would strand chips it cannot use atomically
+    out = compute_desired([gang, train], capacity=3, max_load_desired=1.0)
+    assert out == {"distill": 0, "train": 3}
+    # a non-gang job of the same shape keeps its min floor instead
+    loose = JobView("distill", 4, 4, 4, kind="distill", priority=50)
+    out = compute_desired([loose, train], capacity=3, max_load_desired=1.0)
+    assert out["distill"] == 4
+
+
+def test_policy_demand_clamp_does_not_strand_class_capacity():
+    """Review pin: a member clamped down by its demand cap must not
+    strand budget its classmates (then lower classes) can still use."""
+    jobs = [JobView("s1", 1, 8, 1, kind="serving", priority=100, demand=2),
+            JobView("s2", 1, 8, 1, kind="serving", priority=100, demand=8),
+            JobView("train", 1, 8, 1, kind="training", priority=0)]
+    out = compute_desired(jobs, capacity=9, max_load_desired=1.0)
+    # the naive even split gave s1 4 (clamped to 2) and stranded 2
+    # slots; the waterfill hands them to s2, every slot granted
+    assert out == {"s1": 2, "s2": 6, "train": 1}
+    assert sum(out.values()) == 9
+    # when the whole class caps out, the leftover flows DOWN a class
+    jobs[1].demand = 3
+    out = compute_desired(jobs, capacity=9, max_load_desired=1.0)
+    assert out == {"s1": 2, "s2": 3, "train": 4}
+
+
+def test_policy_priority_floors_still_granted_to_low_class():
+    """A higher class's demand can squeeze training to its floor but
+    never below it (the no-starvation rail)."""
+    jobs = [JobView("serve", 1, 16, 2, kind="serving", priority=100,
+                    demand=16),
+            JobView("train", 2, 8, 6, kind="training", priority=0)]
+    out = compute_desired(jobs, capacity=10, max_load_desired=1.0)
+    assert out["train"] == 2                        # floor, not zero
+    assert out["serve"] == 8                        # the rest of the pool
+
+
+def test_controller_serving_job_view_counts_replica_adverts(memkv):
+    """kind=serving jobs are measured by their serving adverts and
+    capped by the autoscaler's demand."""
+    import json as _json
+
+    from edl_tpu.gateway import fleet
+    scale.save_nodes_range(memkv, "svc", 1, 4)
+    scale.save_job_spec(memkv, "svc", kind="serving")
+    for i in range(2):
+        memkv.put(fleet.node_key("svc", f"r{i}"),
+                  _json.dumps({"endpoint": f"127.0.0.1:9{i}"}).encode())
+    ctl = Controller(memkv, capacity=8, actuator=FakeActuator(),
+                     cooldown=0.0)
+    view = ctl.job_view("svc")
+    assert view.kind == "serving" and view.current_nodes == 2
+    assert view.priority == 100                     # kind default
+    assert view.demand == 2                         # hold at current
+    # a fresh demand record (the dispatcher's scale-out) raises it
+    scale.save_demand(memkv, "svc", 3, reason="gateway-p99-slo")
+    assert ctl.job_view("svc").demand == 3
+    acted = ctl.reconcile_once()
+    assert acted["svc"] == 3
+    assert scale.load_desired_nodes(memkv, "svc") == 3
+
+
+def test_controller_graceful_shrink_flags_preempt_then_commits(memkv):
+    """preempt_grace_s > 0: a training shrink first preempt-flags the
+    retiring (highest-rank) pods with a reason; the desired record
+    lands only after they depart — preemption-grace accounting."""
+    from edl_tpu.cluster import preempt
+    pods = [make_pod(f"10.7.0.{i}") for i in range(3)]
+    cluster = _publish_job(memkv, "j7", pods, 1, 8)
+    act = FakeActuator()
+    ctl = Controller(memkv, capacity=2, max_load_desired=1.0,
+                     actuator=act, cooldown=0.0, preempt_grace_s=60.0)
+    acted = ctl.reconcile_once()
+    # tick 1: flag only — no record yet, trainers get their checkpoint
+    assert acted == {}
+    assert scale.load_desired_nodes(memkv, "j7") is None
+    retiring = cluster.pod_ids()[2:]
+    info = preempt.pod_preempt_info(memkv, "j7", cluster.stage, retiring[0])
+    assert info is not None and info[1] == "descale"
+    surviving = cluster.pod_ids()[:2]
+    assert preempt.pod_preempt_info(memkv, "j7", cluster.stage,
+                                    surviving[0]) is None
+    # tick 2: still draining -> hands off
+    assert ctl.reconcile_once() == {}
+    assert scale.load_desired_nodes(memkv, "j7") is None
+    # the flagged pod departs; the shrink record commits
+    _put_cluster(memkv, "j7", pods[:2])
+    acted = ctl.reconcile_once()
+    assert acted == {"j7": 2}
+    assert scale.load_desired_nodes(memkv, "j7") == 2
+    assert act.calls == [("j7", 2)]
+
+
+def test_controller_graceful_shrink_reason_priority_yield(memkv):
+    """A shrink forced by a higher class's growth carries reason
+    priority-yield, not descale."""
+    import json as _json
+
+    from edl_tpu.cluster import preempt
+    from edl_tpu.gateway import fleet
+    pods = [make_pod(f"10.8.0.{i}") for i in range(3)]
+    cluster = _publish_job(memkv, "j8", pods, 1, 8)
+    scale.save_nodes_range(memkv, "svc8", 1, 4)
+    scale.save_job_spec(memkv, "svc8", kind="serving")
+    memkv.put(fleet.node_key("svc8", "r0"),
+              _json.dumps({"endpoint": "127.0.0.1:90"}).encode())
+    scale.save_demand(memkv, "svc8", 3, reason="gateway-p99-slo")
+    ctl = Controller(memkv, capacity=5, max_load_desired=1.0,
+                     actuator=FakeActuator(), cooldown=0.0,
+                     preempt_grace_s=60.0)
+    ctl.reconcile_once()
+    # serving wants 3 of 5 slots -> training shrinks 3 -> 2, yielding
+    retiring = cluster.pod_ids()[2:]
+    info = preempt.pod_preempt_info(memkv, "j8", cluster.stage, retiring[0])
+    assert info is not None and info[1] == "priority-yield"
 
 
 def test_controller_cooldown_scales_with_resize_cost(memkv):

@@ -223,6 +223,39 @@ def test_least_loaded_routing_prefers_free_replica(memkv):
         srv_b.close()
 
 
+def test_routing_weighs_kv_warmth_among_comparable_replicas(memkv):
+    """ISSUE 15 satellite: with identical load, _pick prefers the
+    replica advertising a warmer paged-KV cache (higher prefix hit
+    rate, then more free blocks) — never overriding the load score."""
+    base = {"endpoint": "127.0.0.1:1", "free_slots": 4, "queue_depth": 0}
+    fleet.advertise(memkv, "job", "cold", dict(base, kv_block=8,
+                                               kv_prefix_hit_rate=0.1,
+                                               kv_blocks_free=10), ttl=30)
+    fleet.advertise(memkv, "job", "warm", dict(base, kv_block=8,
+                                               kv_prefix_hit_rate=0.9,
+                                               kv_blocks_free=2), ttl=30)
+    gw = _gateway(memkv)
+    try:
+        gw._fleet.refresh()
+        assert gw._pick(None, set())[0] == "warm"
+        # equal hit rates: free blocks break the tie
+        fleet.advertise(memkv, "job", "roomy", dict(base, kv_block=8,
+                                                    kv_prefix_hit_rate=0.9,
+                                                    kv_blocks_free=64),
+                        ttl=30)
+        gw._fleet.refresh()
+        assert gw._pick(None, set())[0] == "roomy"
+        # load still dominates: a genuinely less-loaded cold replica wins
+        fleet.advertise(memkv, "job", "idle", dict(base, free_slots=8),
+                        ttl=30)
+        gw._fleet.refresh()
+        assert gw._pick(None, set())[0] == "idle"
+        # replicas with no kv fields at all keep working (pre-paged)
+        assert gw._pick(None, {"idle", "warm", "roomy"})[0] == "cold"
+    finally:
+        gw.close()
+
+
 def test_session_affinity_sticks_to_ring_owner(memkv):
     engines = {}
     servers = []

@@ -237,6 +237,59 @@ def test_hang_cap_persists_across_supervise_loops(monkeypatch):
     assert not lch._count_hang("s2")   # stages count independently
 
 
+def test_hang_flag_honored_with_watchdog_disabled(memkv, monkeypatch):
+    """EDL_TPU_HANG_TIMEOUT=-1 disables LOCAL staleness detection only:
+    the coordinated hang FLAG (a peer's watchdog, or a remediation-
+    ordered restart — controller/remediate.py's multi-pod path) must
+    still be polled and acted on, or the alert-driven restart silently
+    no-ops exactly in the alerts-do-the-detecting configuration."""
+    import threading as _t
+
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.env import JobEnv
+    from edl_tpu.cluster.status import Status
+    from edl_tpu.collective import launcher as launcher_mod
+    from tests.test_cluster_model import make_pod
+
+    monkeypatch.setattr(launcher_mod.constants, "HANG_TIMEOUT", -1.0)
+    pods = [make_pod("10.6.0.1"), make_pod("10.6.0.2")]
+    cluster = Cluster.from_pods(pods)
+    lch = launcher_mod.Launcher.__new__(launcher_mod.Launcher)
+    lch._store = memkv
+    lch._job_env = JobEnv.__new__(JobEnv)
+    lch._job_env.job_id = "j-hangflag"
+    lch._pod = pods[0]
+    lch._procs = []
+    lch._period = 0.02
+    lch._ttl = 0.2
+    lch._hang_counts = {}
+    lch._targeted_counts = {}
+    lch._hang_incident = None
+    lch._preempt_event = _t.Event()
+    lch._preempt_stage = None
+    lch._preempt_deadline = None
+
+    class _Alive:
+        is_stopped = False
+    lch._resource_register = _Alive()
+    lch._elector = _Alive()
+    monkeypatch.setattr(launcher_mod.train_process, "watch_procs",
+                        lambda procs: Status.RUNNING)
+
+    from tests.test_relaunch_and_grace import _FakeWatcher
+    watcher = _FakeWatcher()
+
+    def flag():
+        time.sleep(0.1)
+        heartbeat.flag_hang(memkv, "j-hangflag", cluster.stage,
+                            "remediation:trainer-hang")
+    _t.Thread(target=flag, daemon=True).start()
+    # the flagged coordinated restart unwinds the supervise loop (None
+    # = take the restart path) even with the local watchdog disabled
+    assert lch._supervise(watcher, cluster) is None
+    assert lch._hang_incident is not None
+
+
 def test_hang_flag_roundtrip(memkv):
     assert heartbeat.get_hang(memkv, "j", "s1") is None
     t1 = heartbeat.flag_hang(memkv, "j", "s1", "podA")

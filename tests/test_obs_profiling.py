@@ -249,7 +249,10 @@ def test_profile_jax_stop_failure_does_not_double_sleep(tmp_path,
                                      ledger=StepPhaseLedger(enabled=True),
                                      out_dir=str(tmp_path))
     t0 = time.monotonic()
-    res = cap.trigger(duration_s=0.6)
+    # a 1.5s window so the one-vs-two-sleeps gap (1.5s) dwarfs
+    # scheduler jitter: the original 0.6s window left 0.5s of slack
+    # and flaked on a loaded box without any real double-sleep
+    res = cap.trigger(duration_s=1.5)
     assert res["started"] and res["kind"] == "jax_profiler"
     deadline = time.time() + 15
     while time.time() < deadline and not os.path.exists(res["manifest"]):
@@ -258,9 +261,9 @@ def test_profile_jax_stop_failure_does_not_double_sleep(tmp_path,
     with open(res["manifest"], encoding="utf-8") as f:
         manifest = json.load(f)
     # downgraded (stop failed, window already spent) — and finished in
-    # ~one window, not two (the double-sleep bug took >= 1.2s)
+    # ~one window, not two (the double-sleep bug took >= 3.0s)
     assert manifest["kind"] == "manifest_only"
-    assert elapsed < 1.1, f"capture slot held {elapsed:.2f}s for a 0.6s window"
+    assert elapsed < 2.5, f"capture slot held {elapsed:.2f}s for a 1.5s window"
 
 
 def test_profile_route_over_http(tmp_path):
@@ -346,8 +349,14 @@ def test_rule_action_error_does_not_stop_alerting():
 
 def test_builtin_profile_actions_and_goodput_rule():
     rules = {r.name: r for r in obs_rules.builtin_rules()}
-    assert rules["trainer-straggler"].action == "profile"
-    assert rules["gateway-p99-slo"].action == "profile"
+    # the capture action rides alongside the remediation actuators
+    # (comma-chained; the engine runs each registered handler)
+    assert "profile" in rules["trainer-straggler"].action_names()
+    assert "evict" in rules["trainer-straggler"].action_names()
+    assert "profile" in rules["gateway-p99-slo"].action_names()
+    assert "scale-out" in rules["gateway-p99-slo"].action_names()
+    assert rules["trainer-hang"].action_names() == ["restart"]
+    assert rules["gateway-reject-burn"].action_names() == ["scale-out"]
     gr = rules["goodput-regression"]
     assert gr.metric == "edl_goodput_ratio" and gr.op == "<"
 

@@ -1,0 +1,348 @@
+"""Alert-driven remediation (controller/remediate.py + autoscale.py):
+the action handlers, the safety rails (cooldown, circuit breaker,
+dry-run), the audit trail, and the serving autoscaler's decisions.
+
+The acceptance pin for ISSUE 15's safety rail lives here: a
+deliberately flapping rule trips the breaker after N actions inside
+the window, breaker-open surfaces as its own builtin alert, and no
+further restarts land until the breaker half-opens.
+"""
+
+import json
+
+import pytest
+
+from edl_tpu.cluster import heartbeat, paths, preempt, scale
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.controller.autoscale import ServingAutoscaler
+from edl_tpu.controller.remediate import (
+    CircuitBreaker, RemediationDispatcher, _BREAKER_G,
+)
+from edl_tpu.obs.rules import Rule, RuleEngine, builtin_rules
+from edl_tpu.obs.tsdb import TSDB
+from edl_tpu.utils import constants
+from tests.test_cluster_model import make_pod
+
+JOB = "remjob"
+
+
+def _rule(name="trainer-hang", action="restart", window=60.0):
+    return Rule(name, kind="gauge", metric="edl_g", op=">", threshold=0.0,
+                window=window, action=action)
+
+
+def _put_cluster(store, pods, job=JOB):
+    cluster = Cluster.from_pods(pods)
+    store.put(paths.key(job, constants.ETCD_CLUSTER, "cluster"),
+              cluster.to_json().encode())
+    return cluster
+
+
+def _advertise(store, name, endpoint, pod_id, job=JOB):
+    store.put(paths.key(job, constants.ETCD_OBS, f"metrics/{name}"),
+              json.dumps({"endpoint": endpoint, "component": "trainer",
+                          "pod": pod_id}).encode())
+
+
+def _dispatcher(store, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("cooldown_s", 0.0)
+    return RemediationDispatcher(store, JOB, **kw)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_trips_after_n_then_half_opens_then_closes():
+    b = CircuitBreaker(max_actions=3, window_s=10.0, reset_s=30.0)
+    t = 100.0
+    assert all(b.allow(t + i) for i in range(3))     # N actions pass
+    assert b.state == "closed"
+    assert not b.allow(t + 3)                        # N+1 inside window: trip
+    assert b.state == "open"
+    assert not b.allow(t + 10)                       # open: denied
+    assert not b.allow(t + 32.9)                     # still inside reset
+    assert b.allow(t + 3 + 30.1)                     # half-open: ONE trial
+    assert b.state == "half_open"
+    # flapping continues: the trial's window hasn't drained -> re-open
+    assert not b.allow(t + 3 + 30.2)
+    assert b.state == "open"
+    # a second half-open trial that stays quiet for a window closes it
+    t2 = t + 3 + 30.2 + 31.0
+    assert b.allow(t2) and b.state == "half_open"
+    assert b.allow(t2 + 11.0)                        # quiet window: closed
+    assert b.state == "closed"
+
+
+def test_breaker_window_prunes_old_actions():
+    b = CircuitBreaker(max_actions=2, window_s=5.0, reset_s=60.0)
+    assert b.allow(0.0) and b.allow(1.0)
+    assert b.allow(7.0)                  # the first two aged out
+    assert b.state == "closed"
+
+
+# -- dispatch rails ----------------------------------------------------------
+
+def test_dispatch_cooldown_skips_and_does_not_feed_breaker(memkv):
+    d = _dispatcher(memkv, cooldown_s=60.0, breaker_n=2)
+    _put_cluster(memkv, [make_pod("10.9.0.1")])
+    rule = _rule()
+    assert d.dispatch("restart", rule, "", 1.0, now=100.0) == "ok"
+    assert d.dispatch("restart", rule, "", 1.0, now=101.0) == "cooldown"
+    assert d.dispatch("restart", rule, "", 1.0, now=102.0) == "cooldown"
+    # cooled-down triggers never count as executions for the breaker
+    assert d.breakers()["restart"] == "closed"
+    ring = d.recent()
+    assert [r["outcome"] for r in ring] == ["ok", "cooldown", "cooldown"]
+
+
+def test_flapping_rule_trips_breaker_and_fires_its_own_alert(memkv):
+    """The ISSUE 15 safety-rail pin: N actions in the window trip the
+    breaker, the edl_remediation_breaker_open gauge fires the builtin
+    remediation-breaker-open alert, and nothing lands until the
+    half-open trial."""
+    d = _dispatcher(memkv, breaker_n=3, breaker_window_s=60.0,
+                    breaker_reset_s=120.0)
+    _put_cluster(memkv, [make_pod("10.9.1.1")])
+    rule = _rule()
+    for i in range(3):
+        assert d.dispatch("restart", rule, "", 1.0, now=200.0 + i) == "ok"
+    # the flap: 4th firing inside the window is SUPPRESSED
+    assert d.dispatch("restart", rule, "", 1.0, now=204.0) == "breaker_open"
+    assert d.breakers()["restart"] == "open"
+    assert _BREAKER_G.labels(action="restart").value == 1.0
+    # no restart flag was re-written after the trip: the flag ts is
+    # from the third execution, not the suppressed fourth
+    pod = Cluster.load_from_store(memkv, JOB).pods[0].pod_id
+    stage = Cluster.load_from_store(memkv, JOB).stage
+    flag = heartbeat.read_pod_restart(memkv, JOB, stage, pod)
+    assert flag is not None
+
+    # the gauge rides the merged page into the TSDB; the builtin rule
+    # turns it into a firing alert
+    t = TSDB(retention_s=600.0)
+    rules = {r.name: r for r in builtin_rules()}
+    breaker_rule = rules["remediation-breaker-open"]
+    eng = RuleEngine(t, [breaker_rule])
+    t.ingest({("edl_remediation_breaker_open",
+               (("action", "restart"),)): 1.0}, 1000.0)
+    firing = eng.evaluate(now=1000.5)
+    assert [a["alert"] for a in firing] == ["remediation-breaker-open"]
+    assert firing[0]["action"] == "restart"
+
+    # still suppressed until the reset elapses; then ONE trial runs
+    assert d.dispatch("restart", rule, "", 1.0, now=250.0) == "breaker_open"
+    assert d.dispatch("restart", rule, "", 1.0, now=340.0) == "ok"
+    assert d.breakers()["restart"] == "half_open"
+    assert _BREAKER_G.labels(action="restart").value == 0.0
+
+
+def test_dry_run_records_plan_without_touching_store(memkv):
+    d = _dispatcher(memkv, enabled=False, breaker_n=2)
+    cluster = _put_cluster(memkv, [make_pod("10.9.2.1")])
+    rule = _rule()
+    assert d.dispatch("restart", rule, "", 1.0) == "dryrun"
+    pod = cluster.pods[0].pod_id
+    assert heartbeat.read_pod_restart(memkv, JOB, cluster.stage, pod) is None
+    rec = d.recent()[-1]
+    assert rec["outcome"] == "dryrun"
+    assert rec["detail"]["pods"] == [pod]
+    # observe-only never moves the rails: a rehearsal firing past the
+    # breaker budget must not trip it (or page the operator)
+    for i in range(5):
+        assert d.dispatch("restart", rule, "", 1.0,
+                          now=500.0 + i) == "dryrun"
+    assert d.breakers()["restart"] == "closed"
+
+
+def test_action_incident_records_are_durable_and_trace_joined(tmp_path,
+                                                              memkv):
+    from edl_tpu.obs.rules import IncidentLog
+    log = IncidentLog(str(tmp_path), "obs-agg", JOB)
+    d = _dispatcher(memkv, incident_log=log, trace_provider=lambda: "t1" * 8)
+    _put_cluster(memkv, [make_pod("10.9.3.1")])
+    assert d.dispatch("restart", _rule(), "", 1.0) == "ok"
+    recs = [json.loads(line) for line in open(log.path, encoding="utf-8")]
+    assert recs and recs[-1]["name"] == "action/restart"
+    assert recs[-1]["state"] == "ok"
+    assert recs[-1]["rule"] == "trainer-hang"
+    assert recs[-1]["trace_id"] == "t1" * 8
+
+
+# -- the actions -------------------------------------------------------------
+
+def test_restart_single_pod_targeted_multi_pod_coordinated(memkv):
+    """A single-pod job restarts in place via the per-pod flag; a
+    multi-pod job ALWAYS takes the coordinated hang flag — its pods
+    share one collective world, and killing one pod's trainers
+    unilaterally just crashes the peers (heartbeat.py's invariant).
+    The stale-beat pods ride the audit detail for blame."""
+    import time as _time
+    pod = make_pod("10.9.4.9")
+    cluster = _put_cluster(memkv, [pod])
+    d = _dispatcher(memkv)
+    assert d.dispatch("restart", _rule(), "", 1.0) == "ok"
+    assert heartbeat.read_pod_restart(
+        memkv, JOB, cluster.stage, pod.pod_id) is not None
+    assert d.recent()[-1]["detail"]["mode"] == "targeted"
+    assert heartbeat.get_hang(memkv, JOB, cluster.stage) is None
+
+    pods = [make_pod(f"10.9.4.{i}") for i in range(3)]
+    cluster = _put_cluster(memkv, pods)
+    now = _time.time()
+    heartbeat.beat(memkv, JOB, pods[0].pod_id, now=now - 500.0,
+                   threshold=60.0)
+    for p in pods[1:]:
+        heartbeat.beat(memkv, JOB, p.pod_id, now=now, threshold=60.0)
+    d2 = _dispatcher(memkv)
+    assert d2.dispatch("restart", _rule(), "", 1.0) == "ok"
+    assert d2.recent()[-1]["detail"]["mode"] == "coordinated"
+    assert d2.recent()[-1]["detail"]["stale"] == [pods[0].pod_id]
+    assert heartbeat.get_hang(memkv, JOB, cluster.stage) is not None
+    for p in pods:
+        assert heartbeat.read_pod_restart(
+            memkv, JOB, cluster.stage, p.pod_id) is None
+
+
+def test_restart_without_cluster_is_noop(memkv):
+    d = _dispatcher(memkv)
+    assert d.dispatch("restart", _rule(), "", 1.0) == "noop"
+
+
+def test_evict_flags_preemption_with_reason(memkv):
+    pods = [make_pod(f"10.9.5.{i}") for i in range(3)]
+    cluster = _put_cluster(memkv, pods)
+    scale.save_nodes_range(memkv, JOB, 1, 4)
+    _advertise(memkv, "t0", "10.9.5.0:9100", pods[0].pod_id)
+    d = _dispatcher(memkv)
+    rule = _rule("trainer-straggler", action="evict")
+    assert d.dispatch("evict", rule, "10.9.5.0:9100", 3.0) == "ok"
+    info = preempt.pod_preempt_info(memkv, JOB, cluster.stage,
+                                    pods[0].pod_id)
+    assert info is not None and info[1] == "straggler-evict"
+    # the stage flag is up too (trainers poll it for the agreed save)
+    assert preempt.get_preempt(memkv, JOB, cluster.stage) is not None
+
+
+def test_evict_refuses_below_min_nodes(memkv):
+    pods = [make_pod("10.9.6.1"), make_pod("10.9.6.2")]
+    cluster = _put_cluster(memkv, pods)
+    scale.save_nodes_range(memkv, JOB, 2, 4)     # already at the floor
+    _advertise(memkv, "t0", "10.9.6.1:9100", pods[0].pod_id)
+    d = _dispatcher(memkv)
+    out = d.dispatch("evict", _rule("trainer-straggler", action="evict"),
+                     "10.9.6.1:9100", 3.0)
+    assert out == "no_capacity"
+    assert preempt.pod_preempt_info(memkv, JOB, cluster.stage,
+                                    pods[0].pod_id) is None
+
+
+def test_evict_unmapped_instance_is_noop(memkv):
+    _put_cluster(memkv, [make_pod("10.9.7.1"), make_pod("10.9.7.2")])
+    scale.save_nodes_range(memkv, JOB, 1, 4)
+    d = _dispatcher(memkv)
+    assert d.dispatch("evict", _rule(action="evict"),
+                      "1.2.3.4:9", 3.0) == "noop"
+
+
+def test_scale_out_writes_demand_record_clamped_to_range(memkv):
+    from edl_tpu.gateway import fleet
+    scale.save_nodes_range(memkv, JOB, 1, 3)
+    for i in range(2):
+        memkv.put(fleet.node_key(JOB, f"r{i}"),
+                  json.dumps({"endpoint": f"127.0.0.1:9{i}"}).encode())
+    d = _dispatcher(memkv)
+    rule = _rule("gateway-p99-slo", action="scale-out")
+    assert d.dispatch("scale-out", rule, "", 9.0) == "ok"
+    rec = scale.load_demand(memkv, JOB)
+    assert rec["replicas"] == 3 and rec["reason"] == "gateway-p99-slo"
+    # at max already: noop, demand unchanged
+    memkv.put(fleet.node_key(JOB, "r2"),
+              json.dumps({"endpoint": "127.0.0.1:92"}).encode())
+    d2 = _dispatcher(memkv)
+    assert d2.dispatch("scale-out", rule, "", 9.0) == "noop"
+
+
+# -- engine integration ------------------------------------------------------
+
+def test_engine_runs_comma_chained_actions_with_outcomes():
+    from edl_tpu.obs.rules import _ACTIONS_TOTAL
+    t = TSDB(retention_s=600.0)
+    calls = []
+    rule = Rule("r", kind="gauge", metric="edl_g", op=">", threshold=0.5,
+                window=60.0, action="first,second")
+    eng = RuleEngine(t, [rule], actions={
+        "first": lambda r, g, v: calls.append("first") or "cooldown",
+        "second": lambda r, g, v: calls.append("second"),   # None -> ok
+    })
+    before_cd = _ACTIONS_TOTAL.labels(action="first",
+                                      outcome="cooldown").value
+    before_ok = _ACTIONS_TOTAL.labels(action="second", outcome="ok").value
+    t.ingest({("edl_g", ()): 1.0}, 1000.0)
+    eng.evaluate(now=1000.5)
+    assert calls == ["first", "second"]
+    assert _ACTIONS_TOTAL.labels(action="first",
+                                 outcome="cooldown").value == before_cd + 1
+    assert _ACTIONS_TOTAL.labels(action="second",
+                                 outcome="ok").value == before_ok + 1
+
+
+# -- serving autoscaler ------------------------------------------------------
+
+def test_autoscaler_demand_record_drives_target_and_ttl_expires(memkv):
+    a = ServingAutoscaler(memkv, quiet_s=50.0, demand_ttl=120.0)
+    # no signal: hold at current
+    assert a.desired(JOB, 1, 8, 2, now=100.0) == 2
+    scale.save_demand(memkv, JOB, 4, reason="gateway-p99-slo")
+    assert a.desired(JOB, 1, 8, 2, now=101.0) == 4
+    # demand clamps to the range
+    scale.save_demand(memkv, JOB, 99, reason="gateway-p99-slo")
+    assert a.desired(JOB, 1, 8, 2, now=102.0) == 8
+    # an EXPIRED record is not a signal; target decays on quiet
+    import time as _time
+    memkv.put(paths.key(JOB, constants.ETCD_SCALE, "demand"),
+              json.dumps({"replicas": 99, "reason": "stale",
+                          "at": _time.time() - 999.0}).encode())
+    assert a.desired(JOB, 1, 8, 2, now=140.0) == 8    # quiet < quiet_s
+    assert a.desired(JOB, 1, 8, 2, now=160.0) == 7    # one step per window
+    assert a.desired(JOB, 1, 8, 2, now=215.0) == 6
+
+
+def test_autoscaler_firing_alert_steps_from_current(memkv):
+    a = ServingAutoscaler(memkv, alerts_url="http://unused/alerts",
+                          step=1, quiet_s=60.0)
+    a._alerts_cache = (100.0, {"gateway-p99-slo"})   # injected poll result
+    assert a.desired(JOB, 1, 8, 2, now=100.0) == 3
+    a._alerts_cache = (100.5, {"gateway-p99-slo"})
+    assert a.desired(JOB, 1, 8, 3, now=100.5) == 4
+    # quiet: decays back toward min one step per window
+    a._alerts_cache = (161.0, set())
+    assert a.desired(JOB, 1, 8, 4, now=161.0) == 3
+
+
+def test_autoscaler_never_below_min_or_above_max(memkv):
+    a = ServingAutoscaler(memkv, quiet_s=1.0)
+    assert a.desired(JOB, 2, 3, 1, now=0.0) == 2     # floor
+    for i in range(10):
+        out = a.desired(JOB, 2, 3, 2, now=10.0 + i * 5)
+    assert out == 2                                   # decay floor = min
+
+
+# -- the edl-obs-top actions pane -------------------------------------------
+
+def test_render_top_shows_recent_actions_and_breakers():
+    from edl_tpu.obs.top import render_top
+    alerts = {"firing": [], "pending": [],
+              "actions": [{"ts": 1000.0, "rule": "trainer-hang",
+                           "action": "restart", "outcome": "ok",
+                           "group": ""},
+                          {"ts": 1001.0, "rule": "trainer-hang",
+                           "action": "restart", "outcome": "breaker_open",
+                           "group": ""}],
+              "breakers": {"restart": "open", "evict": "closed"}}
+    out = render_top({"job_id": "j", "live_targets": 0}, alerts)
+    assert "recent actions (2)" in out
+    assert "breakers: restart=open" in out
+    assert "evict" not in out.split("breakers:")[1].splitlines()[0].replace(
+        "restart=open", "")          # closed breakers are not noise
+    assert "trainer-hang -> restart [breaker_open]" in out
+    assert "trainer-hang -> restart [ok]" in out
