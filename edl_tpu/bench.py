@@ -407,6 +407,16 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- fleet-sim section (PR 16): control-plane scaling headlines ---------
+    # default OFF: a decade sweep costs minutes of wall time; the full
+    # observatory runs via `python -m edl_tpu.sim` (SIM_r*.json + report)
+    if os.environ.get("EDL_TPU_BENCH_SIM", "0") != "0":
+        try:
+            out.update(_bench_sim())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     if pipe_img_s_chip is not None:
         # host-core-bound: JPEG decode scales ~linearly with cores, so
         # report the core count the number was measured with (the
@@ -912,6 +922,37 @@ def _bench_data_delivery() -> dict:
         "data_delivery_pod_loss_samples_s": round(loss_rate, 1),
         "data_delivery_records": total,
     }
+
+
+def _bench_sim() -> dict:
+    """Fleet-sim headline numbers (EDL_TPU_BENCH_SIM=1; see
+    edl_tpu/sim + doc/scale.md for the full observatory).  Reported at
+    the sweep's largest N: watch vs poll membership-propagation p50,
+    aggregator scrape-cycle wall, and the fitted growth exponent of
+    each propagation mode across the sweep."""
+    from edl_tpu.sim.harness import SimConfig, run_sweep
+    from edl_tpu.sim.report import fit_exponent
+
+    ns = tuple(int(n) for n in os.environ.get(
+        "EDL_TPU_BENCH_SIM_NS", "25,100").split(","))
+    round_s = float(os.environ.get("EDL_TPU_BENCH_SIM_ROUND_S", 8.0))
+    art = run_sweep(SimConfig(ns=ns, round_s=round_s, ttl=6.0,
+                              job_id="bench-sim"))
+    rounds = art["rounds"]
+    top = max(rounds, key=lambda r: r["n"])
+    out = {
+        "sim_ns": list(ns),
+        "sim_watch_prop_p50_s": top["propagation"]["watch"].get("p50_s"),
+        "sim_poll_prop_p50_s": top["propagation"]["poll"].get("p50_s"),
+        "sim_scrape_cycle_s": top["scrape"]["mean_wall_s"],
+        "sim_op_failures": sum(r["op_failures"] for r in rounds),
+    }
+    for mode in ("watch", "poll"):
+        alpha = fit_exponent([(r["n"], r["propagation"][mode].get("p50_s"))
+                              for r in rounds])
+        if alpha is not None:
+            out[f"sim_{mode}_prop_alpha"] = round(alpha, 3)
+    return out
 
 
 def _bench_alerts() -> dict:

@@ -28,11 +28,34 @@ import time
 from collections import deque
 
 from edl_tpu.coord.kv import KVRecord, KVStore, WaitResult, WatchEvent
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
 _EVENT_LOG_CAP = 4096
+
+# watch fan-out + lease-sweep telemetry (doc/observability.md,
+# doc/scale.md): the fleet-sim harness attributes its propagation and
+# sweep curves to these, and they stay on in production
+_WATCHERS_G = obs_metrics.gauge(
+    "edl_coord_watchers",
+    "wait() calls currently blocked in this store (watch fan-out)")
+_WAKEUPS_TOTAL = obs_metrics.counter(
+    "edl_coord_watch_wakeups_total",
+    "Blocked wait() calls woken by a mutation (one mutation with N "
+    "watchers costs N wakeups)")
+_WATCH_DELIVERY_SECONDS = obs_metrics.histogram(
+    "edl_coord_watch_delivery_seconds",
+    "Mutation emit -> woken watcher delivery latency (seconds)")
+_LEASE_SWEEP_SECONDS = obs_metrics.histogram(
+    "edl_coord_lease_sweep_seconds",
+    "One sweeper-tick expiry pass over the lease table (seconds)")
+_LEASES_LIVE_G = obs_metrics.gauge(
+    "edl_coord_leases_live", "Live leases after the last sweeper tick")
+_LEASES_SWEPT_TOTAL = obs_metrics.counter(
+    "edl_coord_leases_swept_total",
+    "Leases expired (or revoke-retried) by an expiry pass")
 
 
 class _Lease:
@@ -63,7 +86,9 @@ class MemoryKV(KVStore):
         self._leases: dict[int, _Lease] = {}
         self._revision = 0
         self._next_lease = 1
-        self._events: deque[tuple[int, WatchEvent]] = deque(maxlen=_EVENT_LOG_CAP)
+        # (revision, event, emit perf-counter stamp): the stamp feeds
+        # the wakeup-to-delivery histogram without a second log scan
+        self._events: deque[tuple[int, WatchEvent, float]] = deque(maxlen=_EVENT_LOG_CAP)
         self._closed = False
         self._stop_evt = threading.Event()
         # serializes whole snapshot cycles (cut image -> write -> maybe
@@ -188,7 +213,12 @@ class MemoryKV(KVStore):
         return self._revision
 
     def _emit(self, etype: str, rec: KVRecord):
-        self._events.append((rec.revision, WatchEvent(etype, rec)))
+        self._events.append((rec.revision, WatchEvent(etype, rec),
+                             time.perf_counter()))
+        # notify_all only MOVES the N blocked waiters to the lock queue
+        # (cheap, no per-watcher delivery work under the lock) — each
+        # woken wait() call copies its log tail and does its prefix
+        # filtering after releasing the lock (see wait())
         self._cond.notify_all()
 
     def _put_locked(self, key: str, value: bytes, lease_id: int) -> int:
@@ -235,6 +265,8 @@ class MemoryKV(KVStore):
             return  # post-restart grace: holders get to refresh first
         dead = [lid for lid, l in self._leases.items()
                 if l.revoking or l.expires_at <= now]
+        if dead:
+            _LEASES_SWEPT_TOTAL.inc(len(dead))
         for lid in dead:
             try:
                 lease = self._leases[lid]
@@ -270,7 +302,14 @@ class MemoryKV(KVStore):
                 with self._lock:
                     if self._closed:
                         return
+                    # timed ONLY on the sweeper tick (not the inline
+                    # expiry every op runs): this is the per-tick full
+                    # pass whose duration vs. live-lease count the
+                    # fleet-sim scaling curve plots
+                    t0 = time.perf_counter()
                     self._expire_locked(time.monotonic())
+                    _LEASE_SWEEP_SECONDS.observe(time.perf_counter() - t0)
+                    _LEASES_LIVE_G.set(len(self._leases))
                     if self._snapshot_due and self._journal is not None:
                         image = self._snapshot_state_locked()
                         journal = self._journal  # close() may null the attr
@@ -415,34 +454,74 @@ class MemoryKV(KVStore):
 
     # -- watches -----------------------------------------------------------
     def wait(self, prefix: str, since_revision: int, timeout: float) -> WaitResult:
+        # Delivery is two-phase so N blocked watchers never serialize
+        # mutations behind per-watcher string matching: under the lock
+        # only cheap reference copies happen (the log tail newer than
+        # since_revision, or the record list for a resync); the
+        # per-watcher prefix filtering — the O(events) work that scales
+        # with fan-out — runs OFF the lock.  A mutation landing during
+        # the off-lock filter is caught by the revision re-check before
+        # re-blocking, so no event can be missed.
         deadline = time.monotonic() + timeout
-        with self._lock:
+        woke = False
+        _WATCHERS_G.inc()
+        try:
             while True:
-                self._expire_locked(time.monotonic())
-                if (since_revision > self._revision
-                        or (since_revision < self._revision
-                            and (not self._events
-                                 or since_revision < self._events[0][0] - 1))):
-                    # caller's revision predates the bounded event log
-                    # (compaction, or a restart emptied it) OR exceeds
-                    # the store's (an amnesiac restart REWOUND the
-                    # counter — the position is from a previous life):
-                    # fall back to a full current-state resync.  Marked
-                    # snapshot=True — deletes whose tombstones fell out
-                    # of the log are only visible as ABSENCE from this
-                    # set, so watchers must replace (not merge) their
-                    # view.
-                    recs = [r for k, r in self._data.items() if k.startswith(prefix)]
-                    return WaitResult([WatchEvent("put", r) for r in sorted(recs, key=lambda r: r.key)],
-                                      self._revision, snapshot=True)
-                evs = [e for rev, e in self._events
-                       if rev > since_revision and e.record.key.startswith(prefix)]
+                with self._lock:
+                    self._expire_locked(time.monotonic())
+                    rev = self._revision
+                    snapshot = (since_revision > rev
+                                or (since_revision < rev
+                                    and (not self._events
+                                         or since_revision < self._events[0][0] - 1)))
+                    if snapshot:
+                        # caller's revision predates the bounded event
+                        # log (compaction, or a restart emptied it) OR
+                        # exceeds the store's (an amnesiac restart
+                        # REWOUND the counter — the position is from a
+                        # previous life): fall back to a full
+                        # current-state resync.  Marked snapshot=True —
+                        # deletes whose tombstones fell out of the log
+                        # are only visible as ABSENCE from this set, so
+                        # watchers must replace (not merge) their view.
+                        recs = list(self._data.values())
+                        tail = ()
+                    else:
+                        # newest-first walk stops at the caller's
+                        # position: a caught-up watcher copies only the
+                        # events it has not seen, not the whole log
+                        recs = ()
+                        tail = []
+                        for erev, ev, emitted in reversed(self._events):
+                            if erev <= since_revision:
+                                break
+                            tail.append((ev, emitted))
+                        tail.reverse()
+                if snapshot:
+                    recs = [r for r in recs if r.key.startswith(prefix)]
+                    return WaitResult([WatchEvent("put", r) for r in
+                                       sorted(recs, key=lambda r: r.key)],
+                                      rev, snapshot=True)
+                evs = [ev for ev, _t in tail if ev.record.key.startswith(prefix)]
                 if evs:
-                    return WaitResult(evs, self._revision)
+                    if woke:
+                        _WAKEUPS_TOTAL.inc()
+                        _WATCH_DELIVERY_SECONDS.observe(
+                            time.perf_counter() - tail[-1][1])
+                    return WaitResult(evs, rev)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return WaitResult([], self._revision)
-                self._cond.wait(min(remaining, 0.25))
+                    return WaitResult([], rev)
+                with self._lock:
+                    # re-check under the lock: an emit during the
+                    # off-lock filter already happened-before this
+                    # acquire, so either we see its revision bump here
+                    # (loop again) or we block and its notify wakes us
+                    if self._revision == rev:
+                        self._cond.wait(min(remaining, 0.25))
+                woke = True
+        finally:
+            _WATCHERS_G.inc(-1)
 
     def close(self) -> None:
         with self._lock:

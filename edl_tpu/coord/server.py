@@ -16,6 +16,7 @@ import functools
 import os
 import time
 
+from edl_tpu.cluster import paths
 from edl_tpu.coord.memory import MemoryKV
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.server import RpcServer
@@ -29,20 +30,57 @@ _KV_OPS_TOTAL = obs_metrics.counter(
 _KV_OP_SECONDS = obs_metrics.histogram(
     "edl_kv_op_seconds", "Coordination KV op service time (seconds); "
     "`wait` blocks until an event or its timeout", ("op",))
+_COORD_OP_SECONDS = obs_metrics.histogram(
+    "edl_coord_op_seconds",
+    "Coordination op service time by op and key table — the per-table "
+    "split attributes control-plane latency to its writer (doc/scale.md)",
+    ("op", "table"))
+_TABLE_WRITES_TOTAL = obs_metrics.counter(
+    "edl_coord_table_writes_total",
+    "Mutating coordination ops by key table (hot-prefix write counter)",
+    ("table",))
+
+# mutating wire methods (feed the hot-prefix write counter)
+_WRITE_OPS = frozenset({"kv_put", "kv_del", "kv_del_range",
+                        "txn_put_if_absent", "txn_put_if_equals"})
+_TABLES = frozenset(constants.ALL_TABLES)
+
+
+def _table_of(kw: dict) -> str:
+    """Key table of a wire call's kwargs, from the canonical
+    ``/edl_tpu/<job_id>/<table>/<name>`` schema (cluster/paths.py).
+    Cardinality is bounded by construction: only the known table set
+    mints label values — any other key shape is "other", key-less ops
+    (leases, ping) are ""."""
+    key = kw.get("key") or kw.get("prefix") or kw.get("guard_key") or ""
+    if not key:
+        return ""
+    if key.startswith(paths.ROOT + "/"):
+        parts = key.split("/", 4)
+        if len(parts) >= 4 and parts[3] in _TABLES:
+            return parts[3]
+    return "other"
 
 
 def _timed(fn):
-    """Count + time each KV op (op = wire method name)."""
+    """Count + time each KV op (op = wire method name, table parsed
+    from the key/prefix kwarg — RPC dispatch always calls by kwargs)."""
     op = fn.__name__
+    is_write = op in _WRITE_OPS
 
     @functools.wraps(fn)
     def wrapper(self, *a, **kw):
+        table = _table_of(kw)
+        if is_write:
+            _TABLE_WRITES_TOTAL.labels(table=table).inc()
         t0 = time.perf_counter()
         try:
             return fn(self, *a, **kw)
         finally:
+            dt = time.perf_counter() - t0
             _KV_OPS_TOTAL.labels(op=op).inc()
-            _KV_OP_SECONDS.labels(op=op).observe(time.perf_counter() - t0)
+            _KV_OP_SECONDS.labels(op=op).observe(dt)
+            _COORD_OP_SECONDS.labels(op=op, table=table).observe(dt)
 
     return wrapper
 
@@ -187,12 +225,25 @@ def main():
     parser.add_argument("--restart_grace", type=float, default=None,
                         help="seconds to suspend expiry sweeps after a "
                              "WAL-backed restart (-1/unset = one TTL)")
+    parser.add_argument("--job_id", default=os.environ.get("EDL_TPU_JOB_ID", ""),
+                        help="advertise this server's env-gated /metrics "
+                             "endpoint in its OWN store under the job's obs "
+                             "table, so edl-obs-agg scrapes the coord "
+                             "telemetry and edl-obs-top shows the "
+                             "control-plane pane (empty = no advert)")
     args = parser.parse_args()
     configure()
     from edl_tpu import obs
     obs.install_from_env("coord")  # /metrics + JSONL trace, env-gated
     server = start_server(args.host, args.port, data_dir=args.data_dir,
                           restart_grace=args.restart_grace)
+    if args.job_id:
+        # in-process store handle: the advert rides a TTL lease in the
+        # server's own KV, kept alive for the life of this process —
+        # best-effort (advertise_installed never raises), and a no-op
+        # unless EDL_TPU_METRICS_PORT enabled the endpoint above
+        from edl_tpu.obs import advert
+        advert.advertise_installed(server.kv, args.job_id, "coord")
     logger.info("coordination server listening on %s%s", server.endpoint,
                 f" (durable: {args.data_dir})" if args.data_dir else "")
     try:

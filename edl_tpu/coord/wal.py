@@ -38,12 +38,26 @@ import os
 import struct
 import zlib
 
+import time
+
 import msgpack
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# durability cost attribution (doc/scale.md): appends run under the KV
+# lock (ordering guarantee), so their latency bounds EVERY mutating op
+# — the fleet-sim curves read these off the coord server's /metrics
+_WAL_APPEND_SECONDS = obs_metrics.histogram(
+    "edl_coord_wal_append_seconds",
+    "One WAL record append: pack + write + flush (+ fsync when "
+    "EDL_TPU_COORD_FSYNC=1); runs under the KV lock")
+_WAL_SNAPSHOT_SECONDS = obs_metrics.histogram(
+    "edl_coord_wal_snapshot_seconds",
+    "One snapshot image serialize + atomic write (off the KV lock)")
 
 _REC_HEADER = struct.Struct(">II")  # length, crc32(body)
 SNAPSHOT = "snapshot.bin"
@@ -115,6 +129,7 @@ class Wal:
         failure the file is truncated back to the pre-record offset
         (the log stays a clean prefix) and the error propagates to the
         mutating caller."""
+        t0 = time.perf_counter()
         body = msgpack.packb(rec, use_bin_type=True)
         if self._f is None:
             self._reopen()  # prior disk error lost the handle: self-heal
@@ -145,6 +160,7 @@ class Wal:
                 logger.exception("wal %s: could not repair torn tail; "
                                  "deferred to next append", self._wal_path)
             raise
+        _WAL_APPEND_SECONDS.observe(time.perf_counter() - t0)
         self._count += 1
         return self._snapshot_every > 0 and self._count >= self._snapshot_every
 
@@ -177,6 +193,7 @@ class Wal:
         (SIGKILL loses nothing either way because the OS holds both the
         rename and the dirty pages; only power loss needs
         ``EDL_TPU_COORD_FSYNC=1``)."""
+        t0 = time.perf_counter()
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(state, use_bin_type=True))
@@ -184,6 +201,7 @@ class Wal:
             if self._fsync:
                 os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)
+        _WAL_SNAPSHOT_SECONDS.observe(time.perf_counter() - t0)
 
     def mark(self) -> int:
         """Append-count cursor for :meth:`truncate_if_unmoved` (read
@@ -317,7 +335,6 @@ def load_state(data_dir: str) -> dict | None:
             end_ts = os.path.getmtime(wal_path if os.path.exists(wal_path)
                                       else snap_path)
         except OSError:
-            import time
             end_ts = time.time()
 
     logger.info("wal %s: replayed %d records onto snapshot "

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 from edl_tpu.cluster import paths
@@ -34,6 +35,12 @@ from edl_tpu.utils import constants
 from edl_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# how long the watch view may go without a successful wait()/reseed
+# round before targets() falls back to direct polling (multiplied by
+# the watch period, floored at 10 s): a wedged watch thread must
+# degrade to the old poll path, never serve a frozen fleet view
+_STALE_PERIODS = 3.0
 
 
 def _prefix(job_id: str) -> str:
@@ -60,21 +67,157 @@ def advertise_metrics(store, job_id: str, component: str, endpoint: str,
         json.dumps(payload).encode(), ttl=ttl, session=session)
 
 
+def _decode_advert(value: bytes) -> dict | None:
+    """Advert payload, or None for a torn advert (the lease expires it)."""
+    try:
+        payload = json.loads(value.decode())
+        payload["endpoint"]  # torn advert without an endpoint: skip
+    except (ValueError, KeyError, TypeError, AttributeError):
+        # TypeError: valid JSON that isn't an object (payload["..."]
+        # on a list/number) — as torn as any other malformed advert
+        return None
+    return payload
+
+
 def list_metrics_targets(store, job_id: str) -> dict[str, dict]:
     """Live /metrics endpoints: ``{advert_name: payload}``."""
     prefix = _prefix(job_id)
     recs, _rev = store.get_prefix(prefix)
     out: dict[str, dict] = {}
     for rec in recs:
-        try:
-            payload = json.loads(rec.value.decode())
-            payload["endpoint"]  # torn advert without an endpoint: skip
-        except (ValueError, KeyError, TypeError):
-            # TypeError: valid JSON that isn't an object (payload["..."]
-            # on a list/number) — as torn as any other malformed advert
-            continue  # the lease will expire it
-        out[rec.key[len(prefix):]] = payload
+        payload = _decode_advert(rec.value)
+        if payload is not None:
+            out[rec.key[len(prefix):]] = payload
     return out
+
+
+class MetricsTargetWatcher:
+    """Push-based target discovery: a long-poll ``wait()`` view of the
+    job's /metrics adverts.
+
+    The aggregator used to ``get_prefix`` the whole obs table every
+    collect cycle — at N pods that is an O(N) store scan per cycle
+    whose cost the fleet-sim harness plots (doc/scale.md), and
+    membership changes propagate only at the polling period.  This
+    watcher keeps the ``{advert_name: payload}`` view current from the
+    store's event stream instead (the ``registry.wait_dist_readers``
+    pattern): one mostly-idle long-poll round trip per period, and a
+    new or expired advert lands in the view within one event delivery.
+
+    Degradation is always toward the old behavior, never toward a
+    frozen view: a store whose ``wait`` raises ``NotImplementedError``
+    flips the watcher into permanent poll mode, any other watch error
+    triggers a reseed, and :meth:`targets` serves a direct
+    ``get_prefix`` whenever the watch view is stale or not yet seeded.
+    """
+
+    def __init__(self, store, job_id: str, period: float = 2.0):
+        self._store = store
+        self._job_id = job_id
+        self._prefix = _prefix(job_id)
+        self._period = max(0.1, float(period))
+        self._halt = threading.Event()
+        self._lock = threading.Lock()  # view state only, never store I/O
+        self._targets: dict[str, dict] = {}
+        self._revision = 0
+        self._watch_ok = True
+        self._fresh_at = 0.0  # monotonic stamp of the last good round
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MetricsTargetWatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"obs-targets:{self._job_id}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._halt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _reseed(self) -> None:
+        """Full view rebuild from one prefix read (startup, and repair
+        after any watch error)."""
+        recs, rev = self._store.get_prefix(self._prefix)
+        view: dict[str, dict] = {}
+        for rec in recs:
+            payload = _decode_advert(rec.value)
+            if payload is not None:
+                view[rec.key[len(self._prefix):]] = payload
+        with self._lock:
+            self._targets = view
+            self._revision = rev
+            self._fresh_at = time.monotonic()
+
+    def _apply(self, res) -> None:
+        """Fold one WaitResult into the view.  A snapshot result
+        REPLACES it (kv.py contract: compacted deletes are only visible
+        as absence); an empty delta still refreshes the staleness stamp
+        — an idle fleet is fresh, not stale."""
+        with self._lock:
+            if res.snapshot:
+                self._targets = {}
+            for e in res.events:
+                name = e.record.key[len(self._prefix):]
+                if e.type == "delete":
+                    self._targets.pop(name, None)
+                else:
+                    payload = _decode_advert(e.record.value)
+                    if payload is not None:
+                        self._targets[name] = payload
+            self._revision = res.revision
+            self._fresh_at = time.monotonic()
+
+    def _run(self) -> None:
+        delay = 0.25
+        while not self._halt.is_set():
+            try:
+                self._reseed()
+                break
+            except Exception:  # noqa: BLE001 — store booting: keep trying
+                logger.debug("target watch seed failed", exc_info=True)
+                self._halt.wait(delay)
+                delay = min(delay * 2, 2.0)
+        while not self._halt.is_set():
+            try:
+                res = self._store.wait(self._prefix, self._revision,
+                                       min(self._period, 2.0))
+            except NotImplementedError:
+                # store has no wait(): permanent poll fallback —
+                # targets() serves get_prefix per call from here on,
+                # which is exactly the pre-watch behavior
+                with self._lock:
+                    self._watch_ok = False
+                return
+            except Exception:  # noqa: BLE001 — store blip: reseed + retry
+                if self._halt.is_set():
+                    return
+                self._halt.wait(1.0)
+                try:
+                    self._reseed()
+                except Exception:  # noqa: BLE001 — still down; stay stale
+                    logger.debug("target watch reseed failed",
+                                 exc_info=True)
+                continue
+            self._apply(res)
+
+    def targets(self) -> dict[str, dict]:
+        """Current ``{advert_name: payload}`` view; falls back to a
+        direct ``get_prefix`` while the watch is unavailable (no
+        ``wait()`` on this store, thread not started, view stale or
+        not yet seeded)."""
+        stale_after = max(_STALE_PERIODS * self._period, 10.0)
+        with self._lock:
+            ok = (self._watch_ok and self._thread is not None
+                  and self._fresh_at > 0.0
+                  and time.monotonic() - self._fresh_at <= stale_after)
+            view = dict(self._targets)
+        if ok:
+            return view
+        return list_metrics_targets(self._store, self._job_id)
 
 
 def publish_job_trace(store, job_id: str, ctx, stage: str | None = None

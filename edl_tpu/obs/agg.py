@@ -228,6 +228,14 @@ class Aggregator:
             obs_rules.load_rules() if rules is None else rules,
             incident_log=incident_log,
             trace_provider=self._job_trace_id, actions=actions)
+        # discovery: a long-poll watch view of the obs adverts keeps
+        # membership current between scrape cycles instead of one
+        # O(targets) get_prefix scan per cycle — the first control-plane
+        # hotspot the fleet-sim harness confirmed (doc/scale.md);
+        # EDL_TPU_OBS_DISCOVERY_WATCH=0 restores per-cycle polling
+        self._discovery_watch = (
+            os.environ.get("EDL_TPU_OBS_DISCOVERY_WATCH", "1") != "0")
+        self._target_watcher: advert.MetricsTargetWatcher | None = None
         self._lock = threading.Lock()
         # single-flight gate for the scrape fan-out: collect() holds it
         # across the network I/O so concurrent callers coalesce onto one
@@ -294,6 +302,24 @@ class Aggregator:
         t, self._loop_thread = self._loop_thread, None
         if t is not None:
             t.join(timeout=5.0)
+        w, self._target_watcher = self._target_watcher, None
+        if w is not None:
+            w.stop()
+
+    def _discover_targets(self) -> dict[str, dict]:
+        """Live /metrics targets: the watch-backed view (lazily started
+        on first use), or a direct per-cycle poll when
+        ``EDL_TPU_OBS_DISCOVERY_WATCH=0``.  The watcher itself degrades
+        to polling on stores without ``wait()`` or while its view is
+        stale, so this can only ever be as slow as the old path."""
+        if not self._discovery_watch:
+            return advert.list_metrics_targets(self.store, self.job_id)
+        if self._target_watcher is None:
+            period = (min(max(self.scrape_interval, 0.5), 2.0)
+                      if self.scrape_interval > 0 else 2.0)
+            self._target_watcher = advert.MetricsTargetWatcher(
+                self.store, self.job_id, period=period).start()
+        return self._target_watcher.targets()
 
     def _scoped(self, seconds: float):
         sd = getattr(self.store, "scoped_deadline", None)
@@ -346,7 +372,7 @@ class Aggregator:
             if fresh is not None:
                 return fresh  # the previous holder scraped for us
             t0 = time.perf_counter()
-            targets = advert.list_metrics_targets(self.store, self.job_id)
+            targets = self._discover_targets()
             _TARGETS_G.set(len(targets))
             pages: list[tuple[dict, str]] = []
             scraped: dict[str, str] = {}
@@ -579,6 +605,9 @@ class Aggregator:
                 parsed, "edl_coord_retries_total") or 0.0,
         }
         summary["robustness"] = robustness
+        coord = self._coord_summary(parsed)
+        if coord:
+            summary["coord"] = coord
         # windowed throughput rates (TSDB history permitting)
         w = self.quantile_window
         rates = {}
@@ -595,6 +624,45 @@ class Aggregator:
         summary["alerts"] = {"firing": len(alerts),
                              "names": sorted({a["alert"] for a in alerts})}
         return summary
+
+    def _coord_summary(self, parsed: dict) -> dict:
+        """Control-plane headline block (the edl-obs-top coord pane):
+        present only when a coord server's /metrics rides the merged
+        page (``edl-coord --job_id`` self-advert).  Samples are
+        filtered to ``component="coord"`` so rpc connection gauges
+        from data/memstate servers never pollute the pane."""
+        def csum(name: str) -> float | None:
+            vals = [v for (n, labels), v in parsed.items()
+                    if n == name and dict(labels).get("component") == "coord"]
+            return sum(vals) if vals else None
+
+        ops = csum("edl_kv_ops_total")
+        if ops is None:
+            return {}
+        coord: dict = {
+            "ops_total": ops,
+            "watchers": csum("edl_coord_watchers") or 0.0,
+            "watch_wakeups": csum("edl_coord_watch_wakeups_total") or 0.0,
+            "leases_live": csum("edl_coord_leases_live") or 0.0,
+            "leases_swept": csum("edl_coord_leases_swept_total") or 0.0,
+            "open_connections": csum("edl_rpc_open_connections") or 0.0,
+            "inflight_requests": csum("edl_rpc_inflight_requests") or 0.0,
+        }
+        w = self.quantile_window
+        r = self.tsdb.rate("edl_kv_ops_total", w)
+        if r:
+            coord["ops_per_s"] = round(sum(r.values()), 2)
+        # put p99, not all-op p99: `wait` is a long poll whose latency
+        # is its timeout — folding it in would bury the write path
+        p99 = self.tsdb.quantile_over_window(
+            "edl_coord_op_seconds", 0.99, w, matchers={"op": "kv_put"})
+        if p99 is not None:
+            coord["put_p99_s"] = round(p99, 6)
+        deliver = self.tsdb.quantile_over_window(
+            "edl_coord_watch_delivery_seconds", 0.99, w)
+        if deliver is not None:
+            coord["watch_delivery_p99_s"] = round(deliver, 6)
+        return coord
 
     def _gateway_summary(self, parsed: dict) -> dict:
         """Gateway p50/p99 over the trailing quantile window when the

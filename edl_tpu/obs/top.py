@@ -87,6 +87,21 @@ def render_top(health: dict, alerts: dict | None = None,
             f"productive={_fmt_s(gp.get('productive_s'))} "
             f"observed={_fmt_s(gp.get('observed_s'))}"
             f"{('  badput: ' + badline) if badline else ''}")
+    co = health.get("coord")
+    if co:
+        # control-plane pane: only present when the coord server's own
+        # /metrics rides the merged page (edl-coord --job_id self-advert)
+        lines.append(
+            f"  coord: ops={_fmt_num(co.get('ops_total'))}"
+            f"{'' if co.get('ops_per_s') is None else '  ops/s=' + _fmt_num(co.get('ops_per_s'))} "
+            f" put_p99={_fmt_s(co.get('put_p99_s'))} "
+            f"watchers={_fmt_num(co.get('watchers'))} "
+            f"deliver_p99={_fmt_s(co.get('watch_delivery_p99_s'))}")
+        lines.append(
+            f"         leases={_fmt_num(co.get('leases_live'))} "
+            f"swept={_fmt_num(co.get('leases_swept'))} "
+            f"conns={_fmt_num(co.get('open_connections'))} "
+            f"inflight={_fmt_num(co.get('inflight_requests'))}")
     rb = health.get("robustness")
     if rb:
         lines.append(

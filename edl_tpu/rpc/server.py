@@ -48,6 +48,19 @@ _REQUEST_SECONDS = obs_metrics.histogram(
     ("method",))
 _ERRORS_TOTAL = obs_metrics.counter(
     "edl_rpc_errors_total", "RPC handler exceptions, by method", ("method",))
+# connection-level queue depth (doc/scale.md): one thread per
+# established connection, so open connections bound the server's thread
+# count, and in-flight requests say how many of those threads are
+# executing a handler right now (the rest are parked in recv) — a
+# coord server whose in-flight count tracks its watcher count is
+# spending its threads on long-poll wait()s, not on op service
+_OPEN_CONNECTIONS_G = obs_metrics.gauge(
+    "edl_rpc_open_connections",
+    "Established RPC connections on this process's servers")
+_INFLIGHT_REQUESTS_G = obs_metrics.gauge(
+    "edl_rpc_inflight_requests",
+    "RPC requests currently executing a handler (includes blocked "
+    "long-poll `wait` calls)")
 
 
 class Streaming:
@@ -78,6 +91,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     "r": None})
                 continue
             t0 = time.perf_counter()
+            _INFLIGHT_REQUESTS_G.inc()
             # re-establish the caller's trace context for the handler:
             # spans it emits (and RPCs it makes) join the caller's
             # trace.  attach/detach is per-thread, and this thread
@@ -104,6 +118,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     # StopIteration is end-of-data protocol, not a fault
                     _ERRORS_TOTAL.labels(method=method).inc()
             finally:
+                _INFLIGHT_REQUESTS_G.inc(-1)
                 if token is not None:
                     obs_context.detach(token)
             _REQUEST_SECONDS.labels(method=method).observe(
@@ -161,11 +176,15 @@ class _TcpServer(socketserver.ThreadingTCPServer):
     def process_request(self, request, client_address):
         with self._active_lock:
             self._active.add(request)
+        _OPEN_CONNECTIONS_G.inc()
         super().process_request(request, client_address)
 
     def shutdown_request(self, request):
         with self._active_lock:
+            was_active = request in self._active
             self._active.discard(request)
+        if was_active:  # guard double-shutdown: the gauge must not drift
+            _OPEN_CONNECTIONS_G.inc(-1)
         super().shutdown_request(request)
 
     def close_active(self) -> None:

@@ -1,0 +1,38 @@
+"""Control-plane scale observatory: the fleet-simulation harness.
+
+How many pods can ONE coordination server + ONE observability
+aggregator carry?  Every elastic subsystem in this repo funnels its
+control traffic through the same narrow waist — TTL-leased adverts,
+heartbeats, registry/session-pin/demand writes, ``wait()`` watches,
+/metrics scrapes — and none of the per-subsystem tests exercise that
+waist at fleet scale.  This package does, without spending a single
+accelerator: N lightweight **pod actors** (no trainers, no jax) drive
+a *real* durable coordination server and a *real* aggregator with the
+exact op mix a pod produces, sweeping N across decades (10/100/1000+
+fit one dev box: actors share a small client pool and a thread pool,
+with budgeted op rates).
+
+Each sweep emits one ``SIM_r*.json`` artifact carrying five signal
+curves (latency vs N):
+
+1. **membership propagation** — write -> observed, long-poll ``wait()``
+   watch vs ``get_prefix`` polling (the before/after of the
+   aggregator's discovery conversion, obs/advert.py);
+2. **coord op latency** by op and key table (client-side, cross-checked
+   against the server's ``edl_coord_op_seconds``);
+3. **lease-sweep duration** vs live-lease count
+   (``edl_coord_lease_sweep_seconds``);
+4. **aggregator scrape-cycle** wall time + staleness vs target count;
+5. **alert -> remediation dispatch** latency through a real RuleEngine.
+
+``python -m edl_tpu.sim`` runs the sweep; ``python -m
+edl_tpu.sim.report`` renders per-signal latency-vs-N tables with
+fitted growth exponents and flags super-linear signals.  Design notes
+and baseline curves: doc/scale.md.
+"""
+
+from edl_tpu.sim.actor import OpRecorder, PodActor, TimedStore
+from edl_tpu.sim.harness import FleetSim, SimConfig, run_sweep
+
+__all__ = ["FleetSim", "OpRecorder", "PodActor", "SimConfig",
+           "TimedStore", "run_sweep"]

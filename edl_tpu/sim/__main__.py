@@ -1,0 +1,62 @@
+"""``python -m edl_tpu.sim``: run a fleet-simulation sweep.
+
+Boots one real durable coordination server, sweeps N pod actors across
+the requested decades, writes the ``SIM_r*.json`` artifact, and prints
+the rendered report (``edl_tpu.sim.report``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import sys
+
+from edl_tpu.sim.harness import SimConfig, run_sweep
+from edl_tpu.sim.report import render_report
+from edl_tpu.utils.logger import configure
+
+
+def _next_artifact_path() -> str:
+    taken = set(glob.glob("SIM_r*.json"))
+    for i in range(1, 100):
+        path = f"SIM_r{i:02d}.json"
+        if path not in taken:
+            return path
+    return "SIM_r99.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl_tpu.sim",
+        description="Fleet-simulation sweep: N pod actors vs one real "
+                    "coordination server + aggregator (doc/scale.md)")
+    p.add_argument("--ns", default="10,100,1000",
+                   help="comma-separated fleet sizes to sweep")
+    p.add_argument("--round_s", type=float, default=20.0,
+                   help="driven-load seconds per fleet size")
+    p.add_argument("--ttl", type=float, default=10.0,
+                   help="actor lease TTL (seconds)")
+    p.add_argument("--heartbeat_period", type=float, default=2.0)
+    p.add_argument("--clients", type=int, default=8,
+                   help="shared RPC client pool size")
+    p.add_argument("--stub_servers", type=int, default=8,
+                   help="/metrics stub servers fronting the fleet")
+    p.add_argument("--job_id", default="fleet-sim")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default: next free SIM_r*.json)")
+    args = p.parse_args(argv)
+    configure()
+    cfg = SimConfig(
+        ns=tuple(int(n) for n in args.ns.split(",") if n.strip()),
+        round_s=args.round_s, ttl=args.ttl,
+        heartbeat_period=args.heartbeat_period, clients=args.clients,
+        stub_servers=args.stub_servers, job_id=args.job_id)
+    out = args.out or _next_artifact_path()
+    artifact = run_sweep(cfg, out_path=out)
+    print(f"# {out}")
+    print(render_report(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Pod actors: the control-plane footprint of one pod, without the pod.
+
+A real pod touches the coordination store in a small, regular pattern —
+a TTL-leased resource advert kept alive by its :class:`CoordSession`,
+periodic heartbeat and status writes, occasional cluster-spec reads.
+:class:`PodActor` reproduces exactly that op mix (and nothing else: no
+trainer, no devices), cheap enough that a thousand of them fit one dev
+box.  Every store op flows through a :class:`TimedStore`, so the
+harness gets client-side latency by op and key table for free — the
+same (op, table) split the server exports as ``edl_coord_op_seconds``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.kv import KVStore
+from edl_tpu.coord.session import CoordSession
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_TABLES = frozenset(constants.ALL_TABLES)
+
+
+def table_of_key(key: str) -> str:
+    """Key table under the canonical ``/edl_tpu/<job>/<table>/<name>``
+    schema; "other" for foreign shapes, "" for key-less ops — the same
+    bounded-cardinality rule the server applies (coord/server.py)."""
+    if not key:
+        return ""
+    if key.startswith(paths.ROOT + "/"):
+        parts = key.split("/", 4)
+        if len(parts) >= 4 and parts[3] in _TABLES:
+            return parts[3]
+    return "other"
+
+
+class OpRecorder:
+    """Thread-safe (op, table) -> durations sink shared by every actor.
+
+    Append-only under a lock (durations are floats, appends are
+    nanoseconds — nothing blocking ever runs under it); the harness
+    drains with :meth:`snapshot` at round end."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._durations: dict[tuple[str, str], list[float]] = {}
+        self._failures: dict[tuple[str, str], int] = {}
+
+    def record(self, op: str, table: str, seconds: float,
+               failed: bool = False) -> None:
+        k = (op, table)
+        with self._lock:
+            if failed:
+                self._failures[k] = self._failures.get(k, 0) + 1
+            else:
+                self._durations.setdefault(k, []).append(seconds)
+
+    def snapshot(self, reset: bool = False
+                 ) -> tuple[dict[tuple[str, str], list[float]],
+                            dict[tuple[str, str], int]]:
+        with self._lock:
+            durations = {k: list(v) for k, v in self._durations.items()}
+            failures = dict(self._failures)
+            if reset:
+                self._durations.clear()
+                self._failures.clear()
+        return durations, failures
+
+    @property
+    def failure_count(self) -> int:
+        with self._lock:
+            return sum(self._failures.values())
+
+
+class TimedStore(KVStore):
+    """KVStore proxy that times every op into an :class:`OpRecorder`.
+
+    Actors (and their CoordSessions) are handed one of these instead of
+    the raw client, so the whole simulated op mix — keepalives
+    included — lands in signal 2 without any per-call bookkeeping in
+    the actors themselves."""
+
+    def __init__(self, inner: KVStore, recorder: OpRecorder):
+        self._inner = inner
+        self._recorder = recorder
+
+    def _timed(self, op: str, table: str, fn, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self._recorder.record(op, table, time.perf_counter() - t0,
+                                  failed=True)
+            raise
+        self._recorder.record(op, table, time.perf_counter() - t0)
+        return result
+
+    # -- kv ----------------------------------------------------------------
+    def put(self, key, value, lease_id=0):
+        return self._timed("put", table_of_key(key),
+                           self._inner.put, key, value, lease_id)
+
+    def get(self, key):
+        return self._timed("get", table_of_key(key), self._inner.get, key)
+
+    def get_prefix(self, prefix):
+        return self._timed("get_prefix", table_of_key(prefix),
+                           self._inner.get_prefix, prefix)
+
+    def delete(self, key):
+        return self._timed("delete", table_of_key(key),
+                           self._inner.delete, key)
+
+    def delete_prefix(self, prefix):
+        return self._timed("delete_prefix", table_of_key(prefix),
+                           self._inner.delete_prefix, prefix)
+
+    # -- leases ------------------------------------------------------------
+    def lease_grant(self, ttl):
+        return self._timed("lease_grant", "", self._inner.lease_grant, ttl)
+
+    def lease_keepalive(self, lease_id):
+        return self._timed("lease_keepalive", "",
+                           self._inner.lease_keepalive, lease_id)
+
+    def lease_revoke(self, lease_id):
+        return self._timed("lease_revoke", "",
+                           self._inner.lease_revoke, lease_id)
+
+    # -- transactions ------------------------------------------------------
+    def put_if_absent(self, key, value, lease_id=0):
+        return self._timed("put_if_absent", table_of_key(key),
+                           self._inner.put_if_absent, key, value, lease_id)
+
+    def put_if_equals(self, guard_key, guard_value, key, value, lease_id=0):
+        return self._timed("put_if_equals", table_of_key(key),
+                           self._inner.put_if_equals, guard_key, guard_value,
+                           key, value, lease_id)
+
+    # -- watches: passed through untimed on purpose — a long poll's
+    # latency is its timeout, and folding it into signal 2 would bury
+    # every real op (the server's own histogram keeps `wait` separate)
+    def wait(self, prefix, since_revision, timeout):
+        return self._inner.wait(prefix, since_revision, timeout)
+
+
+class PodActor:
+    """One simulated pod: a leased resource advert + the periodic write
+    mix, driven externally by :meth:`tick` (the harness owns the thread
+    pool and the op-rate budget; the only thread an actor owns is its
+    CoordSession's keepalive — which is the load being measured)."""
+
+    def __init__(self, store: KVStore, job_id: str, pod_id: str,
+                 ttl: float = 10.0, heartbeat_period: float = 2.0,
+                 status_period: float = 5.0, read_period: float = 4.0):
+        self.store = store
+        self.job_id = job_id
+        self.pod_id = pod_id
+        self.ttl = ttl
+        self._heartbeat_period = heartbeat_period
+        self._status_period = status_period
+        self._read_period = read_period
+        self.session: CoordSession | None = None
+        self._beats = 0
+        self._ticking = False
+        # phase-offset the periodic work per actor so N actors spread
+        # over the period instead of thundering together each tick
+        offset = (hash(pod_id) % 1000) / 1000.0
+        now = time.monotonic()
+        self._next_heartbeat = now + offset * heartbeat_period
+        self._next_status = now + offset * status_period
+        self._next_read = now + offset * read_period
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "PodActor":
+        """Grant the lease + put the resource advert (CoordSession's
+        seize-before-thread path), exactly like a pod joining."""
+        payload = json.dumps({"pod_id": self.pod_id, "pid": os.getpid(),
+                              "sim": True}).encode()
+        self.session = CoordSession(
+            self.store, ttl=self.ttl, name=f"sim:{self.pod_id}",
+            initial=(paths.key(self.job_id, constants.ETCD_POD_RESOURCE,
+                               self.pod_id),
+                     payload, False))
+        return self
+
+    def stop(self) -> None:
+        s, self.session = self.session, None
+        if s is not None:
+            s.close()
+
+    def advertise_metrics(self, endpoint: str) -> None:
+        """Ride the session lease with an obs /metrics advert pointing
+        at one of the harness's stub exposition servers — this is what
+        makes the actor a target the real Aggregator discovers and
+        scrapes (signal 4)."""
+        if self.session is None:
+            raise RuntimeError("actor not started")
+        payload = {"endpoint": endpoint, "component": "sim-pod",
+                   "pid": os.getpid(), "ts": time.time()}
+        self.session.register(
+            paths.key(self.job_id, constants.ETCD_OBS,
+                      f"metrics/{self.pod_id}"),
+            json.dumps(payload).encode())
+
+    # -- periodic op mix ----------------------------------------------------
+    def tick(self, now: float | None = None) -> None:
+        """Run whatever periodic work is due; cheap no-op otherwise.
+        Store errors are swallowed (the TimedStore already counted the
+        failure; a sim actor must never take down the scheduler)."""
+        now = time.monotonic() if now is None else now
+        # non-blocking re-entry guard: a pool backlog can re-submit an
+        # actor whose previous tick is still on the wire; skipping beats
+        # doubling its op budget (check-then-set is benignly racy — a
+        # rare duplicate tick only adds one extra put)
+        if self._ticking:
+            return
+        self._ticking = True
+        try:
+            if now >= self._next_heartbeat:
+                self._next_heartbeat = now + self._heartbeat_period
+                self._beats += 1
+                self.store.put(
+                    paths.key(self.job_id, constants.ETCD_HEARTBEAT,
+                              self.pod_id),
+                    json.dumps({"beat": self._beats,
+                                "ts": time.time()}).encode())
+            if now >= self._next_status:
+                self._next_status = now + self._status_period
+                self.store.put(
+                    paths.key(self.job_id, constants.ETCD_TRAIN_STATUS,
+                              self.pod_id),
+                    json.dumps({"step": self._beats,
+                                "state": "running"}).encode())
+            if now >= self._next_read:
+                self._next_read = now + self._read_period
+                # FleetView-style read: the cluster-spec singleton every
+                # pod re-reads (a get, not a prefix scan — pods do not
+                # scan tables, observers and aggregators do)
+                self.store.get(paths.key(self.job_id, constants.ETCD_CLUSTER,
+                                         "spec"))
+        except Exception as e:  # noqa: BLE001 — counted by TimedStore
+            logger.debug("actor %s tick error: %s", self.pod_id, e)
+        finally:
+            self._ticking = False
+
+    def next_due(self) -> float:
+        return min(self._next_heartbeat, self._next_status, self._next_read)

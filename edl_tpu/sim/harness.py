@@ -1,0 +1,588 @@
+"""Fleet-simulation harness: N pod actors vs one real control plane.
+
+One :class:`FleetSim` round boots a **real durable coordination
+server** (subprocess, WAL-backed, /metrics enabled), ramps N
+:class:`~edl_tpu.sim.actor.PodActor`\\ s against it through a small
+shared client pool, and drives a **real Aggregator** (watch-based
+discovery, TSDB, rule engine) over the fleet's TTL-leased adverts —
+then measures the five scale signals (see package docstring) and
+appends one round record to the sweep artifact.
+
+Budgets make 1000 actors fit one dev box: actors own no threads except
+their CoordSession keepalive (which IS simulated load), periodic work
+runs on one bounded thread pool, and every actor rides one of a handful
+of pooled RPC clients.  ``run_sweep`` sweeps N across decades and
+writes ``SIM_r*.json``; render it with ``python -m edl_tpu.sim.report``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.coord.server import spawn_subprocess, wait_ready
+from edl_tpu.obs import advert
+from edl_tpu.obs.metrics import parse_exposition
+from edl_tpu.sim.actor import OpRecorder, PodActor, TimedStore
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+from edl_tpu.utils.network import find_free_port
+
+logger = get_logger(__name__)
+
+SCHEMA = "edl-sim/1"
+
+# one marker key, written under the RESOURCE table on purpose: poll
+# observers must pay the same O(N)-record prefix scan a polling
+# discoverer pays, while watch observers ride event delivery (that
+# contrast IS signal 1)
+_MARKER = "__marker__"
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """Knobs for one sweep; every rate is per actor."""
+
+    ns: tuple = (10, 100, 1000)
+    job_id: str = "fleet-sim"
+    round_s: float = 20.0          # driven-load window per N
+    ttl: float = 10.0              # actor lease TTL (sim-scale, not prod 15)
+    heartbeat_period: float = 2.0
+    status_period: float = 5.0
+    read_period: float = 4.0
+    clients: int = 8               # shared RPC client pool
+    tick_workers: int = 32         # thread pool driving actor ticks
+    ramp_workers: int = 16         # bounded actor start/stop parallelism
+    # fleet-wide op budgets: per-actor periods STRETCH once N exceeds
+    # what the budget allows, so total driven load stays ~constant
+    # across decades (this is what makes 1000 actors fit one dev box —
+    # and what keeps the propagation curves measuring the control
+    # plane's scaling, not the sim box's CPU saturation)
+    hb_budget_ops_s: float = 120.0
+    keepalive_budget_ops_s: float = 60.0
+    watch_observers: int = 2       # signal 1, long-poll wait()
+    poll_observers: int = 2        # signal 1, get_prefix scans
+    propagation_trials: int = 8
+    stub_servers: int = 8          # /metrics stubs fronting the fleet
+    scrape_cycles: int = 3         # signal 4 samples per round
+    alert_trials: int = 2          # signal 5 samples per round
+    scrape_timeout: float = 5.0
+    data_dir: str = ""             # coord WAL dir; empty = tmp
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def latency_stats(vals: list[float]) -> dict:
+    """The per-signal summary shape every curve in the artifact uses."""
+    s = sorted(vals)
+    if not s:
+        return {"samples": 0}
+    return {"samples": len(s),
+            "mean_s": round(sum(s) / len(s), 6),
+            "p50_s": round(_percentile(s, 0.50), 6),
+            "p95_s": round(_percentile(s, 0.95), 6),
+            "p99_s": round(_percentile(s, 0.99), 6),
+            "max_s": round(s[-1], 6)}
+
+
+class _StubPage:
+    """Mutable exposition page shared by one stub server's handlers."""
+
+    def __init__(self, name: str):
+        self._lock = threading.Lock()
+        self._fault = 0.0
+        self._name = name
+
+    def set_fault(self, value: float) -> None:
+        with self._lock:
+            self._fault = value
+
+    def render(self) -> bytes:
+        with self._lock:
+            fault = self._fault
+        return (
+            "# HELP edl_sim_heartbeats_total Simulated pod heartbeats\n"
+            "# TYPE edl_sim_heartbeats_total counter\n"
+            f'edl_sim_heartbeats_total{{stub="{self._name}"}} 1\n'
+            "# HELP edl_sim_fault Simulated fault flag (alert signal)\n"
+            "# TYPE edl_sim_fault gauge\n"
+            f"edl_sim_fault {fault:g}\n").encode()
+
+
+def _start_stub(name: str) -> tuple[ThreadingHTTPServer, _StubPage, str]:
+    """One tiny /metrics HTTP stub; N adverts point at K of these
+    round-robin, so the Aggregator pays N fetches (the scrape fan-out
+    cost under test) against K cheap local servers."""
+    page = _StubPage(name)
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            body = page.render()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102 — silence per-request spam
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name=f"sim-stub:{name}")
+    t.start()
+    return srv, page, f"127.0.0.1:{srv.server_address[1]}"
+
+
+class _PropagationProbe:
+    """Signal 1 bookkeeping: one write, many observers, first-seen
+    stamps per observation mode."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._token = b""
+        self._t0 = 0.0
+        self.latencies: dict[str, list[float]] = {"watch": [], "poll": []}
+
+    def arm(self, token: bytes, t0: float) -> None:
+        with self._lock:
+            self._token = token
+            self._t0 = t0
+
+    def observe(self, mode: str, value: bytes, t_seen: float) -> None:
+        """Stamp one observation of the CURRENT trial token.  Each
+        observer reports each token once by construction (watchers see
+        one put event, pollers dedupe on value change), so every call
+        that matches is one propagation sample."""
+        with self._lock:
+            if self._token and value == self._token:
+                self.latencies[mode].append(t_seen - self._t0)
+
+
+class FleetSim:
+    """One coordination server + one aggregator, swept across fleet
+    sizes.  ``run()`` returns the artifact dict (and writes it when
+    ``out_path`` is given)."""
+
+    def __init__(self, config: SimConfig | None = None):
+        self.config = config or SimConfig()
+        self.recorder = OpRecorder()
+        self._proc = None
+        self._endpoint = ""
+        self._tmpdir = None
+
+    # -- control-plane lifecycle -------------------------------------------
+    def start_control_plane(self) -> str:
+        """Boot the durable coord server subprocess with its /metrics
+        endpoint enabled and self-advertised into its own store."""
+        cfg = self.config
+        data_dir = cfg.data_dir
+        if not data_dir:
+            import tempfile
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="edl-sim-")
+            data_dir = self._tmpdir.name
+        port = find_free_port()
+        env = dict(os.environ)
+        env["EDL_TPU_METRICS_PORT"] = "0"   # OS-assigned; advert carries it
+        env["EDL_TPU_JOB_ID"] = cfg.job_id  # coord self-advert (obs table)
+        env.pop("EDL_TPU_METRICS_DIR", None)
+        self._proc = spawn_subprocess(port, data_dir, env=env)
+        self._endpoint = f"127.0.0.1:{port}"
+        wait_ready(self._endpoint, deadline_s=60.0)
+        return self._endpoint
+
+    def stop_control_plane(self) -> None:
+        p, self._proc = self._proc, None
+        if p is not None:
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                p.kill()
+                p.wait(timeout=10)
+        td, self._tmpdir = self._tmpdir, None
+        if td is not None:
+            td.cleanup()
+
+    def _coord_metrics_endpoint(self, store) -> str:
+        """The coord server's self-adverted /metrics endpoint."""
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            for payload in advert.list_metrics_targets(
+                    store, self.config.job_id).values():
+                if payload.get("component") == "coord":
+                    return str(payload["endpoint"])
+            time.sleep(0.2)
+        raise TimeoutError("coord server never advertised its /metrics "
+                           "endpoint (EDL_TPU_METRICS_PORT not honored?)")
+
+    @staticmethod
+    def _scrape(endpoint: str) -> dict:
+        text = urllib.request.urlopen(f"http://{endpoint}/metrics",
+                                      timeout=5.0).read().decode()
+        return parse_exposition(text)
+
+    @staticmethod
+    def _sample_sum(parsed: dict, name: str) -> float:
+        return sum(v for (n, _l), v in parsed.items() if n == name)
+
+    # -- one round ----------------------------------------------------------
+    def _budgeted_periods(self, n: int) -> tuple[float, float, float, float]:
+        """(heartbeat, status, read, ttl) for fleet size ``n`` under the
+        configured fleet-wide op budgets: once ``n`` heartbeats at the
+        base period would exceed ``hb_budget_ops_s``, every actor period
+        stretches by the same factor — the fleet's total driven op rate
+        plateaus instead of scaling with N (so large-N rounds measure
+        the control plane, not the sim box saturating itself).  The TTL
+        stretches the same way against ``keepalive_budget_ops_s``."""
+        cfg = self.config
+        stretch = max(1.0, (n / cfg.hb_budget_ops_s) / cfg.heartbeat_period)
+        ttl = max(cfg.ttl, n / (cfg.keepalive_budget_ops_s
+                                * constants.TTL_REFRESH_FRACTION))
+        return (cfg.heartbeat_period * stretch, cfg.status_period * stretch,
+                cfg.read_period * stretch, ttl)
+
+    def run_round(self, n: int) -> dict:
+        cfg = self.config
+        store = CoordClient(self._endpoint, timeout=30.0)
+        clients = [CoordClient(self._endpoint, timeout=30.0)
+                   for _ in range(max(1, cfg.clients))]
+        timed = [TimedStore(c, self.recorder) for c in clients]
+        observers = [CoordClient(self._endpoint, timeout=30.0)
+                     for _ in range(cfg.watch_observers + cfg.poll_observers)]
+        stubs = [_start_stub(f"stub-{i}") for i in range(cfg.stub_servers)]
+        actors: list[PodActor] = []
+        halt = threading.Event()
+        agg = None
+        try:
+            self.recorder.snapshot(reset=True)
+            coord_metrics = self._coord_metrics_endpoint(store)
+            hb_p, st_p, rd_p, ttl = self._budgeted_periods(n)
+
+            # -- ramp N actors (bounded parallelism) + obs adverts -------
+            for i in range(n):
+                actors.append(PodActor(
+                    timed[i % len(timed)], cfg.job_id, f"pod-{i:04d}",
+                    ttl=ttl, heartbeat_period=hb_p, status_period=st_p,
+                    read_period=rd_p))
+            t_ramp = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=cfg.ramp_workers) as pool:
+                list(pool.map(lambda a: a.start(), actors))
+                list(pool.map(
+                    lambda ia: ia[1].advertise_metrics(
+                        stubs[ia[0] % len(stubs)][2]),
+                    enumerate(actors)))
+            ramp_s = time.perf_counter() - t_ramp
+
+            # -- aggregator over the fleet (watch discovery, rule engine)
+            from edl_tpu.obs.agg import Aggregator
+            from edl_tpu.obs.rules import Rule
+            dispatch_stamps: list[float] = []
+
+            def _dispatch_action(rule, group, value):
+                dispatch_stamps.append(time.perf_counter())
+                return "ok"
+
+            agg = Aggregator(
+                store, cfg.job_id, scrape_timeout=cfg.scrape_timeout,
+                cache_s=0.0, include_self=False, scrape_interval=0,
+                incident_dir="", enable_actions=True,
+                rules=[Rule("sim-fault", kind="gauge", metric="edl_sim_fault",
+                            op=">", threshold=0.5, for_s=0.0, agg="max",
+                            severity="critical", action="sim-dispatch",
+                            summary="simulated fault flag raised")])
+            agg.engine.actions["sim-dispatch"] = _dispatch_action
+
+            driver = threading.Thread(target=self._drive_actors,
+                                      args=(actors, halt),
+                                      daemon=True, name="sim-driver")
+            driver.start()
+            metrics_before = self._scrape(coord_metrics)
+
+            # The round's signals are measured in SEPARATED phases under
+            # the same steady actor load: on a small sim box the poll
+            # observers' O(N) scans and the aggregator's scrape burst
+            # are CPU-heavy enough to pollute concurrent watch-delivery
+            # stamps — phase separation keeps each curve measuring the
+            # control plane, not cross-signal interference in the
+            # client process.
+            probe = _PropagationProbe()
+            resource_prefix = paths.table_prefix(
+                cfg.job_id, constants.ETCD_POD_RESOURCE)
+            marker_key = paths.key(cfg.job_id, constants.ETCD_POD_RESOURCE,
+                                   _MARKER)
+            phase_s = cfg.round_s * 0.35
+
+            # phase 1: watch propagation (long-poll observers only)
+            self._propagation_phase(
+                store, probe, "watch",
+                [lambda h, c=observers[i]: self._watch_observer(
+                    c, resource_prefix, marker_key, probe, h)
+                 for i in range(cfg.watch_observers)],
+                marker_key, phase_s, cfg.propagation_trials)
+
+            # phase 2: poll propagation (tight get_prefix observers only)
+            self._propagation_phase(
+                store, probe, "poll",
+                [lambda h, c=observers[cfg.watch_observers + i]:
+                 self._poll_observer(c, resource_prefix, marker_key, probe, h)
+                 for i in range(cfg.poll_observers)],
+                marker_key, phase_s, cfg.propagation_trials)
+
+            # phase 3: aggregator scrape cycles + alert dispatch trials
+            scrape_cycles: list[dict] = []
+            for _c in range(cfg.scrape_cycles):
+                t0 = time.perf_counter()
+                agg.scrape_once()
+                wall = time.perf_counter() - t0
+                _merged, info = agg.collect()
+                scrape_cycles.append({
+                    "wall_s": round(wall, 6),
+                    "targets": len(info["targets"]),
+                    "errors": len(info["errors"])})
+                time.sleep(0.5)
+            alert_latencies: list[float] = []
+            for _trial in range(cfg.alert_trials):
+                stubs[0][1].set_fault(1.0)
+                seen = len(dispatch_stamps)
+                t0 = time.perf_counter()
+                agg.scrape_once()
+                if len(dispatch_stamps) > seen:
+                    alert_latencies.append(dispatch_stamps[-1] - t0)
+                stubs[0][1].set_fault(0.0)
+                agg.scrape_once()  # clear the firing state between trials
+                time.sleep(0.25)
+
+            metrics_after = self._scrape(coord_metrics)
+            halt.set()
+            driver.join(timeout=10.0)
+
+            return self._round_record(n, ramp_s, probe, scrape_cycles,
+                                      alert_latencies, metrics_before,
+                                      metrics_after,
+                                      budget={"heartbeat_period_s": hb_p,
+                                              "ttl_s": ttl})
+        finally:
+            halt.set()
+            if agg is not None:
+                agg.stop_loop()
+            with ThreadPoolExecutor(max_workers=cfg.ramp_workers) as pool:
+                list(pool.map(lambda a: a.stop(), actors))
+            for table in (constants.ETCD_HEARTBEAT, constants.ETCD_TRAIN_STATUS,
+                          constants.ETCD_POD_RESOURCE):
+                try:
+                    store.delete_prefix(
+                        paths.table_prefix(cfg.job_id, table))
+                except Exception as e:  # teardown best-effort
+                    logger.debug("sim: cleanup of %s table failed: %s",
+                                 table, e)
+            for srv, _page, _ep in stubs:
+                srv.shutdown()
+                srv.server_close()
+            for c in clients + observers + [store]:
+                c.close()
+
+    # -- round workers ------------------------------------------------------
+    @staticmethod
+    def _drive_actors(actors: list[PodActor], halt: threading.Event) -> None:
+        """Budgeted tick scheduler: one bounded pool runs whatever is
+        due each 50 ms slice — N actors never mean N op threads."""
+        with ThreadPoolExecutor(max_workers=32) as pool:
+            while not halt.is_set():
+                now = time.monotonic()
+                due = [a for a in actors if a.next_due() <= now]
+                for a in due:
+                    pool.submit(a.tick, now)
+                halt.wait(0.05)
+
+    @staticmethod
+    def _watch_observer(client: CoordClient, prefix: str, marker_key: str,
+                        probe: _PropagationProbe,
+                        halt: threading.Event) -> None:
+        """Long-poll wait() loop — membership propagation as a watcher
+        sees it.  Resyncs through snapshots like every real consumer."""
+        rev = 0
+        while not halt.is_set():
+            try:
+                res = client.wait(prefix, rev, 1.0)
+            except Exception:  # noqa: BLE001 — server blip: retry
+                halt.wait(0.2)
+                continue
+            t_seen = time.perf_counter()
+            rev = res.revision
+            for ev in res.events:
+                if ev.record.key == marker_key and ev.type == "put":
+                    probe.observe("watch", ev.record.value, t_seen)
+
+    @staticmethod
+    def _poll_observer(client: CoordClient, prefix: str, marker_key: str,
+                       probe: _PropagationProbe,
+                       halt: threading.Event) -> None:
+        """Tight get_prefix loop — membership propagation as a poller
+        sees it, paying the full O(N)-record table scan per probe."""
+        last_seen = b""
+        while not halt.is_set():
+            try:
+                recs, _rev = client.get_prefix(prefix)
+            except Exception:  # noqa: BLE001 — server blip: retry
+                halt.wait(0.2)
+                continue
+            t_seen = time.perf_counter()
+            for rec in recs:
+                if rec.key == marker_key and rec.value != last_seen:
+                    last_seen = rec.value
+                    probe.observe("poll", rec.value, t_seen)
+            halt.wait(0.005)
+
+    def _propagation_phase(self, store, probe: _PropagationProbe, mode: str,
+                           observer_fns: list, marker_key: str,
+                           phase_s: float, trials: int) -> None:
+        """One mode's propagation measurement: start that mode's
+        observers, write ``trials`` marker tokens spaced over the
+        phase, stop the observers.  The marker rides the resource table
+        so poll observers pay the same O(N)-record scan a polling
+        discoverer pays."""
+        probe.arm(b"", 0.0)  # a residual marker from the previous phase
+        # must not match while this phase's observers take their first
+        # look (a poll observer's initial scan "sees" whatever value is
+        # still there)
+        h = threading.Event()
+        threads = []
+        for i, fn in enumerate(observer_fns):
+            t = threading.Thread(target=fn, args=(h,), daemon=True,
+                                 name=f"sim-{mode}-{i}")
+            t.start()
+            threads.append(t)
+        time.sleep(0.2)  # observers establish (first wait/scan in flight)
+        gap = max(0.05, phase_s / (trials + 1))
+        for i in range(trials):
+            time.sleep(gap)
+            token = f"{mode}-trial-{i}".encode()
+            probe.arm(token, time.perf_counter())
+            try:
+                store.put(marker_key, token)
+            except Exception:  # noqa: BLE001 — server blip: skip trial
+                logger.debug("marker write %s/%d failed", mode, i,
+                             exc_info=True)
+        time.sleep(min(1.0, gap))  # let the final trial land
+        h.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+    # -- artifact assembly --------------------------------------------------
+    def _round_record(self, n: int, ramp_s: float, probe: _PropagationProbe,
+                      scrape_cycles: list[dict],
+                      alert_latencies: list[float],
+                      before: dict, after: dict,
+                      budget: dict | None = None) -> dict:
+        durations, failures = self.recorder.snapshot(reset=True)
+        ops = {}
+        for (op, table), vals in sorted(durations.items()):
+            key = f"{op}/{table}" if table else op
+            ops[key] = latency_stats(vals)
+
+        def delta(name: str) -> float:
+            return self._sample_sum(after, name) - self._sample_sum(
+                before, name)
+
+        sweeps = delta("edl_coord_lease_sweep_seconds_count")
+        sweep_sum = delta("edl_coord_lease_sweep_seconds_sum")
+        deliveries = delta("edl_coord_watch_delivery_seconds_count")
+        delivery_sum = delta("edl_coord_watch_delivery_seconds_sum")
+        appends = delta("edl_coord_wal_append_seconds_count")
+        append_sum = delta("edl_coord_wal_append_seconds_sum")
+        walls = [c["wall_s"] for c in scrape_cycles]
+        return {
+            "n": n,
+            "ramp_s": round(ramp_s, 3),
+            "budget": {k: round(v, 3) for k, v in (budget or {}).items()},
+            "op_failures": sum(failures.values()),
+            "propagation": {
+                "watch": latency_stats(probe.latencies["watch"]),
+                "poll": latency_stats(probe.latencies["poll"]),
+            },
+            "ops": ops,
+            "lease_sweep": {
+                "sweeps": int(sweeps),
+                "mean_s": round(sweep_sum / sweeps, 6) if sweeps else None,
+                "leases_live": self._sample_sum(after,
+                                                "edl_coord_leases_live"),
+                "swept": delta("edl_coord_leases_swept_total"),
+            },
+            "watch_server": {
+                "watchers_last": self._sample_sum(after,
+                                                  "edl_coord_watchers"),
+                "wakeups": delta("edl_coord_watch_wakeups_total"),
+                "delivery_mean_s": (round(delivery_sum / deliveries, 6)
+                                    if deliveries else None),
+            },
+            "wal": {
+                "appends": int(appends),
+                "append_mean_s": (round(append_sum / appends, 6)
+                                  if appends else None),
+            },
+            "rpc": {
+                "open_connections": self._sample_sum(
+                    after, "edl_rpc_open_connections"),
+                "inflight": self._sample_sum(after,
+                                             "edl_rpc_inflight_requests"),
+            },
+            "scrape": {
+                "cycles": scrape_cycles,
+                "mean_wall_s": (round(sum(walls) / len(walls), 6)
+                                if walls else None),
+                # data age the instant a cycle publishes: everything it
+                # merged was fetched at cycle start, so staleness == the
+                # cycle's own wall time (plus however long until the
+                # next cycle runs — interval-dependent, reported per N
+                # as the floor)
+                "staleness_floor_s": (round(max(walls), 6)
+                                      if walls else None),
+            },
+            "alert_dispatch": latency_stats(alert_latencies),
+        }
+
+    # -- sweep --------------------------------------------------------------
+    def run(self, out_path: str | None = None) -> dict:
+        cfg = self.config
+        artifact = {
+            "schema": SCHEMA,
+            "job_id": cfg.job_id,
+            "ts": time.time(),
+            "host": {"cpus": os.cpu_count() or 1},
+            "config": dataclasses.asdict(cfg),
+            "rounds": [],
+        }
+        self.start_control_plane()
+        try:
+            for n in cfg.ns:
+                logger.info("sim round: n=%d", n)
+                artifact["rounds"].append(self.run_round(int(n)))
+        finally:
+            self.stop_control_plane()
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(artifact, f, indent=1, sort_keys=True)
+            logger.info("sim artifact written: %s", out_path)
+        return artifact
+
+
+def run_sweep(config: SimConfig | None = None,
+              out_path: str | None = None) -> dict:
+    """One-call sweep: boot control plane, run every N, emit artifact."""
+    return FleetSim(config).run(out_path)

@@ -1,0 +1,161 @@
+"""``python -m edl_tpu.sim.report``: render a fleet-sim artifact.
+
+Turns one ``SIM_r*.json`` sweep into per-signal latency-vs-N tables
+and fits each signal's **growth exponent** — the least-squares slope
+``alpha`` of ``log(latency)`` against ``log(N)``.  A control-plane
+signal that scales is flat (``alpha ~ 0``); ``alpha > 1.1`` is flagged
+SUPER-LINEAR, the early-warning shape (per-op work growing with fleet
+size on top of fleet size itself) that becomes an outage two decades
+later.  The CI smoke (scripts/fleet_sim_smoke.py) gates on the same
+numbers; this renderer is the human view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import sys
+
+SUPER_LINEAR_ALPHA = 1.1
+_STAT_COLS = ("samples", "p50_s", "mean_s", "p95_s", "max_s")
+
+
+def fit_exponent(points: list[tuple[float, float]]) -> float | None:
+    """Least-squares slope of log(y) vs log(n); None without at least
+    two usable (positive, distinct-n) points."""
+    pts = [(n, y) for n, y in points if n > 0 and y is not None and y > 0]
+    if len(pts) < 2 or len({n for n, _ in pts}) < 2:
+        return None
+    xs = [math.log(n) for n, _ in pts]
+    ys = [math.log(y) for _, y in pts]
+    mx = sum(xs) / len(xs)
+    my = sum(ys) / len(ys)
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom == 0:
+        return None
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+
+
+def classify(alpha: float | None) -> str:
+    if alpha is None:
+        return "n/a"
+    if alpha > SUPER_LINEAR_ALPHA:
+        return "SUPER-LINEAR"
+    if alpha > 0.5:
+        return "grows"
+    if alpha > 0.15:
+        return "sub-linear"
+    return "flat"
+
+
+def _signal_rows(artifact: dict) -> dict[str, list[tuple[float, dict]]]:
+    """signal name -> [(n, stats dict)] across rounds.  Stats dicts are
+    the :func:`~edl_tpu.sim.harness.latency_stats` shape; scalar-only
+    signals are wrapped to match."""
+    out: dict[str, list[tuple[float, dict]]] = {}
+
+    def add(name: str, n: float, stats: dict | None) -> None:
+        if stats:
+            out.setdefault(name, []).append((n, stats))
+
+    for r in artifact.get("rounds", []):
+        n = float(r["n"])
+        prop = r.get("propagation", {})
+        add("propagation/watch", n, prop.get("watch"))
+        add("propagation/poll", n, prop.get("poll"))
+        for op, stats in sorted((r.get("ops") or {}).items()):
+            add(f"op/{op}", n, stats)
+        sweep = r.get("lease_sweep") or {}
+        if sweep.get("mean_s") is not None:
+            add("lease_sweep", n, {"samples": sweep.get("sweeps", 0),
+                                   "mean_s": sweep["mean_s"],
+                                   "leases_live": sweep.get("leases_live")})
+        scrape = r.get("scrape") or {}
+        if scrape.get("mean_wall_s") is not None:
+            add("scrape_cycle", n,
+                {"samples": len(scrape.get("cycles", [])),
+                 "mean_s": scrape["mean_wall_s"],
+                 "max_s": scrape.get("staleness_floor_s")})
+        add("alert_dispatch", n, r.get("alert_dispatch"))
+    return out
+
+
+def _fit_value(stats: dict) -> float | None:
+    """The scalar a signal's exponent is fitted on: p50 when present
+    (robust to one slow trial), mean otherwise."""
+    v = stats.get("p50_s")
+    return stats.get("mean_s") if v is None else v
+
+
+def render_report(artifact: dict) -> str:
+    lines: list[str] = []
+    cfg = artifact.get("config", {})
+    lines.append(f"fleet-sim sweep  job={artifact.get('job_id', '?')}  "
+                 f"ns={cfg.get('ns')}  round_s={cfg.get('round_s')}  "
+                 f"host_cpus={artifact.get('host', {}).get('cpus', '?')}")
+    failures = sum(r.get("op_failures", 0)
+                   for r in artifact.get("rounds", []))
+    lines.append(f"rounds={len(artifact.get('rounds', []))}  "
+                 f"op_failures={failures}")
+    super_linear: list[str] = []
+    for name, rows in sorted(_signal_rows(artifact).items()):
+        alpha = fit_exponent([(n, _fit_value(stats)) for n, stats in rows])
+        verdict = classify(alpha)
+        if verdict == "SUPER-LINEAR":
+            super_linear.append(name)
+        lines.append("")
+        lines.append(f"signal {name}  growth exponent alpha="
+                     f"{'n/a' if alpha is None else f'{alpha:+.3f}'}"
+                     f"  [{verdict}]")
+        cols = [c for c in _STAT_COLS if any(stats.get(c) is not None
+                                             for _n, stats in rows)]
+        header = "  {:>8}".format("N") + "".join(
+            f" {c:>12}" for c in cols)
+        lines.append(header)
+        for n, stats in rows:
+            cells = "".join(
+                f" {stats.get(c):>12}" if stats.get(c) is not None
+                else f" {'-':>12}" for c in cols)
+            lines.append(f"  {int(n):>8}{cells}")
+    lines.append("")
+    if super_linear:
+        lines.append("SUPER-LINEAR signals (alpha > "
+                     f"{SUPER_LINEAR_ALPHA:g}): {', '.join(super_linear)}")
+    else:
+        lines.append(f"no super-linear signals (threshold alpha > "
+                     f"{SUPER_LINEAR_ALPHA:g})")
+    return "\n".join(lines)
+
+
+def newest_artifact(pattern: str = "SIM_r*.json") -> str | None:
+    found = sorted(glob.glob(pattern))
+    return found[-1] if found else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl_tpu.sim.report",
+        description="Render a fleet-sim SIM_r*.json artifact: per-signal "
+                    "latency-vs-N tables with fitted growth exponents")
+    p.add_argument("artifact", nargs="?", default=None,
+                   help="artifact path (default: newest SIM_r*.json in cwd)")
+    args = p.parse_args(argv)
+    path = args.artifact or newest_artifact()
+    if path is None:
+        print("no SIM_r*.json artifact found", file=sys.stderr)
+        return 2
+    with open(path) as f:
+        artifact = json.load(f)
+    if artifact.get("schema") != "edl-sim/1":
+        print(f"unrecognized artifact schema in {path}: "
+              f"{artifact.get('schema')!r}", file=sys.stderr)
+        return 2
+    print(f"# {path}")
+    print(render_report(artifact))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

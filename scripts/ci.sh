@@ -127,6 +127,15 @@ JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
 # alert -> action -> recovery handoff
 JAX_PLATFORMS=cpu python scripts/remediation_smoke.py
 
+# fleet-sim smoke: the control-plane scale observatory (doc/scale.md)
+# at CI-scale decades (N=25/100/400) — a real durable coord server +
+# real aggregator under N pod actors; gates: watch-based membership
+# propagation stays flat (<2x smallest->largest N) while poll-based
+# propagation visibly grows, the scrape cycle stays bounded at the
+# largest N, ZERO coord op failures, and the report renderer parses
+# its own SIM artifact with growth exponents
+JAX_PLATFORMS=cpu python scripts/fleet_sim_smoke.py
+
 # transfer smoke: the streaming data plane's microbench (loopback,
 # small payload, subprocess holders) — pipelined/striped fetch must not
 # regress below the serial baseline, and the MiB/s numbers land in the

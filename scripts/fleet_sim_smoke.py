@@ -1,0 +1,133 @@
+"""CI smoke: the control-plane scale observatory (edl_tpu/sim).
+
+Runs a REAL fleet-simulation sweep — N pod actors (TTL-leased adverts,
+heartbeats, status writes, reads) against a real durable coordination
+server subprocess, with a real Aggregator scraping the fleet's
+/metrics stubs through watch-based discovery — at CI-scale decades
+(N=25/100/400 by default), then gates the scaling curves:
+
+1. watch-based membership propagation stays FLAT: p50 at the largest N
+   under 2x the smallest N (long-poll delivery must not degrade with
+   fleet size);
+2. poll-based propagation VISIBLY GROWS with N (the O(N) prefix scan a
+   polling discoverer pays — the reason the aggregator switched to
+   watches) — and pays more than the watch path at the largest N;
+3. the aggregator scrape cycle stays bounded at the largest N;
+4. ZERO coordination op failures across every round;
+5. the report renderer parses its own artifact (subprocess
+   ``python -m edl_tpu.sim.report``) and renders growth exponents.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/fleet_sim_smoke.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from edl_tpu.sim.harness import SimConfig, run_sweep  # noqa: E402
+from edl_tpu.sim.report import fit_exponent, render_report  # noqa: E402
+
+_NS = tuple(int(n) for n in os.environ.get(
+    "EDL_TPU_SIM_SMOKE_NS", "25,100,400").split(","))
+_ROUND_S = float(os.environ.get("EDL_TPU_SIM_SMOKE_ROUND_S", "10"))
+# CI boxes are small + noisy: the propagation-flatness gate uses a
+# ratio (largest/smallest), the scrape gate an absolute ceiling
+_WATCH_FLAT_RATIO = 2.0
+_POLL_GROWTH_RATIO = 1.2
+_SCRAPE_BOUND_S = 8.0
+
+
+def main() -> None:
+    out = os.path.join(tempfile.mkdtemp(prefix="edl-sim-smoke-"),
+                       "SIM_smoke.json")
+    cfg = SimConfig(ns=_NS, round_s=_ROUND_S, ttl=6.0,
+                    heartbeat_period=1.5, propagation_trials=6,
+                    scrape_cycles=2, alert_trials=1, job_id="sim-smoke")
+    artifact = run_sweep(cfg, out_path=out)
+    print(render_report(artifact))
+
+    rounds = artifact["rounds"]
+    assert len(rounds) == len(_NS), rounds
+    by_n = {r["n"]: r for r in rounds}
+    n_min, n_max = min(by_n), max(by_n)
+
+    # gate 4 first: latency gates on a round with failed ops are noise
+    failures = {r["n"]: r["op_failures"] for r in rounds}
+    assert all(v == 0 for v in failures.values()), \
+        f"coordination op failures during sim: {failures}"
+    print(f"smoke: zero coord op failures across ns={sorted(by_n)}")
+
+    watch_lo = by_n[n_min]["propagation"]["watch"]
+    watch_hi = by_n[n_max]["propagation"]["watch"]
+    poll_lo = by_n[n_min]["propagation"]["poll"]
+    poll_hi = by_n[n_max]["propagation"]["poll"]
+    for name, stats in (("watch", watch_lo), ("watch", watch_hi),
+                        ("poll", poll_lo), ("poll", poll_hi)):
+        assert stats["samples"] > 0, f"no {name} propagation samples: {stats}"
+
+    # gate 1: watch propagation flat across the sweep
+    ratio = watch_hi["p50_s"] / watch_lo["p50_s"]
+    assert ratio < _WATCH_FLAT_RATIO, (
+        f"watch propagation degraded with fleet size: p50 "
+        f"{watch_lo['p50_s']}s @ N={n_min} -> {watch_hi['p50_s']}s "
+        f"@ N={n_max} ({ratio:.2f}x >= {_WATCH_FLAT_RATIO}x)")
+    print(f"smoke: watch propagation flat ({ratio:.2f}x from N={n_min} "
+          f"to N={n_max}, bound {_WATCH_FLAT_RATIO}x)")
+
+    # gate 2: poll propagation visibly grows, and loses to the watch
+    growth = poll_hi["p50_s"] / poll_lo["p50_s"]
+    assert growth > _POLL_GROWTH_RATIO, (
+        f"poll propagation did not grow with fleet size: p50 "
+        f"{poll_lo['p50_s']}s @ N={n_min} -> {poll_hi['p50_s']}s "
+        f"@ N={n_max} ({growth:.2f}x <= {_POLL_GROWTH_RATIO}x) — is the "
+        f"poll observer actually paying the O(N) scan?")
+    assert poll_hi["p50_s"] > watch_hi["p50_s"], (
+        f"poll should lose to watch at N={n_max}: "
+        f"poll p50 {poll_hi['p50_s']}s vs watch p50 {watch_hi['p50_s']}s")
+    print(f"smoke: poll propagation grows ({growth:.2f}x) and loses to "
+          f"watch at N={n_max}")
+
+    # gate 3: scrape cycle bounded at the largest N
+    wall = by_n[n_max]["scrape"]["mean_wall_s"]
+    assert wall is not None and wall < _SCRAPE_BOUND_S, (
+        f"aggregator scrape cycle unbounded at N={n_max}: "
+        f"{wall}s >= {_SCRAPE_BOUND_S}s")
+    print(f"smoke: scrape cycle at N={n_max} targets: {wall}s "
+          f"(bound {_SCRAPE_BOUND_S}s)")
+
+    # coord telemetry actually moved: leases tracked the fleet, the
+    # server's watch instrumentation saw the observers
+    sweep = by_n[n_max]["lease_sweep"]
+    assert sweep["leases_live"] >= n_max, sweep
+    assert sweep["sweeps"] > 0 and sweep["mean_s"] is not None, sweep
+    assert by_n[n_max]["watch_server"]["wakeups"] > 0, \
+        by_n[n_max]["watch_server"]
+    print(f"smoke: coord telemetry live (leases_live="
+          f"{sweep['leases_live']:g}, sweep mean {sweep['mean_s']}s, "
+          f"wakeups={by_n[n_max]['watch_server']['wakeups']:g})")
+
+    # gate 5: the report renderer parses its own artifact
+    proc = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.sim.report", out],
+        capture_output=True, text=True, cwd=_REPO, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "growth exponent" in proc.stdout, proc.stdout[:500]
+    print("smoke: report renderer parsed the artifact standalone")
+
+    # the exponent fit itself is sane on this artifact
+    alpha = fit_exponent([(r["n"], r["propagation"]["poll"]["p50_s"])
+                          for r in rounds])
+    assert alpha is not None and alpha > 0, alpha
+    with open(out) as f:
+        assert json.load(f)["schema"] == "edl-sim/1"
+
+    print("fleet-sim smoke OK")
+
+
+if __name__ == "__main__":
+    main()

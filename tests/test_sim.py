@@ -1,0 +1,376 @@
+"""Control-plane scale observatory: sim package units, the coord/watch
+telemetry it instruments, watch-based aggregator discovery, and the
+TSDB fleet-cardinality guard rails (PR 16)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from edl_tpu.cluster import paths
+from edl_tpu.coord import memory as coord_memory
+from edl_tpu.coord.memory import MemoryKV
+from edl_tpu.coord.server import _table_of
+from edl_tpu.obs import advert
+from edl_tpu.obs.metrics import parse_exposition
+from edl_tpu.sim.actor import OpRecorder, PodActor, TimedStore, table_of_key
+from edl_tpu.sim.harness import latency_stats
+from edl_tpu.sim.report import (classify, fit_exponent, render_report,
+                                SUPER_LINEAR_ALPHA)
+from edl_tpu.utils import constants
+
+JOB = "sim-test"
+
+
+# -- actor / recorder units --------------------------------------------------
+
+def test_table_of_key_bounded_cardinality():
+    assert table_of_key(paths.key(JOB, "heartbeat", "pod-1")) == "heartbeat"
+    assert table_of_key(paths.key(JOB, "obs", "metrics/x")) == "obs"
+    assert table_of_key("/elsewhere/foo") == "other"
+    assert table_of_key(paths.ROOT + f"/{JOB}/nonsense/x") == "other"
+    assert table_of_key("") == ""
+
+
+def test_server_table_of_matches_wire_kwargs():
+    assert _table_of({"key": paths.key(JOB, "resource", "p")}) == "resource"
+    assert _table_of({"prefix": paths.table_prefix(JOB, "obs")}) == "obs"
+    assert _table_of({"guard_key": paths.key(JOB, "rank", "0")}) == "rank"
+    assert _table_of({"ttl": 5}) == ""
+    assert _table_of({"key": "/other/shape"}) == "other"
+
+
+def test_timed_store_records_ops_and_failures():
+    kv = MemoryKV()
+    rec = OpRecorder()
+    store = TimedStore(kv, rec)
+    store.put(paths.key(JOB, "heartbeat", "p0"), b"x")
+    store.get(paths.key(JOB, "cluster", "spec"))
+    lease = store.lease_grant(5.0)
+    store.lease_keepalive(lease)
+
+    class _Boom(MemoryKV):
+        def put(self, key, value, lease=0):
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        TimedStore(_Boom(), rec).put(paths.key(JOB, "heartbeat", "x"), b"v")
+    durations, failures = rec.snapshot()
+    assert ("put", "heartbeat") in durations
+    assert ("get", "cluster") in durations
+    assert ("lease_grant", "") in durations
+    assert failures.get(("put", "heartbeat")) == 1
+    assert rec.failure_count == 1
+    _d, f = rec.snapshot(reset=True)
+    assert rec.failure_count == 0
+
+
+def test_pod_actor_lifecycle_and_op_mix():
+    kv = MemoryKV()
+    rec = OpRecorder()
+    actor = PodActor(TimedStore(kv, rec), JOB, "pod-0", ttl=5.0,
+                     heartbeat_period=0.01, status_period=0.01,
+                     read_period=0.01)
+    actor.start()
+    assert kv.get(paths.key(JOB, constants.ETCD_POD_RESOURCE,
+                            "pod-0")) is not None
+    time.sleep(0.02)
+    actor.tick()
+    hb = kv.get(paths.key(JOB, constants.ETCD_HEARTBEAT, "pod-0"))
+    assert hb is not None and json.loads(hb.value.decode())["beat"] == 1
+    assert kv.get(paths.key(JOB, constants.ETCD_TRAIN_STATUS,
+                            "pod-0")) is not None
+    durations, failures = rec.snapshot()
+    assert ("get", "cluster") in durations  # FleetView-style read
+    assert not failures
+    actor.stop()
+    # lease revoked with the session: the advert must expire with it
+    assert kv.get(paths.key(JOB, constants.ETCD_POD_RESOURCE,
+                            "pod-0")) is None
+
+
+# -- report math -------------------------------------------------------------
+
+def test_latency_stats_shape():
+    s = latency_stats([0.004, 0.001, 0.002, 0.003])
+    assert s["samples"] == 4
+    assert s["p50_s"] == pytest.approx(0.003, abs=1e-3)
+    assert s["max_s"] == pytest.approx(0.004)
+    assert latency_stats([]) == {"samples": 0}
+
+
+def test_fit_exponent_recovers_known_slopes():
+    linear = [(10, 0.01), (100, 0.1), (1000, 1.0)]
+    assert fit_exponent(linear) == pytest.approx(1.0, abs=1e-6)
+    flat = [(10, 0.02), (100, 0.02), (1000, 0.02)]
+    assert fit_exponent(flat) == pytest.approx(0.0, abs=1e-6)
+    quadratic = [(10, 1.0), (100, 100.0)]
+    assert fit_exponent(quadratic) == pytest.approx(2.0, abs=1e-6)
+    assert fit_exponent([(10, 0.01)]) is None
+    assert fit_exponent([(10, 0.0), (100, 0.0)]) is None
+    assert classify(2.0) == "SUPER-LINEAR"
+    assert classify(0.05) == "flat"
+    assert classify(None) == "n/a"
+    assert SUPER_LINEAR_ALPHA > 1.0
+
+
+def test_render_report_flags_super_linear(tmp_path):
+    artifact = {
+        "schema": "edl-sim/1", "job_id": "t", "ts": 0.0,
+        "host": {"cpus": 1}, "config": {"ns": [10, 100], "round_s": 1.0},
+        "rounds": [
+            {"n": 10, "op_failures": 0,
+             "propagation": {"watch": latency_stats([0.001] * 4),
+                             "poll": latency_stats([0.01] * 4)},
+             "ops": {"put/heartbeat": latency_stats([0.001] * 4)},
+             "lease_sweep": {"sweeps": 4, "mean_s": 1e-05,
+                             "leases_live": 10, "swept": 0},
+             "scrape": {"cycles": [{"wall_s": 0.01, "targets": 10,
+                                    "errors": 0}],
+                        "mean_wall_s": 0.01, "staleness_floor_s": 0.01},
+             "alert_dispatch": latency_stats([0.02])},
+            {"n": 100, "op_failures": 0,
+             "propagation": {"watch": latency_stats([0.0011] * 4),
+                             "poll": latency_stats([1.0] * 4)},
+             "ops": {"put/heartbeat": latency_stats([0.0011] * 4)},
+             "lease_sweep": {"sweeps": 4, "mean_s": 1.2e-05,
+                             "leases_live": 100, "swept": 0},
+             "scrape": {"cycles": [{"wall_s": 0.1, "targets": 100,
+                                    "errors": 0}],
+                        "mean_wall_s": 0.1, "staleness_floor_s": 0.1},
+             "alert_dispatch": latency_stats([0.2])},
+        ],
+    }
+    text = render_report(artifact)
+    assert "propagation/watch" in text and "flat" in text
+    assert "SUPER-LINEAR" in text  # poll went 0.01 -> 1.0 over one decade
+    # the standalone renderer parses the same artifact from disk
+    p = tmp_path / "SIM_r01.json"
+    p.write_text(json.dumps(artifact))
+    from edl_tpu.sim import report as report_mod
+    assert report_mod.main([str(p)]) == 0
+
+
+# -- coord watch/lease telemetry (PR 16 instrumentation) ---------------------
+
+def test_wait_watch_telemetry_moves():
+    kv = MemoryKV()
+    prefix = paths.table_prefix(JOB, constants.ETCD_POD_RESOURCE)
+    key = paths.key(JOB, constants.ETCD_POD_RESOURCE, "p0")
+    rev = kv.put(key, b"seed")
+    # the gauge/counter are process-global: other tests in a full-suite
+    # run may leave blocked daemon waiters behind, so assert DELTAS
+    watchers0 = coord_memory._WATCHERS_G.value
+    wakeups0 = coord_memory._WAKEUPS_TOTAL.value
+    delivered = []
+
+    def waiter():
+        res = kv.wait(prefix, rev, 5.0)
+        delivered.append(res)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while (coord_memory._WATCHERS_G.value < watchers0 + 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert coord_memory._WATCHERS_G.value >= watchers0 + 1  # blocked watcher
+    kv.put(key, b"changed")
+    t.join(timeout=5.0)
+    assert delivered and delivered[0].events
+    assert coord_memory._WAKEUPS_TOTAL.value >= wakeups0 + 1
+    assert coord_memory._WATCHERS_G.value <= watchers0  # ours unblocked
+
+
+def test_lease_sweep_telemetry_moves():
+    kv = MemoryKV(sweep_period=0.05)
+    sweeps0 = coord_memory._LEASE_SWEEP_SECONDS.count
+    swept0 = coord_memory._LEASES_SWEPT_TOTAL.value
+    lease = kv.lease_grant(0.1)
+    kv.put(paths.key(JOB, constants.ETCD_POD_RESOURCE, "dead"), b"x", lease)
+    time.sleep(0.5)
+    assert coord_memory._LEASE_SWEEP_SECONDS.count > sweeps0
+    assert coord_memory._LEASES_SWEPT_TOTAL.value >= swept0 + 1
+    assert kv.get(paths.key(JOB, constants.ETCD_POD_RESOURCE,
+                            "dead")) is None
+
+
+# -- watch-based aggregator discovery (satellite: advert watcher) ------------
+
+def test_metrics_target_watcher_tracks_adverts():
+    kv = MemoryKV()
+    w = advert.MetricsTargetWatcher(kv, JOB, period=0.2).start()
+    try:
+        reg = advert.advertise_metrics(kv, JOB, "trainer", "1.2.3.4:9",
+                                       name="t0", ttl=30.0)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            targets = w.targets()
+            if "t0" in targets:
+                break
+            time.sleep(0.02)
+        assert w.targets()["t0"]["endpoint"] == "1.2.3.4:9"
+        reg.stop()
+        kv.delete(paths.key(JOB, constants.ETCD_OBS, "metrics/t0"))
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if "t0" not in w.targets():
+                break
+            time.sleep(0.02)
+        assert "t0" not in w.targets()
+    finally:
+        w.stop()
+
+
+def test_metrics_target_watcher_poll_fallback():
+    class NoWaitKV(MemoryKV):
+        def wait(self, prefix, since_revision, timeout):
+            raise NotImplementedError
+
+    kv = NoWaitKV()
+    advert.advertise_metrics(kv, JOB, "trainer", "5.6.7.8:9", name="t1",
+                             ttl=30.0)
+    w = advert.MetricsTargetWatcher(kv, JOB, period=0.1).start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while w._watch_ok and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not w._watch_ok  # permanently degraded to polling
+        assert w.targets()["t1"]["endpoint"] == "5.6.7.8:9"  # via get_prefix
+    finally:
+        w.stop()
+
+
+def test_aggregator_discovery_knob(monkeypatch):
+    from edl_tpu.obs.agg import Aggregator
+    kv = MemoryKV()
+    advert.advertise_metrics(kv, JOB, "trainer", "9.9.9.9:1", name="t2",
+                             ttl=30.0)
+    monkeypatch.setenv("EDL_TPU_OBS_DISCOVERY_WATCH", "0")
+    agg = Aggregator(kv, JOB, scrape_interval=0, incident_dir="",
+                     enable_actions=False)
+    assert agg._discover_targets()["t2"]["endpoint"] == "9.9.9.9:1"
+    assert agg._target_watcher is None  # knob off: pure poll path
+    agg.stop_loop()
+
+    monkeypatch.setenv("EDL_TPU_OBS_DISCOVERY_WATCH", "1")
+    agg = Aggregator(kv, JOB, scrape_interval=0, incident_dir="",
+                     enable_actions=False)
+    assert agg._discover_targets()["t2"]["endpoint"] == "9.9.9.9:1"
+    assert agg._target_watcher is not None  # watch view lazily started
+    agg.stop_loop()
+    assert agg._target_watcher is None  # stop_loop stops the watcher
+
+
+# -- /healthz coord block + edl-obs-top pane ---------------------------------
+
+def test_coord_summary_block_and_top_pane():
+    from edl_tpu.obs.agg import Aggregator
+    from edl_tpu.obs.top import render_top
+    agg = Aggregator(MemoryKV(), JOB, scrape_interval=0, incident_dir="",
+                     enable_actions=False)
+    try:
+        page = (
+            "# TYPE edl_kv_ops_total counter\n"
+            'edl_kv_ops_total{component="coord",op="kv_put"} 42\n'
+            "# TYPE edl_coord_watchers gauge\n"
+            'edl_coord_watchers{component="coord"} 3\n'
+            "# TYPE edl_coord_leases_live gauge\n"
+            'edl_coord_leases_live{component="coord"} 17\n'
+            "# TYPE edl_rpc_open_connections gauge\n"
+            'edl_rpc_open_connections{component="coord"} 5\n'
+            'edl_rpc_open_connections{component="data"} 99\n')
+        coord = agg._coord_summary(parse_exposition(page))
+        assert coord["ops_total"] == 42.0
+        assert coord["watchers"] == 3.0
+        assert coord["leases_live"] == 17.0
+        assert coord["open_connections"] == 5.0  # data server filtered out
+        # no coord component on the page -> no block at all
+        assert agg._coord_summary(parse_exposition(
+            'edl_kv_ops_total{component="data",op="kv_put"} 1\n')) == {}
+        frame = render_top({"job_id": JOB, "live_targets": 1,
+                            "coord": coord}, {"firing": []})
+        assert "coord:" in frame and "leases=17" in frame
+    finally:
+        agg.stop_loop()
+
+
+# -- TSDB fleet-cardinality guard rails (satellite: ~5k series) --------------
+
+def test_tsdb_guardrail_5k_instance_series():
+    from edl_tpu.obs.tsdb import TSDB
+    tsdb = TSDB(retention_s=60.0)
+    n_series = 5000
+    parsed = {}
+    for i in range(n_series):
+        labels = (("component", "sim-pod"), ("instance", f"10.0.0.1:{i}"))
+        parsed[("edl_sim_heartbeats_total", labels)] = float(i)
+    t0 = time.perf_counter()
+    for tick in range(3):
+        tsdb.ingest({k: v + tick for k, v in parsed.items()},
+                    ts=100.0 + tick)
+    ingest_s = (time.perf_counter() - t0) / 3
+    assert tsdb.series_count("edl_sim_heartbeats_total") == n_series
+    # bound per-cycle ingestion at fleet cardinality: a 5k-target fleet
+    # scraped every few seconds must not eat the scrape interval (the
+    # generous bound absorbs CI-box noise; the regression this pins is
+    # accidental O(series^2) work, which would blow far past it)
+    assert ingest_s < 2.0, f"TSDB ingest took {ingest_s:.3f}s for 5k series"
+    t0 = time.perf_counter()
+    rates = tsdb.rate("edl_sim_heartbeats_total", 10.0, now=103.0,
+                      min_coverage=0.0)
+    rate_s = time.perf_counter() - t0
+    assert rates and rate_s < 2.0, f"windowed rate took {rate_s:.3f}s"
+
+
+def test_healthz_read_bounded_at_fleet_cardinality():
+    from edl_tpu.obs.agg import Aggregator
+    agg = Aggregator(MemoryKV(), JOB, scrape_interval=0, cache_s=30.0,
+                     incident_dir="", enable_actions=False)
+    try:
+        for i in range(5000):
+            labels = (("component", "sim-pod"),
+                      ("instance", f"10.0.0.1:{i}"))
+            agg.tsdb.ingest(
+                {("edl_sim_heartbeats_total", labels): 1.0}, ts=100.0)
+        agg.collect()  # warm the merged-page cache (cache_s=30)
+        t0 = time.perf_counter()
+        summary = agg.job_summary()
+        healthz_s = time.perf_counter() - t0
+        assert "job_id" in summary
+        assert healthz_s < 2.0, \
+            f"/healthz took {healthz_s:.3f}s at 5k-series cardinality"
+    finally:
+        agg.stop_loop()
+
+
+# -- end-to-end: one tiny real round -----------------------------------------
+
+def test_harness_round_end_to_end(tmp_path):
+    """A real (subprocess) coord server + real aggregator under a tiny
+    fleet: every signal present, artifact parseable by the renderer."""
+    from edl_tpu.sim.harness import SimConfig, run_sweep
+    cfg = SimConfig(ns=(4,), round_s=2.5, ttl=5.0, heartbeat_period=0.5,
+                    propagation_trials=3, scrape_cycles=1, alert_trials=1,
+                    stub_servers=2, clients=2, job_id="sim-e2e",
+                    data_dir=str(tmp_path / "coord"))
+    os.makedirs(cfg.data_dir, exist_ok=True)
+    out = str(tmp_path / "SIM_e2e.json")
+    artifact = run_sweep(cfg, out_path=out)
+    assert artifact["schema"] == "edl-sim/1"
+    (r,) = artifact["rounds"]
+    assert r["n"] == 4
+    assert r["op_failures"] == 0
+    assert r["propagation"]["watch"]["samples"] > 0
+    assert r["propagation"]["poll"]["samples"] > 0
+    assert any(k.startswith("put/") for k in r["ops"])
+    assert r["lease_sweep"]["sweeps"] > 0
+    assert r["lease_sweep"]["leases_live"] >= 4
+    assert r["scrape"]["cycles"] and r["scrape"]["cycles"][0]["targets"] >= 4
+    assert r["alert_dispatch"]["samples"] >= 1  # rule fired + dispatched
+    text = render_report(artifact)
+    assert "growth exponent" in text
+    with open(out) as f:
+        assert json.load(f)["rounds"][0]["n"] == 4
