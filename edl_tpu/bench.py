@@ -321,6 +321,18 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- delta replication plane: lag, bytes/step, steps lost (ISSUE 17) -----
+    # streamed optimizer-state deltas between checkpoints: how fast a
+    # record seals (stage -> both holders committed), how many bytes a
+    # cadence step ships vs a full shard set, and the steps a SIGKILL
+    # loses on the chain path vs the checkpoint path
+    if os.environ.get("EDL_TPU_BENCH_DELTA", "1") != "0":
+        try:
+            out.update(_bench_delta())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     # -- serving gateway: fleet-level request latency/throughput -------------
     # the ISSUE 3 number: what a caller sees THROUGH the front door
     # (admission, routing, chunked fetch) vs the engine-only tokens/s
@@ -1236,6 +1248,121 @@ def _bench_memstate() -> dict:
             "memstate_speedup": round(storage_s / max(cache_s, 1e-9), 2),
         }
     finally:
+        for r in regs:
+            r.stop()
+        for s in servers:
+            s.stop()
+        store.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_delta() -> dict:
+    """Delta replication plane numbers (ISSUE 17): per-record
+    replication lag (stage -> sealed on own pod + ring replica),
+    changed-bytes-per-cadence-step vs the full shard set, and the
+    steps an induced mid-interval failure loses when restoring from
+    base + chains vs rolling back to the checkpoint.  Only a fraction
+    of the state changes per step (the optimizer-state reality the
+    diff exploits), so the bytes ratio is the headline."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu import memstate
+    from edl_tpu.cluster.state import State
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.memstate import delta as ms_delta
+    from edl_tpu.memstate import restore as ms_restore
+    from edl_tpu.memstate.service import StateCacheService
+    from edl_tpu.memstate.tee import StateCacheTee
+    from edl_tpu.rpc.server import RpcServer
+    from edl_tpu.train.checkpoint import CheckpointManager
+
+    mb = int(os.environ.get("EDL_TPU_BENCH_MEMSTATE_MB", 64))
+    cadence = int(os.environ.get("EDL_TPU_BENCH_DELTA_EVERY", 10))
+    n_records = int(os.environ.get("EDL_TPU_BENCH_DELTA_RECORDS", 5))
+    n_arrays = 8
+    n_hot = 2                                    # arrays that change per step
+    per = max(1, (mb << 20) // 4 // n_arrays)    # float32 elements each
+    state = {f"w{i}": jnp.asarray(
+        np.random.default_rng(i).normal(size=(per,)).astype(np.float32))
+        for i in range(n_arrays)}
+
+    store = MemoryKV(sweep_period=1.0)
+    tmp = tempfile.mkdtemp(prefix="edl-delta-bench-")
+    servers, regs, services = [], [], {}
+    rep = None
+    try:
+        for pid in ("bench-a", "bench-b"):
+            srv = RpcServer("127.0.0.1", 0)
+            services[pid] = StateCacheService(store, "bench", pid)
+            srv.register_instance(services[pid])
+            srv.start()
+            servers.append(srv)
+            regs.append(memstate.advertise(store, "bench", pid,
+                                           f"127.0.0.1:{srv.port}", ttl=60))
+        tee = StateCacheTee(store, "bench", "bench-a")
+        ck = CheckpointManager(tmp, tee=tee)
+        base_step = 1
+        ck.save(base_step, state, State())
+        ck.wait()
+        deadline = time.monotonic() + 60
+        while memstate.read_committed_step(store, "bench") != base_step:
+            if time.monotonic() > deadline:
+                raise TimeoutError("tee never sealed the bench base")
+            time.sleep(0.05)
+
+        rep = ms_delta.DeltaReplicator(store, "bench", "bench-a",
+                                       every=cadence)
+        rep.rebase(base_step, state)
+        lags, step = [], base_step
+        for r in range(n_records):
+            step += cadence
+            for i in range(n_hot):  # the optimizer's hot slice moves
+                k = f"w{(r + i) % n_arrays}"
+                state[k] = state[k] + jnp.float32(1.0)
+            t0 = time.perf_counter()
+            rep.stage(step, state, State())
+            assert rep.flush(60), "delta record never sealed"
+            lags.append(time.perf_counter() - t0)
+        listing = services["bench-a"].cache_delta_manifest()
+        recs = listing["bench-a/0"]["records"]
+        assert len(recs) == n_records, listing
+        delta_bytes = [sum(int(e["nbytes"]) for e in r["shards"].values())
+                       for r in recs]
+        full_bytes = sum(int(v.nbytes) for v in state.values())
+
+        # induced failure one step before the NEXT record would seal:
+        # base + chains restore at the last sealed step, the checkpoint
+        # path rolls all the way back to the base
+        fail_step = step + cadence - 1
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), state)
+        t0 = time.perf_counter()
+        res = ms_restore.try_restore(store, "bench", abstract,
+                                     expect_step=base_step, delta_step=step)
+        delta_restore_s = time.perf_counter() - t0
+        assert res is not None and res[2]["step"] == step, "chain restore"
+        lags.sort()
+        ck.close()
+        return {
+            "delta_lag_p50_ms": round(lags[len(lags) // 2] * 1e3, 1),
+            "delta_lag_p99_ms": round(lags[-1] * 1e3, 1),
+            "delta_bytes_per_step_mb": round(
+                sum(delta_bytes) / n_records / 1e6, 2),
+            "delta_full_shard_mb": round(full_bytes / 1e6, 2),
+            "delta_bytes_ratio": round(
+                sum(delta_bytes) / n_records / max(full_bytes, 1), 3),
+            "delta_restore_s": round(delta_restore_s, 3),
+            "delta_steps_lost_per_failure": fail_step - step,
+            "checkpoint_steps_lost_per_failure": fail_step - base_step,
+        }
+    finally:
+        if rep is not None:
+            rep.close()
         for r in regs:
             r.stop()
         for s in servers:

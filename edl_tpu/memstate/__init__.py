@@ -14,6 +14,9 @@ from __future__ import annotations
 from edl_tpu.memstate.advert import (  # noqa: F401
     advertise, list_adverts, read_committed_step, write_committed_step,
 )
+from edl_tpu.memstate.delta import (  # noqa: F401
+    DeltaReplicator, probe_freshest,
+)
 from edl_tpu.memstate.placement import replica_for  # noqa: F401
 from edl_tpu.memstate.service import StateCacheService  # noqa: F401
 from edl_tpu.memstate.tee import StateCacheTee  # noqa: F401
@@ -23,3 +26,9 @@ from edl_tpu.utils import constants as _c
 def enabled() -> bool:
     """EDL_TPU_MEMSTATE=0 turns the whole subsystem off."""
     return bool(_c.MEMSTATE)
+
+
+def delta_enabled() -> bool:
+    """Delta replication rides the cache: on when the cache is on and
+    EDL_TPU_DELTA_EVERY > 0 (0 turns just the delta plane off)."""
+    return enabled() and _c.DELTA_EVERY > 0

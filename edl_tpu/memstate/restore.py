@@ -70,7 +70,8 @@ LOCAL_POD = "__local__"
 
 def try_restore(store, job_id: str, abstract_state,
                 expect_step: int | None = None, local: dict | None = None,
-                prefer_pod: str | None = None):
+                prefer_pod: str | None = None,
+                delta_step: int | None = None):
     """Returns ``(state, meta_json_str, info)`` or None (= use storage).
 
     ``abstract_state``: pytree of ShapeDtypeStructs WITH target
@@ -81,7 +82,11 @@ def try_restore(store, job_id: str, abstract_state,
     source at the committed step (a live reshard's host snapshot);
     keys it covers never touch the wire.  ``prefer_pod``: holder tried
     first after the local source (the restoring pod's OWN cache — a
-    loopback fetch beats any LAN peer).
+    loopback fetch beats any LAN peer).  ``delta_step``: restore the
+    base PLUS the intact delta chains up to exactly this step
+    (memstate/delta.py) — the caller has already agreed the target
+    across processes, so a plan that cannot reach it exactly is a miss,
+    never a silently different step.
     """
     import jax
 
@@ -106,7 +111,7 @@ def try_restore(store, job_id: str, abstract_state,
         # candidates so one bad/corrupt holder doesn't fail the restore
         holders: dict[str, list[tuple[str, dict, str]]] = {}
         meta_holders: list[tuple[str, str]] = []  # (pod, owner)
-        local = local or {}
+        local = dict(local or {})  # copy: the delta overlay prunes keys
         for key, (ent, _buf) in local.items():
             holders.setdefault(key, []).append((LOCAL_POD, ent, LOCAL_POD))
         for pod, ep in endpoints.items():
@@ -127,7 +132,33 @@ def try_restore(store, job_id: str, abstract_state,
             _miss("empty")
             return None
 
-        info = {"step": committed, "shards": 0, "bytes": 0,
+        restore_step = committed
+        if delta_step is not None and int(delta_step) > committed:
+            # overlay the intact chains: per changed key the freshest
+            # record's copy REPLACES the base candidates, the sidecar
+            # comes from the step-F record, and unchanged keys (plus
+            # the local in-RAM source for them) stay on the base plan
+            from edl_tpu.memstate import delta as delta_mod
+            listings = {}
+            for pod, pool in pools.items():
+                try:
+                    listings[pod] = pool.call("cache_delta_manifest")
+                except Exception as e:  # noqa: BLE001 — old peer: no chains
+                    logger.debug("delta manifest from %s failed (%s)",
+                                 pod[:8], e)
+                    continue
+            plan_d = delta_mod.plan_freshest(committed, listings,
+                                             max_step=int(delta_step))
+            if plan_d is None or int(plan_d["step"]) != int(delta_step):
+                _miss("delta_unreachable")
+                return None
+            for key, (_ent, cands) in plan_d["overlay"].items():
+                holders[key] = list(cands)
+                local.pop(key, None)  # base-step bytes are stale here
+            meta_holders = list(plan_d["meta"])
+            restore_step = int(delta_step)
+
+        info = {"step": restore_step, "shards": 0, "bytes": 0,
                 "local_bytes": 0, "wire_bytes": 0,
                 "peers": sorted({p for hs in holders.values()
                                  for p, _, _ in hs if p != LOCAL_POD})}
@@ -208,9 +239,11 @@ def try_restore(store, job_id: str, abstract_state,
         _HITS.inc()
         info["seconds"] = round(time.perf_counter() - t0, 3)
         logger.info("memstate: restored step %d from peers %s "
-                    "(%d shards, %.1f MB, %.2fs)", committed,
+                    "(%d shards, %.1f MB, %.2fs%s)", restore_step,
                     [p[:8] for p in info["peers"]], info["shards"],
-                    info["bytes"] / 1e6, info["seconds"])
+                    info["bytes"] / 1e6, info["seconds"],
+                    "" if restore_step == committed else
+                    f", base {committed} + delta chains")
         return state, meta_json, info
     finally:
         for p in pools.values():

@@ -25,7 +25,7 @@ import threading
 import time
 import zlib
 
-from edl_tpu.memstate import advert, placement
+from edl_tpu.memstate import advert, delta, placement
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
 from edl_tpu.utils import constants
@@ -106,6 +106,46 @@ class _Staging:
         self.t_start = time.monotonic()
 
 
+class _DeltaRec:
+    """One sealed delta record: the changed-shard bytes plus the chain
+    linkage fields a restorer re-verifies (memstate/delta.py)."""
+
+    __slots__ = ("step", "seq", "prev", "hash", "manifest", "shards",
+                 "nproc", "meta")
+
+    def __init__(self, step, seq, prev, hash_, manifest, shards_,
+                 nproc, meta):
+        self.step = int(step)
+        self.seq = int(seq)
+        self.prev = prev
+        self.hash = hash_
+        self.manifest = manifest
+        self.shards = shards_
+        self.nproc = int(nproc)
+        self.meta = meta
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(b) for b in self.shards.values())
+
+
+class _Chain:
+    """One producer's delta chain over a committed base
+    (keyed ``owner/src``; see memstate/delta.py for the format)."""
+
+    __slots__ = ("owner", "src", "base_step", "records")
+
+    def __init__(self, owner: str, src: str, base_step: int):
+        self.owner = owner
+        self.src = src
+        self.base_step = int(base_step)
+        self.records: list[_DeltaRec] = []
+
+    @property
+    def nbytes(self) -> int:
+        return sum(r.nbytes for r in self.records)
+
+
 class StateCacheService:
     """RPC-facing cache; every public method is wire surface (the pod
     server's ``register_instance`` exposes them), hence the ``cache_``
@@ -121,6 +161,7 @@ class StateCacheService:
         self._lock = threading.Lock()
         self._sets: dict[str, _Set] = {}            # owner -> committed set
         self._staging: dict[tuple[str, int, str], _Staging] = {}
+        self._chains: dict[str, _Chain] = {}        # "owner/src" -> chain
 
     # -- push (trainer tee / replicating peer) -----------------------------
     def cache_put_chunk(self, owner: str, step: int, key: str, seq: int,
@@ -192,6 +233,11 @@ class StateCacheService:
             for sk in [sk for sk in self._staging
                        if sk[0] == owner and sk[1] < step]:
                 self._staging.pop(sk, None)
+            # delta compaction: the new base subsumes every chain built
+            # over an older one (memstate/delta.py chain format)
+            for cid in [cid for cid, ch in self._chains.items()
+                        if ch.owner == owner and ch.base_step < step]:
+                self._chains.pop(cid, None)
             self._account_locked()
         _SETS_COMMITTED.labels(
             role="own" if owner == self._pod_id else "replica").inc()
@@ -225,10 +271,8 @@ class StateCacheService:
     def cache_fetch(self, owner: str, key: str, offset: int,
                     length: int) -> bytes:
         with self._lock:
-            s = self._sets.get(owner)
-            if s is None or key not in s.shards:
-                raise EdlInternalError(f"no cached shard {owner}/{key}")
-            data = s.shards[key][int(offset):int(offset) + int(length)]
+            blob = self._blob_locked(owner, key)
+            data = blob[int(offset):int(offset) + int(length)]
         _BYTES_SERVED.inc(len(data))
         return data
 
@@ -241,12 +285,9 @@ class StateCacheService:
         method surface as a typed no-such-method error the restore
         demotes on."""
         with self._lock:
-            s = self._sets.get(owner)
-            if s is None or key not in s.shards:
-                raise EdlInternalError(f"no cached shard {owner}/{key}")
             # bytes are immutable: hold the ref, stream outside the lock
             # (eviction replaces the dict entry, never mutates the blob)
-            data = s.shards[key]
+            data = self._blob_locked(owner, key)
         offset = max(0, int(offset))
         end = len(data) if int(length) < 0 else min(len(data),
                                                     offset + int(length))
@@ -262,8 +303,104 @@ class StateCacheService:
 
     def cache_meta(self, owner: str) -> bytes | None:
         with self._lock:
+            parsed = delta.parse_wire_owner(owner)
+            if parsed is not None:
+                rec = self._delta_rec_locked(*parsed)
+                return None if rec is None else rec.meta
             s = self._sets.get(owner)
             return None if s is None else s.meta
+
+    # -- delta chains (memstate/delta.py producers / restore overlay) ------
+    def cache_delta_commit(self, owner: str, src: str, base_step: int,
+                           step: int, seq: int, prev_hash: str,
+                           chain_hash: str, manifest: dict, nproc: int = 0,
+                           meta: bytes | None = None) -> dict:
+        """Seal one delta record staged under its wire-owner namespace.
+        CRC/length of every payload shard, the record hash, and the
+        chain linkage are all verified here, under the lock — a reader
+        can never observe a torn or mis-linked chain entry."""
+        step, seq, base_step = int(step), int(seq), int(base_step)
+        src = str(src)
+        wire = delta.wire_owner(owner, src, seq)
+        if delta.chain_hash(prev_hash, step, seq, manifest) != chain_hash:
+            _PUSH_REJECTS.labels(reason="delta_hash").inc()
+            return {"ok": False, "reason": "hash"}
+        with self._lock:
+            cid = f"{owner}/{src}"
+            ch = self._chains.get(cid)
+            if ch is not None and ch.base_step != base_step:
+                if base_step < ch.base_step:
+                    return {"ok": False, "reason": "stale"}
+                ch = None  # a newer base re-anchors: replace the chain
+            if ch is None:
+                if seq != 1:
+                    _PUSH_REJECTS.labels(reason="delta_gap").inc()
+                    return {"ok": False, "reason": "gap"}
+                own_set = self._sets.get(owner)
+                if own_set is not None and own_set.step > base_step:
+                    # a newer full set already subsumes this base
+                    return {"ok": False, "reason": "stale"}
+                ch = _Chain(owner, src, base_step)
+            tail = ch.records[-1] if ch.records else None
+            expect_prev = tail.hash if tail else delta.anchor_hash(base_step)
+            expect_seq = (tail.seq if tail else 0) + 1
+            if tail is not None and seq <= tail.seq:
+                dup = next((r for r in ch.records if r.seq == seq), None)
+                if dup is not None and dup.hash == chain_hash:
+                    return {"ok": True, "dup": True}  # idempotent re-push
+                _PUSH_REJECTS.labels(reason="delta_link").inc()
+                return {"ok": False, "reason": "link"}
+            if seq != expect_seq or prev_hash != expect_prev or \
+                    step <= (tail.step if tail else base_step):
+                _PUSH_REJECTS.labels(reason="delta_link").inc()
+                return {"ok": False, "reason": "link"}
+            if len(ch.records) >= constants.DELTA_MAX_CHAIN > 0:
+                _PUSH_REJECTS.labels(reason="delta_full").inc()
+                return {"ok": False, "reason": "full"}
+            staged: dict[str, bytes] = {}
+            for key, ent in manifest.items():
+                st = self._staging.get((wire, step, key))
+                if st is None or not st.done:
+                    raise EdlInternalError(
+                        f"commit of unstaged delta shard {key}")
+                data = bytes(st.buf)
+                if len(data) != int(ent["nbytes"]) or \
+                        zlib.crc32(data) != int(ent["crc"]):
+                    self._staging.pop((wire, step, key), None)
+                    _PUSH_REJECTS.labels(reason="crc").inc()
+                    raise EdlInternalError(
+                        f"delta shard {key} failed CRC/length verification")
+                staged[key] = data
+            for key in manifest:
+                self._staging.pop((wire, step, key), None)
+            ch.records.append(_DeltaRec(
+                step, seq, prev_hash, chain_hash,
+                {k: dict(v) for k, v in manifest.items()}, staged,
+                int(nproc), None if meta is None else bytes(meta)))
+            self._chains[cid] = ch
+            self._account_locked()
+        _SETS_COMMITTED.labels(
+            role="own_delta" if owner == self._pod_id
+            else "replica_delta").inc()
+        obs_trace.emit("memstate/delta_commit", owner=owner, src=src,
+                       step=step, seq=seq, shards=len(staged),
+                       bytes=sum(len(d) for d in staged.values()))
+        return {"ok": True}
+
+    def cache_delta_manifest(self) -> dict:
+        """Every delta chain held here, linkage fields included so the
+        restorer can verify intact prefixes without trusting us:
+        ``{cid: {owner, src, base_step, records: [...]}}``."""
+        with self._lock:
+            return {cid: {
+                "owner": ch.owner, "src": ch.src,
+                "base_step": ch.base_step,
+                "records": [{"step": r.step, "seq": r.seq, "prev": r.prev,
+                             "hash": r.hash, "shards": r.manifest,
+                             "nproc": r.nproc,
+                             "has_meta": r.meta is not None}
+                            for r in ch.records],
+            } for cid, ch in self._chains.items()}
 
     def cache_stats(self) -> dict:
         with self._lock:
@@ -272,11 +409,37 @@ class StateCacheService:
                 "owners": {o: {"step": s.step, "shards": len(s.shards),
                                "nbytes": s.nbytes}
                            for o, s in self._sets.items()},
+                "chains": {cid: {"base_step": ch.base_step,
+                                 "records": len(ch.records),
+                                 "nbytes": ch.nbytes}
+                           for cid, ch in self._chains.items()},
                 "staging": len(self._staging),
                 "max_bytes": self._max_bytes,
             }
 
     # -- internals ---------------------------------------------------------
+    def _blob_locked(self, owner: str, key: str) -> bytes:
+        """One shard's bytes under the lock — committed full sets by
+        plain owner, delta record payloads by their ``~delta:`` wire
+        owner (the one resolution point the read surface shares)."""
+        parsed = delta.parse_wire_owner(owner)
+        if parsed is not None:
+            rec = self._delta_rec_locked(*parsed)
+            if rec is None or key not in rec.shards:
+                raise EdlInternalError(f"no cached delta shard "
+                                       f"{owner}/{key}")
+            return rec.shards[key]
+        s = self._sets.get(owner)
+        if s is None or key not in s.shards:
+            raise EdlInternalError(f"no cached shard {owner}/{key}")
+        return s.shards[key]
+
+    def _delta_rec_locked(self, owner: str, src: str, seq: int):
+        ch = self._chains.get(f"{owner}/{src}")
+        if ch is None:
+            return None
+        return next((r for r in ch.records if r.seq == int(seq)), None)
+
     def _over_cap(self, incoming: int, owner: str, step: int) -> bool:
         """Admission check for one more chunk of ``owner``'s ``step``.
 
@@ -291,11 +454,14 @@ class StateCacheService:
             return False
         held = sum(s.nbytes for o, s in self._sets.items()
                    if not (o == owner and s.step < step)) + \
+            sum(ch.nbytes for ch in self._chains.values()) + \
             sum(len(st.buf) for st in self._staging.values())
         return held + incoming > self._max_bytes
 
     def _account_locked(self) -> None:
         _BYTES_CACHED.set(sum(s.nbytes for s in self._sets.values()))
+        delta.resident_gauge().set(
+            sum(ch.nbytes for ch in self._chains.values()))
 
     def _replicate(self, owner: str, step: int) -> None:
         """Push ``owner``'s committed set to its ring-placed replica pod

@@ -605,6 +605,25 @@ class Aggregator:
                 parsed, "edl_coord_retries_total") or 0.0,
         }
         summary["robustness"] = robustness
+        # delta replication plane headline: how far the streamed chains
+        # run ahead of the committed checkpoint (the failover exposure
+        # is min(lag_steps, EDL_TPU_DELTA_EVERY) steps, not the full
+        # checkpoint interval) and whether chains are breaking
+        delta_lag = self._metric_max(parsed, "edl_delta_lag_steps")
+        if delta_lag is not None:
+            summary["delta"] = {
+                "lag_steps": delta_lag,
+                "chain_len": self._metric_max(
+                    parsed, "edl_delta_chain_len") or 0.0,
+                "records": self._metric_sum(
+                    parsed, "edl_delta_records_total") or 0.0,
+                "bytes_streamed": self._metric_sum(
+                    parsed, "edl_delta_bytes_total") or 0.0,
+                "bytes_resident": self._metric_sum(
+                    parsed, "edl_delta_bytes_resident") or 0.0,
+                "chain_breaks": self._metric_sum(
+                    parsed, "edl_delta_chain_breaks_total") or 0.0,
+            }
         coord = self._coord_summary(parsed)
         if coord:
             summary["coord"] = coord

@@ -10,7 +10,62 @@ LR-consistent (doc: lr ∝ total_batch/base_batch).  Schedules are plain
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import optax
+
+
+class WorldScaleState(NamedTuple):
+    """State of :func:`world_scaled`'s trailing transform: one scalar
+    multiplier on the final update.  It LIVES IN the optimizer state on
+    purpose — it rides every checkpoint/delta record, so a resized-
+    then-resumed job keeps its accumulated scale, and repeated resizes
+    compound multiplicatively (4->8->4 pods lands back on 1.0)."""
+
+    lr_scale: object  # scalar jnp array
+
+
+def world_scaled(tx: optax.GradientTransformation
+                 ) -> optax.GradientTransformation:
+    """Wrap ``tx`` so the effective learning rate can be re-scaled on a
+    world-size change without rebuilding the optimizer
+    (EDL_TPU_LR_RESCALE; the first-class form of the reference's
+    register_adjust_function LR rule, state.py:142).  The trailing
+    stage multiplies the FINAL update by ``lr_scale`` — exact linear
+    effective-LR scaling for any optimizer whose update is proportional
+    to its learning rate (SGD, Adam, ...), with no knowledge of the
+    wrapped schedule."""
+    import jax
+
+    def init_fn(params):
+        del params
+        import jax.numpy as jnp
+        return WorldScaleState(lr_scale=jnp.ones((), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        del params
+        updates = jax.tree.map(
+            lambda u: u * state.lr_scale.astype(u.dtype), updates)
+        return updates, state
+
+    return optax.chain(tx, optax.GradientTransformation(init_fn, update_fn))
+
+
+def rescale_state(state, factor: float):
+    """Multiply every :class:`WorldScaleState` in ``state`` (a
+    TrainState or bare opt_state pytree) by ``factor`` — called at
+    restore/reshard time with ``new_world / old_world`` (the linear
+    LR-vs-global-batch rule).  A no-op tree if the optimizer was not
+    built through :func:`world_scaled`."""
+    import jax
+
+    def one(x):
+        if isinstance(x, WorldScaleState):
+            return WorldScaleState(lr_scale=x.lr_scale * float(factor))
+        return x
+
+    return jax.tree.map(one, state,
+                        is_leaf=lambda x: isinstance(x, WorldScaleState))
 
 
 def scale_lr_for_batch(base_lr: float, global_batch: int,

@@ -186,6 +186,9 @@ class ElasticTrainer:
         self.mesh = build_mesh(self.cfg.mesh_spec, devices)
         self.rules = self.cfg.rules
         self.adjust = AdjustRegistry()
+        # delta replication plane (memstate/delta.py): owned here, built
+        # alongside the checkpoint manager and rebuilt with it on reshard
+        self._delta_rep = None
         self.ckpt = self._build_ckpt()
         self._step_fn = None
         self._t_restored: float | None = None  # recovery instrumentation
@@ -217,6 +220,11 @@ class ElasticTrainer:
         manager's construction runs a world-wide sync, so survivors must
         construct a FRESH one right after re-forming the world — pairing
         with the construction sync of any freshly spawned joiner."""
+        if self._delta_rep is not None:
+            # an old replicator targets the OLD membership's chains;
+            # signal-only close — never block a reshard on a dead peer
+            self._delta_rep.close(wait=False)
+            self._delta_rep = None
         if not self.cfg.checkpoint_dir:
             return None
         # under the elastic launcher, committed saves tee into the pod's
@@ -233,11 +241,27 @@ class ElasticTrainer:
                                                  self.tenv.pod_id)
                 except Exception:  # noqa: BLE001 — cache is best-effort
                     logger.exception("memstate tee unavailable")
+            if tee is not None and memstate.delta_enabled():
+                try:
+                    self._delta_rep = memstate.DeltaReplicator(
+                        self.store, self.tenv.job_id, self.tenv.pod_id)
+                except Exception:  # noqa: BLE001 — deltas are best-effort
+                    logger.exception("delta replicator unavailable")
         return CheckpointManager(self.cfg.checkpoint_dir,
                                  self.cfg.max_to_keep, tee=tee)
 
     # -- state construction --------------------------------------------------
     def _build_fn(self, init_fn, tx, param_logical):
+        from edl_tpu.utils import constants as _c
+        if _c.LR_RESCALE:
+            # first-class world-derived LR re-scale: every state built
+            # through this one choke point (create_state AND the restore
+            # skeleton) carries the world-scale stage, so the structure
+            # is consistent across save/restore.  Default OFF because it
+            # CHANGES the opt_state pytree — flipping it mid-run makes
+            # old checkpoints structurally unrestorable.
+            from edl_tpu.train import lr as lr_mod
+            tx = lr_mod.world_scaled(tx)
         mesh, rules = self.mesh, self.rules
 
         def constrain(params):
@@ -320,7 +344,28 @@ class ElasticTrainer:
             logger.info("world size %d -> %d; running adjust functions",
                         old_world, new_world)
             self.adjust.run(old_world, new_world, meta)
+            state = self._world_lr_rescale(state, old_world, new_world)
+        if self._delta_rep is not None and self._restore_source is not None:
+            # re-anchor the delta chain on the restored step when it IS
+            # the committed one; a chain-overlay restore lands past the
+            # commit, so its chain stays useful until the next save
+            if self._restore_source != "delta":
+                self._delta_rep.rebase(int(state.step), state)
         return state, meta
+
+    def _world_lr_rescale(self, state, old_world: int, new_world: int):
+        """EDL_TPU_LR_RESCALE: linear LR-vs-global-batch adjustment on
+        a world change — multiplies the world-scale stage riding the
+        optimizer state (train/lr.py) by new/old.  No-op tree when the
+        optimizer was not built with the knob on."""
+        from edl_tpu.utils import constants as _c
+        if not _c.LR_RESCALE or not old_world or old_world == new_world:
+            return state
+        from edl_tpu.train import lr as lr_mod
+        factor = new_world / old_world
+        logger.info("LR rescale: world %d -> %d, effective-LR factor %.3f",
+                    old_world, new_world, factor)
+        return lr_mod.rescale_state(state, factor)
 
     def _cache_first_restore(self, abstract, latest: int
                              ) -> tuple[Any, State | None]:
@@ -338,32 +383,98 @@ class ElasticTrainer:
             return None, None
         from edl_tpu.memstate import restore as ms_restore
         t0 = time.perf_counter()
-        try:
-            with obs_trace.get_tracer().span("train/restore_peer",
-                                             step=latest):
-                res = ms_restore.try_restore(self.store, self.tenv.job_id,
-                                             abstract, expect_step=latest)
-        except Exception:  # noqa: BLE001 — cache must never fail a restore
-            logger.exception("peer-cache restore errored; using storage")
-            return None, None
+        # sub-checkpoint-loss failover: restore base + the freshest
+        # intact delta chains when the whole world agrees one is
+        # reachable; any per-process failure demotes EVERY process to
+        # the plain committed-step restore (a torn mix of steps across
+        # processes would be worse than the lost interval)
+        delta_step = self._agree_delta_target(latest)
+        res = None
+        if delta_step is not None:
+            try:
+                with obs_trace.get_tracer().span("train/restore_delta",
+                                                 step=delta_step):
+                    res = ms_restore.try_restore(
+                        self.store, self.tenv.job_id, abstract,
+                        expect_step=latest, delta_step=delta_step)
+            except Exception:  # noqa: BLE001 — demote to the base restore
+                logger.exception("delta-chain restore errored")
+            if not self._agree_flag(res is not None):
+                res = None  # someone missed: everyone takes the base
+        source = "delta" if res is not None else "peer"
+        if res is None:
+            try:
+                with obs_trace.get_tracer().span("train/restore_peer",
+                                                 step=latest):
+                    res = ms_restore.try_restore(self.store,
+                                                 self.tenv.job_id,
+                                                 abstract,
+                                                 expect_step=latest)
+            except Exception:  # noqa: BLE001 — cache never fails a restore
+                logger.exception("peer-cache restore errored; using storage")
+                return None, None
         if res is None:
             return None, None
         state, meta_json, info = res
         meta = State().from_json(meta_json)
-        if os.environ.get("EDL_TPU_MEMSTATE_VERIFY") == "1":
+        if os.environ.get("EDL_TPU_MEMSTATE_VERIFY") == "1" \
+                and info["step"] == latest:
+            # only comparable when the restored step IS the storage
+            # step; a chain-overlay restore is fresher than storage by
+            # construction (the failover smoke verifies it end to end)
             stored = self.ckpt.restore(abstract)
             assert stored is not None
             ms_restore.assert_bit_identical(state, stored[0])
             logger.info("memstate: peer restore verified bit-identical to "
                         "storage (step %d)", latest)
-        self._restore_source = "peer"
-        ms_restore.RESTORE_SECONDS.labels(source="peer").observe(
+        self._restore_source = source
+        ms_restore.RESTORE_SECONDS.labels(source=source).observe(
             time.perf_counter() - t0)
-        logger.info("restored checkpoint step %d from peer cache "
-                    "(restore_source=peer, %d shards, %.1f MB from %s)",
-                    latest, info["shards"], info["bytes"] / 1e6,
+        logger.info("restored step %d from peer cache (restore_source=%s, "
+                    "%d shards, %.1f MB from %s)", info["step"], source,
+                    info["shards"], info["bytes"] / 1e6,
                     [p[:8] for p in info["peers"]])
         return state, meta
+
+    def _agree_delta_target(self, expect: int | None) -> int | None:
+        """The world-agreed delta restore target past ``expect`` (the
+        committed/storage step), or None.  Every process probes the
+        freshest recoverable step (memstate.probe_freshest) and the
+        allgathered MIN is the answer — restorable by construction on
+        every process (intact chains are prefix-closed), identical
+        everywhere, and -1 from any process (probe failure, stale
+        committed record, nothing fresher) demotes the whole world.
+        The collective is UNCONDITIONAL on the delta knob being on, so
+        every process must call this at the same point."""
+        from edl_tpu import memstate
+        if not memstate.delta_enabled():
+            return None
+        committed = freshest = None
+        try:
+            committed, freshest = memstate.probe_freshest(
+                self.store, self.tenv.job_id)
+        except Exception:  # noqa: BLE001 — probe failure = no delta
+            logger.exception("delta freshness probe failed")
+        cand = -1
+        if (expect is not None and committed == expect
+                and freshest is not None and freshest > expect):
+            cand = int(freshest)
+        if jax.process_count() > 1:
+            from edl_tpu.parallel.sharding import allgather_flag
+            cand = int(allgather_flag(cand).min())
+        if cand <= (expect if expect is not None else cand):
+            return None
+        logger.info("delta restore target agreed: step %d (base %s)",
+                    cand, expect)
+        return cand
+
+    @staticmethod
+    def _agree_flag(ok: bool) -> bool:
+        """All-processes-AND of a local outcome (identity when solo)."""
+        if jax.process_count() <= 1:
+            return bool(ok)
+        from edl_tpu.parallel.sharding import allgather_flag
+        return bool(allgather_flag(int(bool(ok))).min())
 
     # -- the step ------------------------------------------------------------
     def _make_step(self):
@@ -520,12 +631,26 @@ class ElasticTrainer:
                                 {k: float(v) for k, v in metrics.items()})
                 if self._profiling and step >= self.cfg.profile_window[1]:
                     self._stop_profile()
-            if (self.ckpt is not None and self.cfg.save_every_steps
-                    and step % self.cfg.save_every_steps == 0):
+            saving = (self.ckpt is not None and self.cfg.save_every_steps
+                      and step % self.cfg.save_every_steps == 0)
+            if (not saving and self._delta_rep is not None
+                    and self._delta_rep.want(step)):
+                # stream a delta record for this step (D2H + push on the
+                # worker thread; only the snapshot is on the step path).
+                # ``want`` is deterministic across processes, so the
+                # collective _sync_data_checkpoint below stays aligned
+                with ledger.phase("hooks"):
+                    meta.step = step
+                    self._sync_data_checkpoint(meta)
+                    self._delta_rep.stage(step, state, meta)
+            if saving:
                 with ledger.phase("checkpoint"):
                     meta.step = step
                     self._sync_data_checkpoint(meta)
                     self.ckpt.save(step, state, meta)
+                    if self._delta_rep is not None:
+                        # new base: re-anchor the chain on this commit
+                        self._delta_rep.rebase(step, state)
         dt = time.monotonic() - t_epoch
         # step_num covers the WHOLE epoch, including segments trained
         # before a mid-epoch stop-resume; avg time reflects this segment
@@ -547,6 +672,8 @@ class ElasticTrainer:
                     self.ckpt.save_meta(int(state.step), meta)
                 else:
                     self.ckpt.save(int(state.step), state, meta, force=True)
+                    if self._delta_rep is not None:
+                        self._delta_rep.rebase(int(state.step), state)
                 # Under the elastic launcher a membership change SIGTERMs
                 # the trainer between epochs; drain the async save so the
                 # resize never lands before any checkpoint committed (a
@@ -1137,8 +1264,11 @@ class ElasticTrainer:
                             self.store, self.tenv.job_id, old_stage)
                         is not None):
                     # no save here: the dead pod's live-step shards are
-                    # gone, so the world rolls back to the committed
-                    # step — the same data-loss window stop-resume has
+                    # gone.  With the delta plane on, the reshard
+                    # restore rolls forward to the freshest world-agreed
+                    # chain step (≤ EDL_TPU_DELTA_EVERY steps lost);
+                    # otherwise it rolls back to the committed step —
+                    # the same data-loss window stop-resume has
                     return _ReshardPayload(mode="shrink")
             except Exception:  # noqa: BLE001 — store blip: keep polling
                 logger.exception("resize handshake poll failed")
@@ -1224,17 +1354,38 @@ class ElasticTrainer:
 
             # 3. rebuild state: local snapshot first (zero wire), own
             # pod's cache over loopback next, peers/replicas for the
-            # shards whose owner changed — the delta
+            # shards whose owner changed — the delta.  When the world
+            # agrees a streamed delta chain reaches PAST the committed
+            # step (a failure shrink: the base + survivors' chains are
+            # fresher than any checkpoint), overlay it first.  The
+            # collective order here (ckpt construction sync, then the
+            # target agreement, then the restore, then the all-ok vote)
+            # mirrors _cache_first_restore exactly, because survivors
+            # and freshly spawned joiners run these collectives against
+            # each other.
             expect = self.ckpt.latest_step()
             t_restore = time.time()
+            delta_step = self._agree_delta_target(expect)
             res = None
-            try:
-                res = ms_restore.try_restore(
-                    self.store, self.tenv.job_id, abstract,
-                    expect_step=expect, local=payload.local,
-                    prefer_pod=self.tenv.pod_id)
-            except Exception:  # noqa: BLE001 — storage fallback below
-                logger.exception("reshard cache restore errored")
+            if delta_step is not None:
+                try:
+                    res = ms_restore.try_restore(
+                        self.store, self.tenv.job_id, abstract,
+                        expect_step=expect, local=payload.local,
+                        prefer_pod=self.tenv.pod_id,
+                        delta_step=delta_step)
+                except Exception:  # noqa: BLE001 — demote to base
+                    logger.exception("reshard delta-chain restore errored")
+                if not self._agree_flag(res is not None):
+                    res = None
+            if res is None:
+                try:
+                    res = ms_restore.try_restore(
+                        self.store, self.tenv.job_id, abstract,
+                        expect_step=expect, local=payload.local,
+                        prefer_pod=self.tenv.pod_id)
+                except Exception:  # noqa: BLE001 — storage fallback below
+                    logger.exception("reshard cache restore errored")
             if res is not None:
                 state, meta_json, info = res
                 meta = State().from_json(meta_json)
@@ -1246,7 +1397,7 @@ class ElasticTrainer:
                     info.get("shards", 0) - info.get("local_shards", 0))
                 logger.info(
                     "reshard restore: step %d, %.1f MB local / %.1f MB "
-                    "moved", expect if expect is not None else -1,
+                    "moved", info.get("step", -1),
                     info.get("local_bytes", 0) / 1e6,
                     info.get("wire_bytes", 0) / 1e6)
             else:
@@ -1260,7 +1411,9 @@ class ElasticTrainer:
                 meta = saved_meta if saved_meta is not None else meta
                 source = "storage"
             if os.environ.get("EDL_TPU_MEMSTATE_VERIFY") == "1" \
-                    and source == "delta":
+                    and source == "delta" and delta_step is None:
+                # only comparable when no chain overlay ran: a chain
+                # restore lands past the stored step by construction
                 stored = self.ckpt.restore(abstract)
                 assert stored is not None
                 ms_restore.assert_bit_identical(state, stored[0])
@@ -1275,6 +1428,9 @@ class ElasticTrainer:
             logger.info("world size %d -> %d (live); running adjust "
                         "functions", old_world, new_world)
             self.adjust.run(old_world, new_world, meta)
+            # adjust hooks only see meta; the LR rescale touches the
+            # optimizer state, so it is applied here directly
+            state = self._world_lr_rescale(state, old_world, new_world)
         self._reshard_seen = False
         # a preemption sighting belongs to the OLD stage: the departed
         # pod is gone; the new stage must not re-trigger on it
@@ -1295,9 +1451,17 @@ class ElasticTrainer:
             except Exception:  # noqa: BLE001 — the launcher's deadline
                 logger.exception("reshard done record write failed")
         self._capture_state_spec(state)
+        if self._delta_rep is not None and delta_step is None:
+            # restored at the committed step: re-anchor the (freshly
+            # rebuilt) replicator's chain there so delta streaming
+            # resumes immediately.  After a chain-overlay restore the
+            # landed step has no full base — streaming waits for the
+            # next save's rebase, and the existing chains stay servable
+            # until that save's commit compacts them away.
+            self._delta_rep.rebase(int(state.step), state)
         logger.info("live reshard complete: stage %s, world %d, %.2fs "
-                    "(source=%s)", cluster.stage[:8], new_world,
-                    time.monotonic() - t0, source)
+                    "(source=%s, step %d)", cluster.stage[:8], new_world,
+                    time.monotonic() - t0, source, int(state.step))
         return state, meta
 
     # -- eval ----------------------------------------------------------------

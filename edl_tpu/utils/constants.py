@@ -144,9 +144,11 @@ COORD_BACKOFF_MAX = _f("EDL_TPU_COORD_BACKOFF_MAX", 2.0)
 # trainer PROCESSES stay alive, the collective world re-forms in place
 # (train/distributed.reform_world) and only the shards whose owner
 # changed move over the streaming plane (memstate/reshard.py).  Any
-# failure mid-reshard falls back to the proven stop-resume path.  Off
-# by default until burned in; the chaos/resize smokes run with it on.
-RESIZE_DELTA = int(_f("EDL_TPU_RESIZE_DELTA", 0))
+# failure mid-reshard falls back to the proven stop-resume path.  ON
+# by default since the ROADMAP item 3 burn-in (ISSUE 17);
+# EDL_TPU_RESIZE_DELTA=0 is the documented opt-out back to pure
+# stop-resume.
+RESIZE_DELTA = int(_f("EDL_TPU_RESIZE_DELTA", 1))
 # reshard barrier timeout: bounds BOTH the trainer's wait for the
 # post-barrier "go" record + the re-formed world, and the launcher's
 # wait for its trainers' reshard-done records; expiry on either side
@@ -157,6 +159,28 @@ RESIZE_RESHARD_TIMEOUT = _f("EDL_TPU_RESIZE_RESHARD_TIMEOUT", 60.0)
 # anyway, stop-resume (which overlaps the fetch with process respawn)
 # is cheaper.  0 = always attempt delta when enabled
 RESIZE_MIN_DELTA = _f("EDL_TPU_RESIZE_MIN_DELTA", 0.0)
+
+# -- delta replication plane: sub-checkpoint-loss failover (ISSUE 17) ------
+# stream optimizer/param-state DELTAS to the consistent-hash ring
+# replica every N steps, off the critical path, so a crash loses at
+# most N steps instead of a checkpoint interval (memstate/delta.py).
+# 0 disables the plane entirely (no step hook, no chains); requires
+# EDL_TPU_MEMSTATE=1 and a committed base checkpoint to be active
+DELTA_EVERY = int(_f("EDL_TPU_DELTA_EVERY", 10))
+# bound on delta records retained per chain in a cache service; when a
+# chain grows past it the two OLDEST records merge (freshest bytes win,
+# linkage preserved), so freshness keeps growing under a fixed RAM cap
+DELTA_MAX_CHAIN = int(_f("EDL_TPU_DELTA_MAX_CHAIN", 64))
+
+# -- first-class world-derived hyperparameter re-scale (ISSUE 17) ----------
+# 1 wraps every trainer-built optimizer with a world-scale stage
+# (train/lr.world_scaled) and linearly re-scales the effective LR with
+# the global batch (new_world / old_world) on every resize — the
+# reference's linear-scaling rule (state.py:142) without ad-hoc
+# trainer.adjust hooks.  Off by default: it changes the opt_state
+# pytree (one extra scalar leaf), so flipping it mid-job invalidates
+# checkpoints taken without it
+LR_RESCALE = int(_f("EDL_TPU_LR_RESCALE", 0))
 
 # -- in-memory peer checkpoint cache (edl_tpu/memstate) -------------------
 # 0 disables the cache entirely (saves are not teed, restores go
@@ -260,8 +284,11 @@ SERVING_RESULT_TTL = _f("EDL_TPU_SERVING_RESULT_TTL", 600.0)
 # -- paged KV cache + session migration (serving/kv_cache.py) -------------
 # KV block size in tokens for the replica CLI's engine; 0 keeps the
 # pre-paged contiguous slabs (no prefix reuse, no migration).  Library
-# constructors take kv_block= directly.
-KV_BLOCK = int(_f("EDL_TPU_KV_BLOCK", 0))
+# constructors take kv_block= directly.  ON by default since the
+# ROADMAP item 3 burn-in (ISSUE 17) — EDL_TPU_KV_BLOCK=0 is the
+# documented opt-out to contiguous slabs (mesh/tp engines still
+# construct with kv_block=0 explicitly: the pool is single-device).
+KV_BLOCK = int(_f("EDL_TPU_KV_BLOCK", 16))
 # pool capacity in blocks; 0 sizes it at 2x the slot pool's worth so a
 # full fleet of lanes can commit without evicting each other
 KV_POOL_BLOCKS = int(_f("EDL_TPU_KV_POOL_BLOCKS", 0))

@@ -86,6 +86,16 @@ JAX_PLATFORMS=cpu python scripts/data_chaos_smoke.py
 # cleanly to the proven stop-resume path and still SUCCEED
 JAX_PLATFORMS=cpu python scripts/resize_smoke.py
 
+# delta failover smoke: sub-checkpoint-loss recovery — real launchers +
+# durable coord, one pod SIGKILLed mid-delta-interval (sealed chain
+# records observably past the committed checkpoint): the job must
+# SUCCEED with restore_source=delta, the restore must land at/past the
+# freshest sealed step (steps lost <= the delta cadence, not the
+# checkpoint interval), and the identical kill with the plane disabled
+# must resume AT the checkpoint — badput-per-failure strictly below
+# the stop-resume baseline
+JAX_PLATFORMS=cpu python scripts/delta_failover_smoke.py
+
 # obs-agg smoke: 2 child processes + parent — one trace_id propagated
 # over the EDL1 wire into both children's trace files, the aggregator
 # discovers all three via coord-store adverts and serves a merged
@@ -176,6 +186,14 @@ assert out['obs_scrape_overhead_pct'] < 5, out['obs_scrape_overhead_pct']
 # on the same grow-by-one (it skips process respawn + jax cold import)
 dl, sr = out['resize_delta_mttr_s'], out['resize_stop_resume_mttr_s']
 assert dl <= sr, (dl, sr)
+# delta replication plane (ISSUE 17): a cadence step must ship fewer
+# bytes than a full shard set (only the hot slice changes), the chain
+# restore must work, and an induced mid-interval failure must lose
+# fewer steps on the chain path than the checkpoint rollback
+assert out['delta_bytes_per_step_mb'] < out['delta_full_shard_mb'], out
+assert out.get('delta_lag_p50_ms') is not None, out
+assert out['delta_steps_lost_per_failure'] \
+    < out['checkpoint_steps_lost_per_failure'], out
 # continuous profiling (ISSUE 13): the per-step phase ledger must cost
 # the hot loop under 2% of step time (measured directly, noise-immune)
 assert out['step_phase_overhead_pct'] < 2, out['step_phase_overhead_pct']

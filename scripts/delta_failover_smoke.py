@@ -1,0 +1,243 @@
+"""CI delta-failover smoke: sub-checkpoint-loss recovery (ISSUE 17).
+
+Two phases against REAL launchers + a durable coordinator, each
+SIGKILLing one non-leader pod mid-epoch (between per-epoch
+checkpoints), on a paced 2-host CPU/gloo world:
+
+1. **Delta plane ON** (EDL_TPU_DELTA_EVERY=2) — the kill lands
+   mid-delta-interval, after the smoke has OBSERVED (probe_freshest)
+   sealed chain records past the committed checkpoint.  The job must
+   finish SUCCEED, the recovery record must carry
+   ``restore_source=delta``, and the restore log must show the landed
+   step F strictly past the committed base AND >= the freshest sealed
+   step observed at kill time — i.e. the failure lost at most one
+   delta interval of steps, not the checkpoint interval.
+2. **Baseline OFF** (EDL_TPU_DELTA_EVERY=0) — the identical kill with
+   the delta plane disabled resumes AT the committed checkpoint step
+   (``restore_source`` peer/storage): every step past the last save is
+   badput.
+
+The gate: preserved-steps-per-failure with the plane on is strictly
+positive while the stop-resume baseline preserves zero by construction
+— badput-per-failure (lost steps x paced step time, the goodput
+ledger's checkpoint_loss component) is strictly below the baseline for
+equivalently timed kills.  Prints one JSON line so the numbers trend
+in the CI log.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/delta_failover_smoke.py
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from resize_smoke import (  # noqa: E402  (same harness, same knobs)
+    FAST, finish, kill_tree, spawn_coord, spawn_launcher, trainer_pids,
+    wait_first_checkpoint, wait_world,
+)
+
+STEP_SLEEP = float(FAST["EDL_TPU_DEMO_STEP_SLEEP"])
+DELTA_EVERY = 2
+
+_DELTA_RESTORE = re.compile(
+    r"memstate: restored step (\d+) from peers .*base (\d+) \+ delta chains")
+
+
+def _logs_text(tmp: str, names) -> str:
+    """All launcher+trainer log text for THIS phase's pods only — both
+    phases share one tmp dir, so an unscoped glob would leak phase 1's
+    delta-restore lines into phase 2's no-delta assertion."""
+    out = []
+    for path in glob.glob(os.path.join(tmp, "**"), recursive=True):
+        if not os.path.isfile(path):
+            continue
+        rel = os.path.relpath(path, tmp)
+        if not any(rel.startswith((f"launcher-{n}", f"log-{n}"))
+                   for n in names):
+            continue
+        try:
+            with open(path, "rb") as f:
+                out.append(f.read().decode(errors="replace"))
+        except OSError:
+            continue
+    return "\n".join(out)
+
+
+def _wait_recovery_source(client, job_id, deadline_s=180) -> dict:
+    from edl_tpu.cluster.recovery import summarize_recovery
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            recs = [s for s in summarize_recovery(client, job_id)
+                    if s.get("restore_source")]
+        except Exception:  # noqa: BLE001 — store warming up
+            recs = []
+        if recs:
+            return recs[-1]
+        time.sleep(0.3)
+    raise AssertionError("no recovery record with a restore_source")
+
+
+def _pick_victim(tmp, procs, cluster):
+    """The highest-rank (non-leader) pod's launcher: leader death also
+    kills the jax coordination service — a different, slower scenario
+    than the shard-loss this smoke measures."""
+    from resize_smoke import log_text
+    victim_pod = cluster.pods[-1].pod_id
+    return next(n for n in procs if f"pod {victim_pod}" in log_text(tmp, n))
+
+
+def phase_delta(tmp, coord_ep) -> dict:
+    from edl_tpu import memstate
+    from edl_tpu.cluster.status import Status, load_job_status
+    from edl_tpu.coord.client import connect
+    job = "delta-fo"
+    ckpt = os.path.join(tmp, "ckpt-delta")
+    env = {"EDL_TPU_DELTA_EVERY": str(DELTA_EVERY)}
+    os.environ.update(env)
+    procs = {n: spawn_launcher(job, coord_ep, tmp, n, ckpt, epochs=12,
+                               steps=8) for n in ("da", "db")}
+    try:
+        client = connect(coord_ep)
+        cluster = wait_world(client, job, 2)
+        wait_first_checkpoint(ckpt, tuple(procs.values()))
+        # mid-delta-interval kill: wait until sealed chain records are
+        # OBSERVABLY past the committed base, remember the freshest —
+        # the restore may not land below it
+        deadline = time.monotonic() + 120
+        committed = freshest = None
+        while time.monotonic() < deadline:
+            try:
+                committed, freshest = memstate.probe_freshest(client, job)
+            except Exception:  # noqa: BLE001 — caches still warming up
+                committed = freshest = None
+            if committed is not None and freshest is not None \
+                    and freshest > committed:
+                break
+            assert all(p.poll() is None for p in procs.values()), \
+                "a launcher died before any delta record sealed"
+            time.sleep(0.1)
+        assert freshest is not None, "no delta chain sealed in 120s"
+
+        victim = _pick_victim(tmp, procs, cluster)
+        assert trainer_pids(procs[victim]), "victim has no trainer yet"
+        kill_tree(procs[victim])  # SIGKILL: pod + cache service, all gone
+        t_kill = time.monotonic()
+
+        rec = _wait_recovery_source(client, job)
+        survivors = [p for n, p in procs.items() if n != victim]
+        assert all(finish(p, 300) == 0 for p in survivors), \
+            "survivors failed after the mid-interval SIGKILL"
+        assert load_job_status(client, job) == Status.SUCCEED
+        client.close()
+
+        assert rec.get("restore_source") == "delta", (
+            f"expected restore_source=delta, got {rec}")
+        hits = [(int(a), int(b))
+                for a, b in _DELTA_RESTORE.findall(_logs_text(tmp, procs))]
+        assert hits, "no base+chain restore line found in any log"
+        landed, base = max(hits)
+        assert landed > base, (landed, base)
+        assert landed >= freshest, (
+            f"restore landed at {landed}, below the freshest sealed "
+            f"step {freshest} observed before the kill")
+        print(f"delta failover smoke: ON OK — killed past committed "
+              f"{committed} with chains at {freshest}; restored at "
+              f"{landed} (base {base}), restore_source=delta, "
+              f"mttr {rec.get('total', -1):.2f}s")
+        return {"landed": landed, "base": base,
+                "preserved_steps": landed - base,
+                "mttr_s": float(rec.get("total", -1)),
+                "t_recover_s": round(time.monotonic() - t_kill, 2)}
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+        for p in procs.values():
+            if p.poll() is None:
+                kill_tree(p)
+
+
+def phase_baseline(tmp, coord_ep) -> dict:
+    from edl_tpu.cluster.status import Status, load_job_status
+    from edl_tpu.coord.client import connect
+    job = "delta-fo-base"
+    ckpt = os.path.join(tmp, "ckpt-base")
+    env = {"EDL_TPU_DELTA_EVERY": "0"}  # stop-resume loss window
+    os.environ.update(env)
+    procs = {n: spawn_launcher(job, coord_ep, tmp, n, ckpt, epochs=12,
+                               steps=8) for n in ("ba", "bb")}
+    try:
+        client = connect(coord_ep)
+        cluster = wait_world(client, job, 2)
+        wait_first_checkpoint(ckpt, tuple(procs.values()))
+        # the same mid-epoch kill point, timed instead of probed (there
+        # are no chains to probe): a few paced steps past the save
+        time.sleep(max(1.0, (DELTA_EVERY + 1) * STEP_SLEEP))
+        victim = _pick_victim(tmp, procs, cluster)
+        kill_tree(procs[victim])
+
+        rec = _wait_recovery_source(client, job)
+        survivors = [p for n, p in procs.items() if n != victim]
+        assert all(finish(p, 300) == 0 for p in survivors), \
+            "baseline survivors failed after SIGKILL"
+        assert load_job_status(client, job) == Status.SUCCEED
+        client.close()
+
+        assert rec.get("restore_source") in ("peer", "storage", "delta"), rec
+        assert not _DELTA_RESTORE.findall(_logs_text(tmp, procs)), \
+            "baseline run must not restore from delta chains"
+        print(f"delta failover smoke: BASELINE OK — resumed at the "
+              f"committed step (restore_source={rec.get('restore_source')}, "
+              f"mttr {rec.get('total', -1):.2f}s)")
+        return {"preserved_steps": 0,
+                "mttr_s": float(rec.get("total", -1))}
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+        for p in procs.values():
+            if p.poll() is None:
+                kill_tree(p)
+
+
+def main() -> None:
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    tmp = tempfile.mkdtemp(prefix="edl-delta-fo-")
+    coord, coord_ep = spawn_coord(tmp)
+    try:
+        delta_res = base_res = None
+        if only in (None, "delta"):
+            delta_res = phase_delta(tmp, coord_ep)
+        if only in (None, "baseline"):
+            base_res = phase_baseline(tmp, coord_ep)
+        if delta_res and base_res:
+            # the badput gate: lost-work-per-failure strictly below the
+            # stop-resume baseline (which preserves nothing past the
+            # checkpoint by construction)
+            assert delta_res["preserved_steps"] > base_res["preserved_steps"]
+            print(json.dumps({
+                "delta_preserved_steps": delta_res["preserved_steps"],
+                "delta_restore_step": delta_res["landed"],
+                "delta_base_step": delta_res["base"],
+                "delta_mttr_s": round(delta_res["mttr_s"], 3),
+                "baseline_preserved_steps": base_res["preserved_steps"],
+                "baseline_mttr_s": round(base_res["mttr_s"], 3),
+                "badput_steps_saved_per_failure":
+                    delta_res["preserved_steps"],
+            }))
+        print("delta failover smoke OK")
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait(timeout=30)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""World-derived LR re-scaling (EDL_TPU_LR_RESCALE, edl_tpu/train/lr):
+the trailing world_scaled transform multiplies the FINAL update, its
+scalar lives in the optimizer state (rides checkpoints and deltas),
+and rescale_state applies new_world/old_world on grow AND shrink,
+compounding across repeated resizes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.train import lr as lr_mod
+
+
+def _setup(lr=0.1):
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    tx = lr_mod.world_scaled(optax.sgd(lr))
+    return params, tx, tx.init(params)
+
+
+def _step_delta(params, tx, opt_state):
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return float(np.asarray(updates["w"][0])), opt_state
+
+
+def test_world_scaled_identity_before_any_resize():
+    params, tx, opt_state = _setup(lr=0.1)
+    d, _ = _step_delta(params, tx, opt_state)
+    assert np.isclose(d, -0.1), "wrapper must not perturb the base update"
+
+
+def test_rescale_grow_scales_update_linearly():
+    params, tx, opt_state = _setup(lr=0.1)
+    grown = lr_mod.rescale_state(opt_state, 8 / 4)  # 4 -> 8 pods
+    d, _ = _step_delta(params, tx, grown)
+    assert np.isclose(d, -0.2), d
+
+
+def test_rescale_shrink_scales_update_linearly():
+    params, tx, opt_state = _setup(lr=0.1)
+    shrunk = lr_mod.rescale_state(opt_state, 2 / 4)  # 4 -> 2 pods
+    d, _ = _step_delta(params, tx, shrunk)
+    assert np.isclose(d, -0.05), d
+
+
+def test_rescale_compounds_and_round_trips():
+    params, tx, opt_state = _setup(lr=0.1)
+    s = lr_mod.rescale_state(opt_state, 8 / 4)   # 4 -> 8
+    s = lr_mod.rescale_state(s, 4 / 8)           # 8 -> 4: back to 1.0
+    d, _ = _step_delta(params, tx, s)
+    assert np.isclose(d, -0.1), d
+
+
+def test_scale_state_survives_update_and_noops_unwrapped():
+    params, tx, opt_state = _setup(lr=0.1)
+    scaled = lr_mod.rescale_state(opt_state, 2.0)
+    _d, after = _step_delta(params, tx, scaled)
+    d2, _ = _step_delta(params, tx, after)
+    assert np.isclose(d2, -0.2), "the scale must persist across steps"
+    # a plain (unwrapped) opt_state passes through rescale_state untouched
+    plain = optax.sgd(0.1).init(params)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+        plain, lr_mod.rescale_state(plain, 3.0)))
+
+
+def test_world_scaled_adam_effective_lr(monkeypatch):
+    """Adam's update is proportional to its LR, so the trailing scale is
+    an exact effective-LR change there too, inside a jitted step."""
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    tx = lr_mod.world_scaled(optax.adam(1e-3))
+    opt_state = tx.init(params)
+    grads = {"w": jnp.full((4,), 0.5, jnp.float32)}
+
+    @jax.jit
+    def step(g, s):
+        return tx.update(g, s, params)
+
+    base, _ = step(grads, opt_state)
+    scaled, _ = step(grads, lr_mod.rescale_state(opt_state, 4.0))
+    assert np.allclose(np.asarray(scaled["w"]),
+                       4.0 * np.asarray(base["w"]), rtol=1e-5)
+
+
+def test_trainer_world_lr_rescale_gate(monkeypatch):
+    """The trainer helper applies the factor only when the knob is on."""
+    from edl_tpu.utils import constants
+    from edl_tpu.train.trainer import ElasticTrainer
+
+    params = {"w": jnp.ones((2,), jnp.float32)}
+    tx = lr_mod.world_scaled(optax.sgd(0.1))
+    state = {"opt": tx.init(params)}
+
+    monkeypatch.setattr(constants, "LR_RESCALE", 0)
+    off = ElasticTrainer._world_lr_rescale(object(), state, 4, 8)
+    assert float(np.asarray(off["opt"][1].lr_scale)) == 1.0
+
+    monkeypatch.setattr(constants, "LR_RESCALE", 1)
+    on = ElasticTrainer._world_lr_rescale(object(), state, 4, 8)
+    assert float(np.asarray(on["opt"][1].lr_scale)) == 2.0
+    # no-op factors never touch the tree
+    same = ElasticTrainer._world_lr_rescale(object(), state, 8, 8)
+    assert same is state
