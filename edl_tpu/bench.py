@@ -311,6 +311,16 @@ def _main_impl(out: dict) -> None:
             import traceback
             traceback.print_exc()
 
+    # -- distill fleet elasticity: student rows/s at 1 vs 3 teachers +
+    # backlog->autoscaler-step latency (ISSUE 18); pure fleet machinery,
+    # no model — runs on CPU boxes too
+    if os.environ.get("EDL_TPU_BENCH_DISTILL_FLEET", "1") != "0":
+        try:
+            out.update(_bench_distill_fleet())
+        except Exception:  # noqa: BLE001 — secondary metric, never fatal
+            import traceback
+            traceback.print_exc()
+
     # -- resize cost: peer-cache vs storage restore (memstate) ---------------
     # the number ISSUE 2 exists to move — same state, restored once from
     # a surviving peer's RAM and once from the Orbax directory
@@ -1989,6 +1999,93 @@ def _bench_distill(n_dev: int, size: int) -> dict:
         "distill_teacher_rows_s": tstats["rows_per_s"],
         "distill_teacher_batch": tbs,
     }
+
+
+def _bench_distill_fleet() -> dict:
+    """Teacher-fleet elasticity (ISSUE 18), measured store-up: student
+    rows/s through the DistillFleet routed view at 1 vs 3 teachers
+    (same deliberately-slow predict_fn), and the latency from a
+    published backlog record to the DistillAutoscaler stepping its
+    target.  No model involved — the numbers belong to the fleet
+    machinery (discovery, routing, pool rebalance, backlog->demand),
+    so this runs everywhere, CPU boxes included."""
+    from edl_tpu.cluster import scale as scale_mod
+    from edl_tpu.controller.autoscale import DistillAutoscaler
+    from edl_tpu.coord.memory import MemoryKV
+    from edl_tpu.distill.backlog import StudentFeed
+    from edl_tpu.distill.fleet import DistillFleet, TeacherReplica
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.distill.teacher import TeacherServer
+
+    n_batches = int(os.environ.get("EDL_TPU_BENCH_DISTILL_FLEET_BATCHES", 30))
+    bs = 8
+    # per-forward sleep: large vs loopback RPC cost so the 1->3 speedup
+    # reflects fan-out, not noise
+    delay = float(os.environ.get("EDL_TPU_BENCH_DISTILL_FLEET_DELAY", 0.02))
+
+    def predict_fn(feed):
+        time.sleep(delay)               # stands in for a teacher forward
+        return {"prediction": feed["x"] * 2.0}
+
+    def gen():
+        for b in range(n_batches):
+            yield [(np.full((4,), b * bs + i, np.float32), b * bs + i)
+                   for i in range(bs)]
+
+    out: dict = {}
+    store = MemoryKV(sweep_period=0.2)
+    try:
+        for n_teachers in (1, 3):
+            replicas = [
+                TeacherReplica(store, "bench-teach",
+                               TeacherServer(predict_fn, port=0),
+                               "bench-svc", replica_id=f"t{n_teachers}-{i}",
+                               ttl=5.0, advert_period=0.25)
+                for i in range(n_teachers)]
+            try:
+                fleet = DistillFleet(store, "bench-teach", period=0.1)
+                if not fleet.wait_for(n_teachers, timeout=10.0):
+                    raise RuntimeError("teacher adverts never appeared")
+                dr = DistillReader(ins=["x", "idx"], predicts=["prediction"],
+                                   feeds=["x"], teacher_batch_size=bs)
+                dr.set_sample_list_generator(gen)
+                dr.set_servers_fn(fleet.endpoints_fn())
+                dr._pool_kw = {"manage_period": 0.1,
+                               "no_teacher_timeout": 30.0}
+                feed = StudentFeed(store, "bench-teach", dr,
+                                   student_id=f"bench-{n_teachers}",
+                                   period=0.2)
+                rows = 0
+                t0 = time.perf_counter()
+                for batch in feed:
+                    rows += len(batch[0])
+                dt = time.perf_counter() - t0
+                out[f"distill_student_rows_s_{n_teachers}"] = round(
+                    rows / dt, 1)
+            finally:
+                for r in replicas:
+                    try:
+                        r.stop()
+                    except Exception as e:  # noqa: BLE001 — bench teardown
+                        print(f"teacher stop failed (ignored): {e}",
+                              file=sys.stderr)
+        # backlog record -> autoscaler target step: the demand half of
+        # the loop the chaos smoke proves end-to-end via the controller
+        auto = DistillAutoscaler(store, step=1, grow_s=0.05, hold_s=0.1,
+                                 quiet_s=60.0, demand_ttl=30.0)
+        if auto.desired("bench-lat", 1, 3, 1) != 1:
+            raise RuntimeError("autoscaler grew with no backlog record")
+        t0 = time.perf_counter()
+        scale_mod.save_backlog(store, "bench-lat", "s0", 10_000, 10.0)
+        while auto.desired("bench-lat", 1, 3, 1) < 2:
+            if time.perf_counter() - t0 > 30.0:
+                raise RuntimeError("autoscaler never stepped the target")
+            time.sleep(0.02)
+        out["distill_backlog_scale_latency_s"] = round(
+            time.perf_counter() - t0, 3)
+    finally:
+        store.close()
+    return out
 
 
 if __name__ == "__main__":

@@ -62,16 +62,23 @@ def load_nodes_range(store, job_id: str) -> tuple[int, int] | None:
 
 # -- multi-job arbitration records (controller/policy.py) -----------------
 def save_job_spec(store, job_id: str, kind: str = "training",
-                  priority: int | None = None, gang: bool = False) -> None:
+                  priority: int | None = None, gang: bool = False,
+                  fleet: bool = False) -> None:
     """Arbitration spec for one job: ``kind`` (training / distill /
     serving — serving jobs are counted by their replica adverts, not a
     cluster record), ``priority`` (surplus capacity goes to higher
     classes first; None = the kind's default, policy.KIND_PRIORITY) and
-    ``gang`` (atomic placement: min_nodes or nothing).  Published by
-    whoever owns the job's deployment; absent = a plain training job."""
+    ``gang`` (atomic placement: min_nodes or nothing).  ``fleet`` marks
+    a ``kind="distill"`` job as an advert-backed teacher fleet: its
+    members are counted by their serving-table adverts (like a serving
+    job) and its demand comes from the DistillAutoscaler's backlog
+    signal, not a cluster record.  Published by whoever owns the job's
+    deployment; absent = a plain training job."""
     spec = {"kind": kind, "gang": bool(gang)}
     if priority is not None:
         spec["priority"] = int(priority)
+    if fleet:
+        spec["fleet"] = True
     store.put(paths.key(job_id, constants.ETCD_SCALE, "spec"),
               json.dumps(spec).encode())
 
@@ -117,3 +124,44 @@ def load_demand(store, job_id: str) -> dict | None:
 
 def clear_demand(store, job_id: str) -> None:
     store.delete(paths.key(job_id, constants.ETCD_SCALE, "demand"))
+
+
+# -- distill backlog records (controller/autoscale.DistillAutoscaler) ------
+def save_backlog(store, job_id: str, student_id: str, queued_rows: int,
+                 rows_per_s: float, by: str = "student") -> None:
+    """One student's durable backlog signal for a teacher-fleet job:
+    rows it has queued for teacher inference and the teacher throughput
+    it is observing.  Per-student keys (``scale/backlog/<student>``) so
+    concurrent students never clobber each other; the DistillAutoscaler
+    sums the FRESH records (same EDL_TPU_DEMAND_TTL freshness rule as
+    demand records — a dead student's last backlog decays instead of
+    pinning teachers scaled out)."""
+    store.put(paths.key(job_id, constants.ETCD_SCALE,
+                        f"backlog/{student_id}"),
+              json.dumps({"queued_rows": int(queued_rows),
+                          "rows_per_s": float(rows_per_s), "by": by,
+                          "at": time.time()}).encode())
+
+
+def load_backlogs(store, job_id: str) -> dict[str, dict]:
+    """Every student's backlog record:
+    ``{student_id: {"queued_rows", "rows_per_s", "at"}}`` (torn records
+    skipped — the writer re-publishes every period)."""
+    prefix = paths.key(job_id, constants.ETCD_SCALE, "backlog/")
+    recs, _rev = store.get_prefix(prefix)
+    out: dict[str, dict] = {}
+    for rec in recs:
+        try:
+            d = json.loads(rec.value.decode())
+            out[rec.key[len(prefix):]] = {
+                "queued_rows": int(d["queued_rows"]),
+                "rows_per_s": float(d.get("rows_per_s", 0.0)),
+                "at": float(d.get("at", 0.0))}
+        except (ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def clear_backlog(store, job_id: str, student_id: str) -> None:
+    store.delete(paths.key(job_id, constants.ETCD_SCALE,
+                           f"backlog/{student_id}"))

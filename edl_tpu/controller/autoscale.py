@@ -135,3 +135,105 @@ class ServingAutoscaler:
         self._state[job_id] = (last, target)
         _DEMAND_G.labels(job=job_id).set(target)
         return target
+
+
+_DISTILL_DEMAND_G = obs_metrics.gauge(
+    "edl_controller_distill_demand",
+    "The distill autoscaler's current teacher target per fleet job",
+    ("job",))
+
+
+class DistillAutoscaler:
+    """Teacher-count targets for ``kind="distill"`` fleet jobs, from
+    the students' durable backlog records (``scale/backlog/<student>``,
+    written by :class:`~edl_tpu.distill.backlog.StudentFeed`).
+
+    The signal is **backlog seconds** — total queued rows across fresh
+    student records divided by the observed teacher throughput.  Growth
+    is deliberately two-staged so a single burst can't flap the fleet:
+    backlog above ``EDL_TPU_DISTILL_BACKLOG_GROW`` seconds, held
+    continuously for ``EDL_TPU_DISTILL_BACKLOG_HOLD`` seconds, steps
+    the target by ``EDL_TPU_AUTOSCALE_STEP`` and re-arms (so 1→3 takes
+    two held windows).  Decay mirrors the ServingAutoscaler: one step
+    per ``EDL_TPU_AUTOSCALE_QUIET`` window without a growth-worthy
+    signal, down to min_nodes.  Records older than
+    ``EDL_TPU_DEMAND_TTL`` are ignored — a dead student's last backlog
+    decays instead of pinning teachers out.  Targets are clamped to
+    the job's published nodes range, and the controller feeds them
+    into the SAME arbitration (priority classes, cooldowns, eviction
+    grace) as every other demand."""
+
+    def __init__(self, store, step: int | None = None,
+                 grow_s: float | None = None, hold_s: float | None = None,
+                 quiet_s: float | None = None,
+                 demand_ttl: float | None = None):
+        self._store = store
+        self._step = (int(env_float("EDL_TPU_AUTOSCALE_STEP", 1))
+                      if step is None else int(step))
+        self._grow = (env_float("EDL_TPU_DISTILL_BACKLOG_GROW", 5.0)
+                      if grow_s is None else float(grow_s))
+        self._hold = (env_float("EDL_TPU_DISTILL_BACKLOG_HOLD", 15.0)
+                      if hold_s is None else float(hold_s))
+        self._quiet = (env_float("EDL_TPU_AUTOSCALE_QUIET", 120.0)
+                       if quiet_s is None else float(quiet_s))
+        self._demand_ttl = (env_float("EDL_TPU_DEMAND_TTL", 120.0)
+                            if demand_ttl is None else float(demand_ttl))
+        # job -> (above_since | None, last_signal_mono, target)
+        self._state: dict[str, tuple[float | None, float, int]] = {}
+
+    # -- inputs --------------------------------------------------------------
+    def backlog_seconds(self, job_id: str) -> float | None:
+        """Summed fresh backlog across students, in seconds of work at
+        the observed aggregate teacher rate; None = no fresh records
+        (unknown, which never grows the fleet)."""
+        try:
+            records = scale.load_backlogs(self._store, job_id)
+        except Exception:  # noqa: BLE001 — a store blip is not a signal
+            logger.exception("backlog records unreadable for %s", job_id)
+            return None
+        # edl-lint: disable=clock — rec["at"] is the student's
+        # wall-clock stamp read from the store; freshness across
+        # processes can only be judged wall-to-wall
+        now_wall = time.time()
+        fresh = [r for r in records.values()
+                 if now_wall - r["at"] <= self._demand_ttl]
+        if not fresh:
+            return None
+        queued = sum(r["queued_rows"] for r in fresh)
+        rate = sum(r["rows_per_s"] for r in fresh)
+        # rows-as-seconds floor when no throughput was observed yet, the
+        # same convention the StudentFeed gauge uses
+        return queued / rate if rate > 0 else float(queued)
+
+    # -- the decision --------------------------------------------------------
+    def desired(self, job_id: str, min_nodes: int, max_nodes: int,
+                current: int, now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        backlog_s = self.backlog_seconds(job_id)
+        above_since, last, target = self._state.get(
+            job_id, (None, now, max(min_nodes, min(max_nodes, current))))
+        if backlog_s is not None and backlog_s > self._grow:
+            if above_since is None:
+                above_since = now
+            if now - above_since >= self._hold:
+                target = min(max_nodes, target + self._step)
+                above_since = now        # re-arm: one step per held window
+                logger.info("distill job %s backlog %.1fs held %.0fs: "
+                            "scaling out to %d", job_id, backlog_s,
+                            self._hold, target)
+            last = now
+        else:
+            above_since = None
+            if backlog_s is not None and backlog_s > 0:
+                # fresh-but-small backlog: teachers are keeping up but
+                # the fleet is in use — refresh the quiet clock
+                last = now
+            elif now - last > self._quiet and target > min_nodes:
+                target -= 1              # one step per quiet window
+                last = now
+                logger.info("distill job %s quiet for %.0fs: scaling in "
+                            "to %d", job_id, self._quiet, target)
+        target = max(min_nodes, min(max_nodes, target))
+        self._state[job_id] = (above_since, last, target)
+        _DISTILL_DEMAND_G.labels(job=job_id).set(target)
+        return target

@@ -26,7 +26,7 @@ from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.cluster.status import Status, load_job_status
 from edl_tpu.cluster.train_status import SCALABLE, load_train_statuses
 from edl_tpu.controller.actuator import NullActuator
-from edl_tpu.controller.autoscale import ServingAutoscaler
+from edl_tpu.controller.autoscale import DistillAutoscaler, ServingAutoscaler
 from edl_tpu.controller.policy import KIND_PRIORITY, JobView, compute_desired
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import context as obs_context
@@ -63,6 +63,7 @@ class Controller:
                  observe_window_s: float = 900.0,
                  alerts_url: str | None = None,
                  autoscaler: ServingAutoscaler | None = None,
+                 distill_autoscaler: DistillAutoscaler | None = None,
                  preempt_grace_s: float = 0.0):
         """``capacity``: schedulable pod slots across the cluster (the
         k8s node budget; the thing ``max_load_desired`` scales).
@@ -112,6 +113,8 @@ class Controller:
         self._reaped: set[str] = set()
         self._autoscaler = autoscaler or ServingAutoscaler(
             store, alerts_url=alerts_url)
+        self._distill_autoscaler = distill_autoscaler or DistillAutoscaler(
+            store)
         self._preempt_grace = float(preempt_grace_s)
         # job -> in-flight graceful eviction {want, pods, stage, deadline}
         self._evictions: dict[str, dict] = {}
@@ -157,17 +160,22 @@ class Controller:
         kind = str(spec.get("kind", "training"))
         priority = int(spec.get("priority", KIND_PRIORITY.get(kind, 0)))
         gang = bool(spec.get("gang", False))
-        if kind == "serving":
-            # a replica fleet has no cluster record or train status:
-            # the live serving adverts ARE the membership, and the
-            # autoscaler's demand caps its surplus take
+        fleet = kind == "serving" or (kind == "distill"
+                                      and bool(spec.get("fleet")))
+        if fleet:
+            # an advert-backed fleet has no cluster record or train
+            # status: the live serving adverts ARE the membership, and
+            # an autoscaler's demand caps its surplus take — gateway
+            # alerts/demand records for serving, the students' backlog
+            # records for a distill teacher fleet
             from edl_tpu.gateway.fleet import list_replicas
             current = len(list_replicas(self._store, job_id))
             view = JobView(job_id=job_id, min_nodes=rng[0],
                            max_nodes=rng[1], current_nodes=current,
                            kind=kind, priority=priority, gang=gang)
-            view.demand = self._autoscaler.desired(job_id, rng[0], rng[1],
-                                                   current)
+            scaler = (self._autoscaler if kind == "serving"
+                      else self._distill_autoscaler)
+            view.demand = scaler.desired(job_id, rng[0], rng[1], current)
             return view
         cluster = Cluster.load_from_store(self._store, job_id)
         current = len(cluster.pods) if cluster else 0

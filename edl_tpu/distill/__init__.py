@@ -18,10 +18,19 @@ their own batch fields.  TPU-native redesign of the reference's
   discovery_server.py/balance_table.py;
 - :mod:`~edl_tpu.distill.teacher` — the TPU teacher server: a jitted
   fixed-shape (pad-and-bucket) forward served over the EDL1 wire,
-  replacing Paddle Serving GPU teachers.
+  replacing Paddle Serving GPU teachers;
+- :mod:`~edl_tpu.distill.fleet` + :mod:`~edl_tpu.distill.backlog` —
+  the orchestration layer (ROADMAP item 4): teachers advertised as
+  serving replicas on one shared CoordSession, routed/hedged/failed
+  over through the gateway's FleetView, and a StudentFeed publishing
+  the durable backlog signal the controller's DistillAutoscaler
+  converts into teacher count.
 """
 
+from edl_tpu.distill.backlog import StudentFeed
+from edl_tpu.distill.fleet import DistillFleet, TeacherReplica
 from edl_tpu.distill.reader import DistillReader
 from edl_tpu.distill.predict_client import NopPredictClient, TeacherClient
 
-__all__ = ["DistillReader", "TeacherClient", "NopPredictClient"]
+__all__ = ["DistillReader", "TeacherClient", "NopPredictClient",
+           "DistillFleet", "TeacherReplica", "StudentFeed"]

@@ -101,7 +101,20 @@ class Service:
         self._refresh_servers()
 
     def _refresh_servers(self) -> None:
-        recs, _ = self._store.get_prefix(service_prefix(self.name))
+        # the store read rides the ResilientCoordClient's retry/failover
+        # (coord.client.connect default), deadline-scoped so a coord
+        # outage costs one bounded round; a blip that still escapes
+        # DEFERS the rebalance round (stale teacher set kept, watcher
+        # retries next poll) instead of unwinding into the watcher
+        # callback and silently dropping it
+        try:
+            with self._store.scoped_deadline(5.0):
+                recs, _ = self._store.get_prefix(service_prefix(self.name))
+        except Exception as e:  # noqa: BLE001 — keep the stale view
+            logger.warning("service %s teacher refresh failed (%s); "
+                           "rebalance round deferred to the next watch "
+                           "poll", self.name, e)
+            return
         prefix_len = len(service_prefix(self.name))
         servers = {r.key[prefix_len:] for r in recs}
         with self._lock:
@@ -243,7 +256,17 @@ class BalanceTable:
         self._refresh_ring()
 
     def _refresh_ring(self) -> None:
-        recs, _ = self._store.get_prefix(service_prefix(BALANCE_SERVICE))
+        # same deferral contract as Service._refresh_servers: a coord
+        # blip keeps the stale ring (we always include ourselves, so
+        # requests keep being served) rather than killing the watcher
+        try:
+            with self._store.scoped_deadline(5.0):
+                recs, _ = self._store.get_prefix(
+                    service_prefix(BALANCE_SERVICE))
+        except Exception as e:  # noqa: BLE001 — keep the stale ring
+            logger.warning("balance ring refresh failed (%s); keeping "
+                           "the previous ring until the next watch poll", e)
+            return
         plen = len(service_prefix(BALANCE_SERVICE))
         nodes = sorted({r.key[plen:] for r in recs} | {self._endpoint})
         self._hash = ConsistentHash(nodes)

@@ -14,7 +14,7 @@ import os
 import threading
 import uuid
 
-from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession, leased_register
 from edl_tpu.distill.balance import (
     BALANCE_SERVICE, NO_READY, OK, REDIRECT, UNREGISTERED, BalanceTable,
     server_key,
@@ -31,7 +31,8 @@ class DiscoveryServer:
     """``python -m edl_tpu.distill.discovery --coord_endpoints ...``"""
 
     def __init__(self, store, host: str | None = None, port: int = 0,
-                 ttl: float | None = None, client_ttl: float | None = None):
+                 ttl: float | None = None, client_ttl: float | None = None,
+                 session: CoordSession | None = None):
         host = host or local_ip()
         self._rpc = RpcServer(host="0.0.0.0", port=port)
         self.endpoint = f"{host}:{self._rpc.port}"
@@ -41,9 +42,14 @@ class DiscoveryServer:
         self._rpc.register("heartbeat", self._table.heartbeat)
         self._rpc.register("unregister", self._table.unregister_client)
         self._rpc.start()
-        kw = {"ttl": ttl} if ttl else {}
-        self._register = Register(store, server_key(BALANCE_SERVICE, self.endpoint),
-                                  self.endpoint.encode(), **kw)
+        # the ring self-advert rides the caller's shared CoordSession
+        # when given (one lease per process — a colocated teacher and
+        # discovery server share their keepalive), else a standalone
+        # Register exactly as before
+        from edl_tpu.utils import constants as _c
+        self._register = leased_register(
+            store, server_key(BALANCE_SERVICE, self.endpoint),
+            self.endpoint.encode(), ttl=ttl or _c.ETCD_TTL, session=session)
         logger.info("discovery server on %s", self.endpoint)
 
     def stop(self) -> None:

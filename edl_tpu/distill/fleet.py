@@ -1,0 +1,328 @@
+"""Teacher fleets as first-class elastic serving jobs (ROADMAP item 4).
+
+The distill plane's teachers historically lived ONLY in the balance
+table (``/edl_tpu_distill/<service>/nodes/``) — invisible to the
+gateway's fleet machinery, the controller and the autoscaler.  This
+module makes a teacher fleet a serving job:
+
+- :class:`TeacherReplica` — the fleet-member side.  Wraps a
+  :class:`~edl_tpu.distill.teacher.TeacherServer` and advertises it
+  TWICE on ONE shared :class:`~edl_tpu.coord.session.CoordSession`
+  (one lease per process, the replica/memstate idiom): a replica
+  advert in the teacher job's ``serving`` coord table (payload carries
+  ``service_class="distill"`` so gateways serving LM traffic skip it)
+  and the classic balance-table registration students rebalance over.
+  A refresh loop republishes live ``stats()`` (rows/s, queue depth)
+  into both adverts every ``EDL_TPU_DISTILL_ADVERT_PERIOD``.
+
+- :class:`DistillFleet` — the student/router side.  Reuses the
+  gateway's :class:`~edl_tpu.gateway.fleet.FleetView` verbatim over
+  the teacher job's serving table, filtered to the distill service
+  class: least-loaded routing with transport-failure quarantine
+  (mirroring gateway semantics at batch granularity), an
+  ``endpoints_fn()`` pluggable straight into
+  ``DistillReader.set_servers_fn`` (so the PredictPool's
+  requeue-on-death machinery rides the fleet view — teacher death
+  costs a student one retry, not a lost batch), and a one-shot routed
+  :meth:`predict` with failover retry + latency hedging for callers
+  outside the reader.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from edl_tpu.coord.session import CoordSession
+from edl_tpu.gateway import fleet as gw_fleet
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils import constants
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+#: the service-class tag distill teacher adverts carry in the serving
+#: table, so LM gateways and teacher routers never route across classes
+DISTILL_SERVICE_CLASS = "distill"
+
+_TEACHERS_G = obs_metrics.gauge(
+    "edl_distill_fleet_teachers",
+    "Live distill teacher adverts the fleet view sees, per teacher job",
+    ("job",))
+_RETRIES_TOTAL = obs_metrics.counter(
+    "edl_distill_fleet_retries_total",
+    "Routed predicts retried on another teacher after a transport "
+    "failure", ("job",))
+_HEDGES_TOTAL = obs_metrics.counter(
+    "edl_distill_fleet_hedges_total",
+    "Hedge requests fired at a second teacher after the hedge delay",
+    ("job",))
+_QUEUE_G = obs_metrics.gauge(
+    "edl_distill_teacher_queue_depth",
+    "Queued inference rows per fleet teacher (advert refresh)", ("job",))
+_ROWS_S_G = obs_metrics.gauge(
+    "edl_distill_teacher_rows_s",
+    "Lifetime rows/s per fleet teacher (advert refresh)", ("job",))
+
+
+class TeacherReplica:
+    """One fleet member: a TeacherServer advertised as a serving
+    replica AND registered in the balance table, on one shared lease.
+
+    ``replica_id`` doubles as the serving-table node key; the balance
+    key stays the endpoint (the table contract).  ``stop()`` drops
+    both adverts, then the server.
+    """
+
+    def __init__(self, store, job_id: str, server, service: str,
+                 replica_id: str | None = None,
+                 ttl: float = constants.ETCD_TTL,
+                 advert_period: float | None = None,
+                 slots: int | None = None):
+        self._store = store
+        self.job_id = job_id
+        self.server = server
+        self.service = service
+        self.replica_id = replica_id or f"teacher-{server.endpoint}"
+        self._slots = (int(slots) if slots
+                       else len(getattr(server, "_buckets", ())) or 8)
+        self._coord_session = CoordSession(
+            store, ttl=ttl, name=f"teacher:{server.endpoint}")
+        # balance-table advert (students rebalance over it) — same
+        # session, so one keepalive covers both registrations
+        server.register(store, service, ttl=ttl,
+                        session=self._coord_session,
+                        advert_period=advert_period)
+        # serving-table replica advert (controller counts these; the
+        # autoscaler's target is measured against them)
+        self._register = gw_fleet.advertise(
+            store, job_id, self.replica_id, self._payload(), ttl=ttl,
+            session=self._coord_session)
+        period = (constants.DISTILL_ADVERT_PERIOD if advert_period is None
+                  else float(advert_period))
+        self._halt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._refresh_loop, args=(period,), daemon=True,
+            name=f"teacher-replica:{server.endpoint}")
+        self._thread.start()
+        logger.info("teacher replica %s advertised in job %s (service %s)",
+                    self.replica_id, job_id, service)
+
+    def _payload(self) -> dict:
+        stats = self.server.stats()
+        depth = int(stats.get("queue_depth", 0))
+        _QUEUE_G.labels(job=self.job_id).set(depth)
+        _ROWS_S_G.labels(job=self.job_id).set(
+            float(stats.get("rows_per_s", 0.0)))
+        return {"endpoint": self.server.endpoint,
+                "service": self.service,
+                "service_class": DISTILL_SERVICE_CLASS,
+                "slots": self._slots,
+                "free_slots": max(0, self._slots - depth),
+                "queue_depth": depth,
+                "rows_per_s": float(stats.get("rows_per_s", 0.0)),
+                "rows": int(stats.get("rows", 0)),
+                "draining": False,
+                "ts": time.time()}
+
+    def _refresh_loop(self, period: float) -> None:
+        while not self._halt.wait(period):
+            if self._register.is_stopped:
+                continue
+            try:
+                self._register.update(json.dumps(self._payload()).encode())
+            except Exception as e:  # noqa: BLE001 — the session self-heals
+                logger.warning("teacher replica advert refresh failed: %s", e)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._thread.join(timeout=2.0)
+        try:
+            self._register.stop()
+        except Exception as e:  # noqa: BLE001 — best-effort advert drop
+            logger.debug("replica advert stop failed (%s); the lease "
+                         "expires it", e)
+        self.server.stop()              # drops the balance advert too
+        self._coord_session.close()
+
+
+class DistillFleet:
+    """Student-side routed view of a teacher fleet, on the gateway's
+    FleetView.  ``service=None`` accepts every distill-class teacher
+    in the job; a name filters to one service."""
+
+    def __init__(self, store, job_id: str, service: str | None = None,
+                 period: float = constants.GATEWAY_POLL_PERIOD,
+                 quarantine_s: float = constants.GATEWAY_QUARANTINE_S):
+        self.job_id = job_id
+        self.service = service
+        self._view = gw_fleet.FleetView(store, job_id, period=period)
+        self._quarantine_s = quarantine_s
+        self._lock = threading.Lock()
+        self._quarantined: dict[str, float] = {}   # endpoint -> until
+        self._inflight: dict[str, int] = {}        # endpoint -> count
+
+    # -- membership ----------------------------------------------------------
+    def teachers(self) -> dict[str, dict]:
+        """Live distill-class adverts ``{replica_id: payload}``,
+        quarantined endpoints removed."""
+        now = time.monotonic()
+        with self._lock:
+            quarantined = {ep for ep, until in self._quarantined.items()
+                           if until > now}
+            for ep in [ep for ep, until in self._quarantined.items()
+                       if until <= now]:
+                del self._quarantined[ep]
+        out = {}
+        for rid, payload in self._view.replicas().items():
+            if payload.get("service_class") != DISTILL_SERVICE_CLASS:
+                continue
+            if self.service and payload.get("service") != self.service:
+                continue
+            if payload.get("draining") or payload["endpoint"] in quarantined:
+                continue
+            out[rid] = payload
+        _TEACHERS_G.labels(job=self.job_id).set(len(out))
+        return out
+
+    def endpoints(self) -> list[str]:
+        return sorted(p["endpoint"] for p in self.teachers().values())
+
+    def endpoints_fn(self):
+        """A ``DistillReader.set_servers_fn`` plug: the reader's
+        PredictPool then adds/removes teacher workers as the fleet
+        view (this object) tracks adverts; ``close`` stops the view."""
+        fn = self.endpoints
+        # bound method objects reject attributes; wrap in a closure
+        def servers() -> list[str]:
+            return fn()
+        servers.close = self.stop  # type: ignore[attr-defined]
+        return servers
+
+    def wait_for(self, n: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while len(self.teachers()) < n:
+            if time.monotonic() >= deadline:
+                return False
+            self._view.refresh()
+            time.sleep(0.05)
+        return True
+
+    # -- routing -------------------------------------------------------------
+    def pick(self) -> str | None:
+        """Least-loaded endpoint: advertised queue depth corrected by
+        our own in-flight counts (the advert is up to one refresh
+        period stale — the gateway's exact trick)."""
+        teachers = self.teachers()
+        if not teachers:
+            return None
+        with self._lock:
+            def load(p: dict) -> tuple:
+                ep = p["endpoint"]
+                return (int(p.get("queue_depth", 0))
+                        + self._inflight.get(ep, 0), ep)
+            return min(teachers.values(), key=load)["endpoint"]
+
+    def drop(self, endpoint: str) -> None:
+        """Quarantine an endpoint we observed dead and drop its advert
+        from the view (it may outlive the process by up to the TTL);
+        an inline refresh re-reads the table like the gateway does."""
+        with self._lock:
+            self._quarantined[endpoint] = (time.monotonic()
+                                           + self._quarantine_s)
+        for rid, payload in self._view.replicas().items():
+            if payload.get("endpoint") == endpoint:
+                self._view.drop(rid)
+        self._view.refresh()
+
+    def predict(self, feed: dict, fetch: list[str],
+                retries: int = 2, hedge_after_s: float | None = None,
+                client_factory=None) -> dict:
+        """One routed teacher call with failover: a transport failure
+        quarantines the teacher and retries the next-least-loaded one
+        (``edl_distill_fleet_retries_total``).  ``hedge_after_s`` arms
+        a latency hedge — if the primary hasn't answered by then, the
+        same rows race on a second teacher and the first answer wins
+        (``edl_distill_fleet_hedges_total``)."""
+        from edl_tpu.distill.predict_client import TeacherClient
+        factory = client_factory or (lambda ep: TeacherClient(ep, fetch))
+        last: Exception | None = None
+        tried: set[str] = set()
+        for _attempt in range(max(1, retries + 1)):
+            ep = self._pick_excluding(tried)
+            if ep is None:
+                break
+            tried.add(ep)
+            try:
+                if hedge_after_s is not None:
+                    return self._hedged(ep, feed, fetch, hedge_after_s,
+                                        factory, tried)
+                return self._one(ep, feed, factory)
+            except Exception as e:  # noqa: BLE001 — route around the death
+                last = e
+                logger.warning("routed predict on %s failed: %s", ep, e)
+                self.drop(ep)
+                _RETRIES_TOTAL.labels(job=self.job_id).inc()
+        raise ConnectionError(
+            f"no distill teacher answered for job {self.job_id}: {last}")
+
+    def _pick_excluding(self, tried: set[str]) -> str | None:
+        for p in sorted(self.teachers().values(),
+                        key=lambda p: (int(p.get("queue_depth", 0)),
+                                       p["endpoint"])):
+            if p["endpoint"] not in tried:
+                return p["endpoint"]
+        return None
+
+    def _one(self, ep: str, feed: dict, factory) -> dict:
+        with self._lock:
+            self._inflight[ep] = self._inflight.get(ep, 0) + 1
+        client = factory(ep)
+        try:
+            return client.predict(feed)
+        finally:
+            with self._lock:
+                self._inflight[ep] = max(0, self._inflight.get(ep, 1) - 1)
+            close = getattr(client, "close", None)
+            if close:
+                close()
+
+    def _hedged(self, primary: str, feed: dict, fetch: list[str],
+                delay: float, factory, tried: set[str]) -> dict:
+        """Primary + (after ``delay``) one backup; first answer wins.
+        The loser's result is discarded — teacher predicts are pure."""
+        result: dict = {}
+        done = threading.Event()
+        errors: list[Exception] = []
+
+        def run(ep: str) -> None:
+            try:
+                out = self._one(ep, feed, factory)
+                with self._lock:
+                    if not result:
+                        result.update(out)
+                done.set()
+            except Exception as e:  # noqa: BLE001 — the race absorbs one loss
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(primary,),
+                                    daemon=True)]
+        threads[0].start()
+        if not done.wait(delay):
+            backup = self._pick_excluding(tried | {primary})
+            if backup is not None:
+                tried.add(backup)
+                _HEDGES_TOTAL.labels(job=self.job_id).inc()
+                t = threading.Thread(target=run, args=(backup,), daemon=True)
+                t.start()
+                threads.append(t)
+        # first success wins; both legs dying ends the wait too
+        while not done.is_set() and any(t.is_alive() for t in threads):
+            done.wait(0.05)
+        if result:
+            return dict(result)
+        raise errors[0] if errors else ConnectionError("hedge lost both")
+
+    def stop(self) -> None:
+        self._view.stop()

@@ -30,6 +30,7 @@ import threading
 import time
 
 from edl_tpu.distill.balance import Service
+from edl_tpu.utils.exceptions import EdlRetryableError
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
 
@@ -148,6 +149,16 @@ class LiteBalanceServer:
             for msg in conn.frames():
                 try:
                     resp = self._handle(conn, msg)
+                except EdlRetryableError as e:
+                    # a coord blip behind the ResilientCoordClient's
+                    # retry budget (e.g. Service bootstrap get_prefix):
+                    # the request is fine, the store is not — answer
+                    # NO_READY so the student's heartbeat retries
+                    # instead of treating its own message as malformed
+                    logger.warning("lite request deferred on store "
+                                   "error: %s", e)
+                    resp = {"code": "NO_READY", "version": -1,
+                            "servers": None}
                 except Exception as e:  # noqa: BLE001 — bad payload must
                     # never kill the single select loop for everyone
                     logger.warning("lite request failed: %s", e)

@@ -21,6 +21,7 @@ batches / QPS.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -28,10 +29,11 @@ from typing import Callable
 
 import numpy as np
 
-from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession, leased_register
 from edl_tpu.distill.balance import server_key
 from edl_tpu.distill.predict_client import decode_array, encode_array
 from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import constants
 from edl_tpu.utils.exceptions import EdlUnavailableError
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
@@ -88,17 +90,48 @@ class TeacherServer:
         self._rpc.register("stats", self.stats)
         self._rpc.start()
         self.endpoint = f"{host or local_ip()}:{self._rpc.port}"
-        self._register: Register | None = None
+        self._register = None
+        self._advert_halt = threading.Event()
+        self._advert_thread: threading.Thread | None = None
         logger.info("teacher server on %s (buckets %s)", self.endpoint,
                     self._buckets)
 
     # -- registration --------------------------------------------------------
-    def register(self, store, service: str, ttl: float | None = None
-                 ) -> "TeacherServer":
-        kw = {"ttl": ttl} if ttl else {}
-        self._register = Register(store, server_key(service, self.endpoint),
-                                  self.endpoint.encode(), **kw)
+    def register(self, store, service: str, ttl: float | None = None,
+                 session: CoordSession | None = None,
+                 advert_period: float | None = None) -> "TeacherServer":
+        """TTL-leased registration under the service's balance prefix.
+        With ``session`` the advert rides that shared self-healing
+        lease (one lease per process — the replica/memstate advert
+        idiom) instead of minting a standalone Register.  The advert
+        VALUE is the live ``stats()`` payload (rows / QPS / queue
+        depth), republished every ``advert_period`` so discovery-side
+        consumers (DistillFleet, obs) read teacher load without an RPC
+        — the balance table itself only keys off the endpoint suffix,
+        so the richer value is backward compatible."""
+        self._register = leased_register(
+            store, server_key(service, self.endpoint), self._advert_value(),
+            ttl=ttl or constants.ETCD_TTL, session=session)
+        period = (constants.DISTILL_ADVERT_PERIOD if advert_period is None
+                  else float(advert_period))
+        self._advert_thread = threading.Thread(
+            target=self._advert_loop, args=(period,), daemon=True,
+            name="teacher-advert")
+        self._advert_thread.start()
         return self
+
+    def _advert_value(self) -> bytes:
+        return json.dumps({"endpoint": self.endpoint, **self.stats()}).encode()
+
+    def _advert_loop(self, period: float) -> None:
+        while not self._advert_halt.wait(period):
+            reg = self._register
+            if reg is None or reg.is_stopped:
+                continue
+            try:
+                reg.update(self._advert_value())
+            except Exception as e:  # noqa: BLE001 — Register/session self-heal
+                logger.warning("teacher advert refresh failed: %s", e)
 
     # -- RPC side ------------------------------------------------------------
     def _predict(self, feed: dict, fetch: list[str]) -> dict:
@@ -218,7 +251,8 @@ class TeacherServer:
                    "forward_passes": self._forwards,
                    "busy_s": round(self._busy_s, 3),
                    "uptime_s": round(dt, 3),
-                   "rows_per_s": round(self._rows / dt, 1)}
+                   "rows_per_s": round(self._rows / dt, 1),
+                   "queue_depth": self._queue.qsize()}
         if self._extra_stats is not None:
             try:
                 out.update(self._extra_stats())
@@ -227,6 +261,9 @@ class TeacherServer:
         return out
 
     def stop(self) -> None:
+        self._advert_halt.set()
+        if self._advert_thread is not None:
+            self._advert_thread.join(timeout=2.0)
         if self._register is not None:
             self._register.stop()
         # refuse new enqueues FIRST (the lock makes check+put atomic, so

@@ -34,7 +34,8 @@ EXTRA_FILES = ("bench.py",)
 # documentation set for the catalog cross-checks
 DOC_FILES = ("README.md", "doc/usage.md", "doc/observability.md",
              "doc/robustness.md", "doc/memstate.md", "doc/serving.md",
-             "doc/design.md", "doc/perf.md", "doc/lint.md")
+             "doc/design.md", "doc/perf.md", "doc/lint.md",
+             "doc/distill.md")
 
 _DISABLE_RE = re.compile(r"edl-lint:\s*disable=([a-z0-9_,\-]+|all)")
 

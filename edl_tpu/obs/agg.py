@@ -624,6 +624,28 @@ class Aggregator:
                 "chain_breaks": self._metric_sum(
                     parsed, "edl_delta_chain_breaks_total") or 0.0,
             }
+        # distill-workload headline: present only when a StudentFeed or
+        # fleet teacher rides the merged page (same gating pattern as
+        # the delta block) — backlog, observed throughput, fleet size
+        backlog_rows = self._metric_max(parsed, "edl_distill_backlog_rows")
+        teachers = self._metric_max(parsed, "edl_distill_fleet_teachers")
+        if backlog_rows is not None or teachers is not None:
+            summary["distill"] = {
+                "backlog_rows": backlog_rows or 0.0,
+                "backlog_s": self._metric_max(
+                    parsed, "edl_distill_backlog_seconds") or 0.0,
+                "student_rows": self._metric_sum(
+                    parsed, "edl_distill_student_rows_total") or 0.0,
+                "student_rows_s": self._metric_sum(
+                    parsed, "edl_distill_student_rows_s") or 0.0,
+                "teacher_rows_s": self._metric_sum(
+                    parsed, "edl_distill_teacher_rows_s") or 0.0,
+                "teachers": teachers or 0.0,
+                "fleet_retries": self._metric_sum(
+                    parsed, "edl_distill_fleet_retries_total") or 0.0,
+                "fleet_hedges": self._metric_sum(
+                    parsed, "edl_distill_fleet_hedges_total") or 0.0,
+            }
         coord = self._coord_summary(parsed)
         if coord:
             summary["coord"] = coord

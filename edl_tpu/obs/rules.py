@@ -197,7 +197,19 @@ def builtin_rules() -> list[Rule]:
     p99_slo = float(os.environ.get("EDL_TPU_ALERT_GATEWAY_P99_SLO", 2.0))
     mttr = float(os.environ.get("EDL_TPU_ALERT_MTTR_THRESHOLD", 10.0))
     requeue = float(os.environ.get("EDL_TPU_ALERT_REQUEUE_RATE", 50.0))
+    backlog_slo = float(os.environ.get(
+        "EDL_TPU_ALERT_DISTILL_BACKLOG_SLO", 30.0))
     return [
+        # the StudentFeed's backlog-seconds gauge: sustained backlog
+        # beyond the SLO means the teacher fleet is undersized faster
+        # than the autoscaler is reacting (or the job is at max_nodes)
+        Rule("distill-backlog", kind="gauge",
+             metric="edl_distill_backlog_seconds",
+             op=">", threshold=backlog_slo, window=120.0 * s,
+             for_s=30.0 * s, severity="warning",
+             summary="student backlog exceeds the distill SLO: the "
+                     "teacher fleet is not absorbing the stream",
+             record="distill_backlog_s"),
         Rule("trainer-hang", kind="stalled",
              metric="edl_train_step_seconds_count",
              match={"component": "trainer"}, op="<=", threshold=0.0,

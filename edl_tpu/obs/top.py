@@ -87,6 +87,17 @@ def render_top(health: dict, alerts: dict | None = None,
             f"productive={_fmt_s(gp.get('productive_s'))} "
             f"observed={_fmt_s(gp.get('observed_s'))}"
             f"{('  badput: ' + badline) if badline else ''}")
+    ds = health.get("distill")
+    if ds:
+        # distill-workload pane: only present when a StudentFeed or
+        # fleet teacher rides the merged page
+        lines.append(
+            f"  distill: teachers={_fmt_num(ds.get('teachers'))} "
+            f"backlog={_fmt_num(ds.get('backlog_rows'))}rows"
+            f"/{_fmt_s(ds.get('backlog_s'))} "
+            f"student_rows/s={_fmt_num(ds.get('student_rows_s'))} "
+            f"teacher_rows/s={_fmt_num(ds.get('teacher_rows_s'))} "
+            f"retries={_fmt_num(ds.get('fleet_retries'))}")
     co = health.get("coord")
     if co:
         # control-plane pane: only present when the coord server's own

@@ -281,6 +281,24 @@ GATEWAY_QUARANTINE_S = _f("EDL_TPU_GATEWAY_QUARANTINE", 5.0)
 # evicted after this long without an ack (gateway died mid-fetch)
 SERVING_RESULT_TTL = _f("EDL_TPU_SERVING_RESULT_TTL", 600.0)
 
+# -- elastic distill fleet (distill/fleet.py, distill/backlog.py) ---------
+# how often a fleet teacher refreshes BOTH its adverts (the serving
+# table replica advert and the balance-table registration) with live
+# stats() — queue depth, rows/s; the student-side DistillFleet view is
+# at most one period stale
+DISTILL_ADVERT_PERIOD = _f("EDL_TPU_DISTILL_ADVERT_PERIOD", 1.0)
+# how often a StudentFeed publishes its durable backlog record
+# (scale/backlog/<student>) and gauges; a thread, not an inline hook —
+# backlog grows exactly while the student iteration is blocked
+DISTILL_BACKLOG_PERIOD = _f("EDL_TPU_DISTILL_BACKLOG_PERIOD", 2.0)
+# DistillAutoscaler growth trigger: backlog (queued rows / observed
+# teacher rows/s) above GROW seconds, held continuously for HOLD
+# seconds, steps the teacher target by EDL_TPU_AUTOSCALE_STEP; decay
+# reuses EDL_TPU_AUTOSCALE_QUIET.  Read at runtime (env_float) so the
+# controller picks up tuning without a restart.
+DISTILL_BACKLOG_GROW_DEFAULT = 5.0    # EDL_TPU_DISTILL_BACKLOG_GROW
+DISTILL_BACKLOG_HOLD_DEFAULT = 15.0   # EDL_TPU_DISTILL_BACKLOG_HOLD
+
 # -- paged KV cache + session migration (serving/kv_cache.py) -------------
 # KV block size in tokens for the replica CLI's engine; 0 keeps the
 # pre-paged contiguous slabs (no prefix reuse, no migration).  Library

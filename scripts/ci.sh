@@ -137,6 +137,17 @@ JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
 # alert -> action -> recovery handoff
 JAX_PLATFORMS=cpu python scripts/remediation_smoke.py
 
+# distill chaos smoke: elastic distillation as a production workload
+# (ISSUE 18) — real teacher child processes advertised through the
+# serving table, a serving spike makes training yield a pod
+# (reason=priority-yield in its workerlog) while the teacher floor
+# holds, a student stream's backlog record grows the fleet 1->3
+# through the controller's arbitration (and fires the distill-backlog
+# alert), a teacher SIGKILL mid-epoch costs retries not rows (the
+# 800-row stream audits exactly-once, in order), edl_distill_* gauges
+# ride the merged /metrics + /healthz, and quiet decays the fleet back
+JAX_PLATFORMS=cpu python scripts/distill_chaos_smoke.py
+
 # fleet-sim smoke: the control-plane scale observatory (doc/scale.md)
 # at CI-scale decades (N=25/100/400) — a real durable coord server +
 # real aggregator under N pod actors; gates: watch-based membership
@@ -204,6 +215,12 @@ pw, pc = out['serving_prefix_tokens_s'], out['serving_cold_tokens_s']
 assert pw >= pc, (pw, pc)
 assert out['serving_prefill_skipped_frac'] > 0.5, out
 assert out.get('serving_kv_migration_ms') is not None, out
+# distill fleet elasticity (ISSUE 18): three teachers must beat one on
+# the same slow-teacher stream (routing/fan-out actually helps), and a
+# published backlog record must step the autoscaler's target promptly
+s1, s3 = out['distill_student_rows_s_1'], out['distill_student_rows_s_3']
+assert s3 >= s1, (s1, s3)
+assert out.get('distill_backlog_scale_latency_s') is not None, out
 print('bench smoke OK')"
 
 # packaging sanity: console scripts resolve

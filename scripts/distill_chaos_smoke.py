@@ -1,0 +1,450 @@
+"""CI smoke: elastic distillation as a production workload (ISSUE 18).
+
+THREE job kinds arbitrated by ONE in-process Controller against an
+in-process coordination server:
+
+- **train** — two real launcher processes (``edl_tpu.collective
+  .launch``) running the instrumented inert trainer;
+- **svc** — a serving fleet (advert-backed; the demand record is the
+  spike, the gateway path is proven by remediation_smoke.py);
+- **teach** — a ``kind="distill" fleet=True`` teacher fleet: real
+  teacher CHILD PROCESSES (TeacherServer + TeacherReplica, dual advert
+  on one CoordSession) spawned/killed by the controller's actuator,
+  fed by a real student (DistillReader + DistillFleet + StudentFeed)
+  in the parent.
+
+The proof, phase by phase:
+
+1. **baseline** — train=2, teach=1, svc=1 on capacity 6, nobody flaps;
+2. **serving spike → training yields, distill absorbs** — a demand
+   record for 4 replicas squeezes the pool; training departs a pod
+   through the preemption-grace path (``reason=priority-yield`` in its
+   workerlog); the teacher fleet's floor holds throughout;
+3. **reclaim** — the demand decays on quiet, serving scales back in,
+   training reclaims its pod;
+4. **backlog → teachers 1→3** — the student streams against ONE slow
+   teacher; its StudentFeed publishes backlog records; the
+   DistillAutoscaler grows the fleet to 3 (grow+hold ladder), the
+   ``distill-backlog`` alert fires, and ``edl_distill_*`` metrics +
+   the /healthz distill block ride the merged aggregator page;
+5. **teacher SIGKILL mid-epoch** — one teacher child is SIGKILLed
+   while the stream is in flight; the pool requeues onto survivors and
+   the controller respawns the advert gap; the finished stream audits
+   EXACTLY-ONCE: every row id present once, in order, predictions
+   correct — teacher death cost a retry, not a batch;
+6. **decay on quiet** — the student finishes, backlog records clear,
+   the fleet decays back to 1 teacher.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/distill_chaos_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_TMP = tempfile.mkdtemp(prefix="edl-distill-chaos-")
+os.environ.setdefault("EDL_TPU_TRACE_DIR", os.path.join(_TMP, "trace"))
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+os.environ.setdefault("EDL_TPU_ALERT_SCALE", "0.1")
+os.environ.setdefault("EDL_TPU_ALERT_DISTILL_BACKLOG_SLO", "2")
+os.environ.setdefault("EDL_TPU_AUTOSCALE_QUIET", "4")
+os.environ.setdefault("EDL_TPU_DEMAND_TTL", "30")
+os.environ.setdefault("EDL_TPU_DISTILL_BACKLOG_GROW", "1")
+os.environ.setdefault("EDL_TPU_DISTILL_BACKLOG_HOLD", "1")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+_TRAINER = os.path.join(_REPO, "tests", "helpers", "metrics_trainer.py")
+
+FAST = {
+    "EDL_TPU_TTL": "1",
+    "EDL_TPU_GENERATOR_PERIOD": "0.2",
+    "EDL_TPU_WATCHER_PERIOD": "0.2",
+    "EDL_TPU_SUPERVISOR_PERIOD": "0.2",
+    "EDL_TPU_BARRIER_TIMEOUT": "60",
+    "EDL_TPU_RESIZE_BARRIER_TIMEOUT": "30",
+    "EDL_TPU_HANG_TIMEOUT": "-1",
+}
+
+N_BATCHES, BS, TBS = 200, 4, 4          # 800 student rows, 200 teacher tasks
+
+_TEACHER_CHILD = r"""
+import signal, sys, threading, time
+sys.path.insert(0, {repo!r})
+from edl_tpu import obs
+from edl_tpu.coord.client import connect
+from edl_tpu.distill.fleet import TeacherReplica
+from edl_tpu.distill.teacher import TeacherServer
+from edl_tpu.obs import advert as obs_advert
+
+coord_ep, name, delay = sys.argv[1], sys.argv[2], float(sys.argv[3])
+obs.install_from_env("teacher")
+store = connect(coord_ep)
+
+def predict_fn(feed):
+    time.sleep(delay)                   # a deliberately slow teacher
+    return {{"prediction": feed["x"] * 2.0}}
+
+server = TeacherServer(predict_fn, port=0)
+replica = TeacherReplica(store, "teach", server, "smoke-svc",
+                         replica_id=name, ttl=2.0, advert_period=0.25)
+obs_advert.advertise_installed(store, "teach", "teacher")
+print("teacher up", name, flush=True)
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *_: stop.set())
+stop.wait()
+replica.stop()
+store.close()
+"""
+
+
+def _wait(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:  # noqa: BLE001 — condition may race a restart
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def _grep_logs(root, needle):
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            try:
+                with open(p, errors="replace") as f:
+                    if needle in f.read():
+                        return p
+            except OSError:
+                continue
+    return None
+
+
+def _http_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _http_text(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+class Pool:
+    """The out-of-band actuator: launchers for train, in-process
+    adverts for svc, real teacher child processes for teach."""
+
+    def __init__(self, store, coord_ep, tmp):
+        self.store = store
+        self.coord_ep = coord_ep
+        self.tmp = tmp
+        self.launchers = {}              # name -> Popen
+        self.teachers = {}               # name -> Popen
+        self.svc_adverts = {}            # rid -> Register handle
+        self._n = 0
+
+    def spawn_launcher(self, job, name, nodes_range, extra_env=None):
+        env = dict(os.environ)
+        env.update(FAST)
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(extra_env or {})
+        log = open(os.path.join(self.tmp, f"launcher-{job}-{name}.log"),
+                   "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.collective.launch",
+             "--job_id", job, "--coord_endpoints", self.coord_ep,
+             "--nodes_range", nodes_range, "--nproc_per_node", "1",
+             "--log_dir", os.path.join(self.tmp, f"log-{job}-{name}"),
+             _TRAINER],
+            env=env, cwd=self.tmp, stdout=log, stderr=subprocess.STDOUT)
+        proc._logfile = log  # noqa: SLF001
+        self.launchers[f"{job}-{name}"] = proc
+        return proc
+
+    def spawn_teacher(self, name, delay="0.3"):
+        env = dict(os.environ, EDL_TPU_METRICS_PORT="0")
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        log = open(os.path.join(self.tmp, f"teacher-{name}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c",
+             _TEACHER_CHILD.format(repo=_REPO), self.coord_ep, name, delay],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        proc._logfile = log  # noqa: SLF001
+        self.teachers[name] = proc
+        return proc
+
+    def alive_launchers(self, job):
+        return [n for n, p in self.launchers.items()
+                if n.startswith(job + "-") and p.poll() is None]
+
+    def alive_teachers(self):
+        return [n for n, p in self.teachers.items() if p.poll() is None]
+
+    # the controller's Actuator surface
+    def scale(self, job_id, replicas):
+        if job_id == "svc":
+            from edl_tpu.gateway import fleet as gw_fleet
+            live = sorted(self.svc_adverts)
+            for i in range(len(live), replicas):
+                self._n += 1
+                rid = f"r{self._n}"
+                self.svc_adverts[rid] = gw_fleet.advertise(
+                    self.store, "svc", rid,
+                    {"endpoint": f"fake:{self._n}", "slots": 8,
+                     "free_slots": 8, "draining": False}, ttl=2.0)
+            for rid in live[replicas:]:
+                self.svc_adverts.pop(rid).stop()
+        elif job_id == "teach":
+            live = self.alive_teachers()
+            for i in range(len(live), replicas):
+                self._n += 1
+                self.spawn_teacher(f"t{self._n}")
+            for name in sorted(live)[replicas:]:
+                self.teachers[name].send_signal(signal.SIGTERM)
+        elif job_id == "train":
+            live = self.alive_launchers("train")
+            for i in range(len(live), replicas):
+                self._n += 1
+                self.spawn_launcher("train", f"re{self._n}", "1:2",
+                                    {"EDL_TPU_SMOKE_STEP_S": "0.05"})
+        return True
+
+    def kill_all(self):
+        for p in list(self.launchers.values()) + list(self.teachers.values()):
+            if p.poll() is None:
+                p.kill()
+        for p in list(self.launchers.values()) + list(self.teachers.values()):
+            try:
+                p._logfile.close()  # noqa: SLF001
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+        for reg in self.svc_adverts.values():
+            try:
+                reg.stop()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+def _student_gen():
+    import numpy as np
+
+    def gen():
+        for b in range(N_BATCHES):
+            yield [(np.full((3,), b * BS + i, np.float32), b * BS + i)
+                   for i in range(BS)]
+    return gen
+
+
+def main() -> None:
+    import numpy as np
+
+    from edl_tpu import obs
+    from edl_tpu.cluster import scale as scale_mod
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.coord.client import connect
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.controller import Controller
+    from edl_tpu.distill.backlog import StudentFeed
+    from edl_tpu.distill.fleet import DistillFleet
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.gateway.fleet import list_replicas
+    from edl_tpu.obs import advert as obs_advert
+    from edl_tpu.obs.agg import AggregatorServer
+
+    obs.install_from_env("student")
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = connect(coord_ep)
+    pool = Pool(store, coord_ep, _TMP)
+
+    agg_srv, ctl, fleet = None, None, None
+    try:
+        # -- boot the three job kinds ------------------------------------
+        scale_mod.save_job_spec(store, "train", kind="training")
+        scale_mod.save_job_spec(store, "svc", kind="serving")
+        scale_mod.save_job_spec(store, "teach", kind="distill", fleet=True)
+        scale_mod.save_nodes_range(store, "svc", 1, 4)
+        scale_mod.save_nodes_range(store, "teach", 1, 3)
+        for name in ("a", "b"):
+            pool.spawn_launcher("train", name, "1:2",
+                                {"EDL_TPU_SMOKE_STEP_S": "0.05"})
+        pool.scale("svc", 1)
+        pool.scale("teach", 1)
+        obs_advert.advertise_installed(store, "teach", "student")
+
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) == 2, 90, "train cluster of 2")
+        _wait(lambda: len(list_replicas(store, "teach")) == 1, 60,
+              "the first teacher's replica advert")
+
+        agg_srv = AggregatorServer(store, "teach", host="127.0.0.1",
+                                   cache_s=0.0, scrape_interval=0.25,
+                                   incident_dir=os.path.join(
+                                       _TMP, "incidents")).start()
+
+        ctl = Controller(store, capacity=6, max_load_desired=1.0,
+                         actuator=pool, cooldown=1.0,
+                         cooldown_per_resize_s=0.0,
+                         preempt_grace_s=30.0, period=0.5)
+        assert set(ctl.discover_jobs()) == {"train", "svc", "teach"}
+        ctl.start()
+
+        # -- 1: arbitration baseline -------------------------------------
+        time.sleep(3.0)
+        assert len(Cluster.load_from_store(store, "train").pods) == 2
+        assert len(pool.alive_teachers()) == 1
+        print("smoke 1: three job kinds under one controller, baseline "
+              "stable (train=2 svc=1 teach=1 of capacity 6)")
+
+        # -- 2: serving spike -> training yields, distill absorbs --------
+        scale_mod.save_demand(store, "svc", 4, reason="gateway-p99-slo")
+        _wait(lambda: len(pool.svc_adverts) >= 4, 60,
+              "the serving fleet to scale out to the demanded 4")
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) == 1, 90,
+              "training to yield a pod to serving demand")
+        _wait(lambda: _grep_logs(_TMP, "reason=priority-yield") is not None,
+              30, "the yielded pod's workerlog to carry priority-yield")
+        # the distill fleet's floor holds through the squeeze
+        assert len(list_replicas(store, "teach")) >= 1, \
+            "the teacher fleet must keep its floor during the spike"
+        print("smoke 2: serving spike absorbed — training yielded "
+              "(reason=priority-yield), the teacher fleet's floor held")
+
+        # -- 3: quiet -> serving decays, training reclaims ---------------
+        scale_mod.clear_demand(store, "svc")
+        _wait(lambda: len(pool.svc_adverts) <= 1, 120,
+              "the serving fleet to scale back in on sustained quiet")
+        _wait(lambda: (c := Cluster.load_from_store(store, "train"))
+              is not None and len(c.pods) == 2, 120,
+              "training to reclaim its pod after the spike")
+        print("smoke 3: demand decayed on quiet, training reclaimed "
+              "the chips")
+
+        # -- 4: student stream -> backlog -> teachers 1->3 ---------------
+        fleet = DistillFleet(store, "teach", period=0.25)
+        dr = DistillReader(ins=["x", "idx"], predicts=["prediction"],
+                           feeds=["x"], teacher_batch_size=TBS)
+        dr.set_sample_list_generator(_student_gen())
+        dr.set_servers_fn(fleet.endpoints_fn())
+        dr._pool_kw = {"manage_period": 0.25, "no_teacher_timeout": 60.0}
+        feed = StudentFeed(store, "teach", dr, student_id="smoke-student",
+                           period=0.5)
+
+        batches = []
+        stream_err = []
+
+        def consume():
+            try:
+                for b in feed:
+                    batches.append(b)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                stream_err.append(e)
+
+        # the distill-backlog alert fires during the 1-teacher phase and
+        # may resolve once the fleet catches up — sample /alerts
+        # continuously instead of racing a point-in-time read
+        alert_seen = threading.Event()
+        sample_halt = threading.Event()
+
+        def sample_alerts():
+            while not sample_halt.wait(0.5):
+                try:
+                    firing = _http_json(
+                        f"http://{agg_srv.endpoint}/alerts").get("firing", [])
+                except Exception:  # noqa: BLE001 — the server may lag boot
+                    continue
+                if any(a.get("alert") == "distill-backlog" for a in firing):
+                    alert_seen.set()
+                    return
+
+        sampler = threading.Thread(target=sample_alerts, daemon=True)
+        sampler.start()
+
+        t0 = time.time()
+        consumer = threading.Thread(target=consume, daemon=True)
+        consumer.start()
+
+        _wait(lambda: len(list_replicas(store, "teach")) >= 3, 90,
+              "the teacher fleet to scale 1->3 on student backlog")
+        scale_latency = time.time() - t0
+        print(f"smoke 4: student backlog grew the teacher fleet 1->3 "
+              f"in {scale_latency:.1f}s")
+
+        # merged observability: metrics + the /healthz distill block
+        metrics = _http_text(f"http://{agg_srv.endpoint}/metrics")
+        for name in ("edl_distill_backlog_rows", "edl_distill_fleet_teachers",
+                     "edl_distill_student_rows_total",
+                     "edl_controller_distill_demand"):
+            assert name in metrics, f"{name} missing from merged /metrics"
+        health = _http_json(f"http://{agg_srv.endpoint}/healthz")
+        assert "distill" in health, health.keys()
+        assert health["distill"].get("teachers", 0) >= 1, health["distill"]
+        print("smoke 4b: edl_distill_* on merged /metrics, distill block "
+              "on /healthz")
+
+        # -- 5: teacher SIGKILL mid-epoch --------------------------------
+        assert len(batches) < N_BATCHES, "stream finished before the kill"
+        victim = pool.alive_teachers()[0]
+        pool.teachers[victim].kill()                    # SIGKILL, no drain
+        print(f"smoke 5: SIGKILLed teacher {victim} mid-epoch "
+              f"({len(batches)}/{N_BATCHES} batches delivered)")
+
+        _wait(alert_seen.is_set, 60,
+              "the distill-backlog alert to fire while backlogged")
+        sample_halt.set()
+        print("smoke 5a: distill-backlog alert fired during the "
+              "backlogged window")
+
+        consumer.join(timeout=180)
+        assert not consumer.is_alive(), "student stream wedged after SIGKILL"
+        if stream_err:
+            raise AssertionError(f"student stream failed: {stream_err[0]}")
+        assert len(batches) == N_BATCHES, \
+            f"student got {len(batches)}/{N_BATCHES} batches"
+        ids = np.concatenate([b[1] for b in batches])
+        np.testing.assert_array_equal(ids, np.arange(N_BATCHES * BS))
+        preds = np.concatenate([b[2] for b in batches])
+        np.testing.assert_allclose(preds[:, 0],
+                                   np.arange(N_BATCHES * BS) * 2.0)
+        print(f"smoke 5b: exactly-once audit over {N_BATCHES * BS} student "
+              f"rows — zero lost, zero duplicated, order preserved, "
+              f"predictions correct across the SIGKILL")
+
+        # -- 6: decay on quiet -------------------------------------------
+        _wait(lambda: len(pool.alive_teachers()) <= 1, 120,
+              "the teacher fleet to decay to 1 on quiet")
+        print("smoke 6: backlog cleared, teacher fleet decayed back to 1")
+    except BaseException:
+        sys.stdout.flush()
+        for root, _dirs, files in os.walk(_TMP):
+            for fn in files:
+                if fn.endswith(".log"):
+                    p = os.path.join(root, fn)
+                    print(f"==== {p} ====")
+                    print(open(p, errors="replace").read()[-4000:])
+        raise
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        if fleet is not None:
+            fleet.stop()
+        if agg_srv is not None:
+            agg_srv.stop()
+        pool.kill_all()
+        store.close()
+        coord.stop()
+    print("distill chaos smoke OK")
+
+
+if __name__ == "__main__":
+    main()

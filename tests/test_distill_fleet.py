@@ -1,0 +1,453 @@
+"""Distill orchestration: teacher fleets as elastic serving jobs.
+
+Covers the ROADMAP item 4 subsystem end to end at unit scale:
+balance-table churn under teacher SIGKILL (TTL-failover, no student
+stuck on a dead endpoint), assignment versions advancing only on real
+membership change, the DistillFleet routed view (filtering, least-
+loaded pick, quarantine, failover retry, latency hedge), StudentFeed
+backlog accounting + durable records, the DistillAutoscaler's
+grow/hold/decay ladder, and the controller's advert-backed distill
+job view.  The full three-job arbitration story is the chaos smoke
+(scripts/distill_chaos_smoke.py); this file is the fast CI floor.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.cluster import scale
+from edl_tpu.controller.autoscale import DistillAutoscaler
+from edl_tpu.coord.register import Register
+from edl_tpu.coord.session import CoordSession
+from edl_tpu.distill import reader as reader_mod
+from edl_tpu.distill.backlog import StudentFeed
+from edl_tpu.distill.balance import Service, server_key, service_prefix
+from edl_tpu.distill.fleet import DISTILL_SERVICE_CLASS, DistillFleet, \
+    TeacherReplica
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.teacher import TeacherServer
+from edl_tpu.gateway import fleet as gw_fleet
+
+
+def _wait_until(cond, timeout: float, period: float = 0.05) -> float:
+    """Poll ``cond`` until true; returns elapsed seconds (fails the
+    test on timeout so callers can assert on the latency)."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while not cond():
+        assert time.monotonic() < deadline, "condition not met in time"
+        time.sleep(period)
+    return time.monotonic() - t0
+
+
+def sample_list_gen(n_batches=8, bs=4, dim=3):
+    def gen():
+        for b in range(n_batches):
+            yield [(np.full((dim,), b * bs + i, np.float32), b * bs + i)
+                   for i in range(bs)]
+    return gen
+
+
+# -- balance-table churn (satellite: SIGKILL rebalance + version pin) --------
+
+def test_teacher_sigkill_rebalances_within_ttl(memkv):
+    """A teacher whose keepalive dies mid-assignment (SIGKILL from the
+    store's point of view) is rebalanced away within TTL + grace; the
+    surviving client is never left holding only the dead endpoint."""
+    ttl = 1.0
+    regs = {ep: Register(memkv, server_key("churn", ep), ep.encode(),
+                         ttl=ttl) for ep in ("t-dead:1", "t-live:2")}
+    svc = Service("churn", memkv, period=0.1)
+    try:
+        svc.add_client("student", require_num=2)
+        svc._refresh_servers()
+        _, servers = svc.get_servers("student", -1)
+        assert set(servers) == {"t-dead:1", "t-live:2"}
+
+        regs["t-dead:1"].stop_heartbeat_only()   # SIGKILL, as seen by the store
+        t0 = time.monotonic()
+
+        def rebalanced():
+            _, s = svc.get_servers("student", -1)
+            return s is not None and set(s) == {"t-live:2"}
+        _wait_until(rebalanced, timeout=ttl + 2.0)
+        # within TTL + sweep + watch-poll grace, not eventually-someday
+        assert time.monotonic() - t0 <= ttl + 2.0
+        # the client's final assignment holds no dead endpoint
+        _, final = svc.get_servers("student", -1)
+        assert final is None or "t-dead:1" not in final
+    finally:
+        svc.close()
+        for r in regs.values():
+            r.stop()
+
+
+def test_assignment_version_only_advances_on_membership_change(memkv):
+    """Advert VALUE refreshes (the new stats payload republished every
+    advert period) fire watch events but must not bump assignment
+    versions — only real membership change does."""
+    for ep in ("a:1", "b:2"):
+        memkv.put(server_key("verpin", ep), b"v0")
+    svc = Service("verpin", memkv, period=0.05)
+    try:
+        svc.add_client("c1", require_num=2)
+        svc._refresh_servers()
+        v0, servers = svc.get_servers("c1", -1)
+        assert set(servers) == {"a:1", "b:2"}
+        # stats refresh: same keys, new values, several rounds
+        for round_ in range(3):
+            for ep in ("a:1", "b:2"):
+                memkv.put(server_key("verpin", ep),
+                          json.dumps({"endpoint": ep,
+                                      "rows": round_}).encode())
+            svc._refresh_servers()
+        v1, servers = svc.get_servers("c1", v0)
+        assert v1 == v0 and servers is None   # nothing changed for the client
+        # real membership change: one teacher gone
+        memkv.delete(server_key("verpin", "b:2"))
+        svc._refresh_servers()
+        v2, servers = svc.get_servers("c1", v0)
+        assert v2 > v0 and servers == ["a:1"]
+    finally:
+        svc.close()
+
+
+def test_refresh_servers_store_blip_keeps_stale_view(memkv, monkeypatch):
+    """A coord blip during the watch callback defers the rebalance
+    round (stale teacher set kept) instead of dropping teachers."""
+    memkv.put(server_key("blip", "t:1"), b"t")
+    svc = Service("blip", memkv, period=10.0)
+    try:
+        svc.add_client("c", require_num=1)
+        svc._refresh_servers()
+        _, servers = svc.get_servers("c", -1)
+        assert servers == ["t:1"]
+
+        def boom(prefix):
+            raise ConnectionError("coord away")
+        monkeypatch.setattr(memkv, "get_prefix", boom)
+        svc._refresh_servers()                 # must not raise, must not wipe
+        v, servers = svc.get_servers("c", -1)
+        assert servers == ["t:1"]
+    finally:
+        svc.close()
+
+
+# -- teacher adverts on one shared session -----------------------------------
+
+def test_teacher_advert_rides_shared_session(memkv):
+    server = TeacherServer(lambda feed: {"p": feed["x"]}, port=0)
+    session = CoordSession(memkv, ttl=1.0, name="test-teacher")
+    try:
+        server.register(memkv, "shared-svc", session=session,
+                        advert_period=60.0)
+        rec = memkv.get(server_key("shared-svc", server.endpoint))
+        assert rec is not None
+        stats = json.loads(rec.value.decode())
+        # the advert value is the live stats payload
+        assert stats["endpoint"] == server.endpoint
+        assert "queue_depth" in stats and "rows_per_s" in stats
+        # the advert rides the SHARED lease: abandoning the session's
+        # keepalive (a SIGKILLed process) TTL-expires the advert
+        session.abandon()
+        _wait_until(
+            lambda: memkv.get(server_key("shared-svc",
+                                         server.endpoint)) is None,
+            timeout=3.0)
+    finally:
+        server.stop()
+        session.close()
+
+
+def test_teacher_replica_dual_advert_one_lease(memkv):
+    """TeacherReplica advertises in BOTH tables on one session: one
+    abandoned keepalive expires the serving advert and the balance
+    advert together (the one-lease-per-process idiom)."""
+    server = TeacherServer(lambda feed: {"p": feed["x"]}, port=0)
+    replica = TeacherReplica(memkv, "teachjob", server, "dual-svc",
+                             ttl=1.0, advert_period=60.0)
+    try:
+        reps = gw_fleet.list_replicas(memkv, "teachjob")
+        assert replica.replica_id in reps
+        payload = reps[replica.replica_id]
+        assert payload["service_class"] == DISTILL_SERVICE_CLASS
+        assert payload["endpoint"] == server.endpoint
+        assert memkv.get(server_key("dual-svc", server.endpoint)) is not None
+
+        replica._halt.set()                    # silence refresh loops, then
+        server._advert_halt.set()              # kill the keepalive: SIGKILL
+        replica._coord_session.abandon()
+        _wait_until(
+            lambda: not gw_fleet.list_replicas(memkv, "teachjob")
+            and memkv.get(server_key("dual-svc", server.endpoint)) is None,
+            timeout=3.0)
+    finally:
+        try:
+            replica.stop()
+        except Exception:
+            pass
+
+
+# -- DistillFleet routing ----------------------------------------------------
+
+def _advert(memkv, job, rid, ep, queue_depth=0, service="svc",
+            service_class=DISTILL_SERVICE_CLASS, draining=False, ttl=5.0):
+    return gw_fleet.advertise(
+        memkv, job, rid,
+        {"endpoint": ep, "service": service, "service_class": service_class,
+         "queue_depth": queue_depth, "draining": draining}, ttl=ttl)
+
+
+def test_fleet_filters_and_picks_least_loaded(memkv):
+    regs = [
+        _advert(memkv, "fl", "t1", "t1:1", queue_depth=4),
+        _advert(memkv, "fl", "t2", "t2:2", queue_depth=1),
+        _advert(memkv, "fl", "lm", "lm:3", service_class="lm"),
+        _advert(memkv, "fl", "t3", "t3:4", queue_depth=0, draining=True),
+    ]
+    fleet = DistillFleet(memkv, "fl", period=0.05)
+    try:
+        assert fleet.wait_for(2, timeout=3.0)
+        teachers = fleet.teachers()
+        # the LM replica and the draining teacher are filtered out
+        assert set(teachers) == {"t1", "t2"}
+        assert fleet.pick() == "t2:2"          # least advertised queue
+        fleet.drop("t2:2")                     # transport failure observed
+        assert fleet.pick() == "t1:1"          # quarantined endpoint skipped
+        assert fleet.endpoints() == ["t1:1"]
+    finally:
+        fleet.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_fleet_routed_predict_fails_over(memkv):
+    regs = [_advert(memkv, "fo", "t1", "dead:1", queue_depth=0),
+            _advert(memkv, "fo", "t2", "live:2", queue_depth=3)]
+    fleet = DistillFleet(memkv, "fo", period=0.05)
+
+    class _Client:
+        def __init__(self, ep):
+            self.ep = ep
+
+        def predict(self, feed):
+            if self.ep == "dead:1":
+                raise ConnectionError("teacher gone")
+            return {"from": self.ep}
+
+        def close(self):
+            pass
+
+    try:
+        assert fleet.wait_for(2, timeout=3.0)
+        out = fleet.predict({"x": 1}, ["from"], retries=2,
+                            client_factory=_Client)
+        assert out == {"from": "live:2"}       # death cost one retry, not the call
+        assert "dead:1" not in fleet.endpoints()   # quarantined
+    finally:
+        fleet.stop()
+        for r in regs:
+            r.stop()
+
+
+def test_fleet_hedged_predict_backup_wins(memkv):
+    regs = [_advert(memkv, "hg", "t1", "slow:1", queue_depth=0),
+            _advert(memkv, "hg", "t2", "fast:2", queue_depth=1)]
+    fleet = DistillFleet(memkv, "hg", period=0.05)
+
+    class _Client:
+        def __init__(self, ep):
+            self.ep = ep
+
+        def predict(self, feed):
+            if self.ep == "slow:1":
+                time.sleep(1.5)
+            return {"from": self.ep}
+
+        def close(self):
+            pass
+
+    try:
+        assert fleet.wait_for(2, timeout=3.0)
+        t0 = time.monotonic()
+        out = fleet.predict({"x": 1}, ["from"], hedge_after_s=0.05,
+                            client_factory=_Client)
+        # primary (least queue = slow:1) stalls; the hedge answers first
+        assert out == {"from": "fast:2"}
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        fleet.stop()
+        for r in regs:
+            r.stop()
+
+
+# -- the fleet-backed student, teacher SIGKILL mid-epoch ---------------------
+
+def test_student_survives_teacher_sigkill_exactly_once(memkv):
+    """Two live TeacherReplicas behind a DistillFleet feeding a real
+    DistillReader; one teacher SIGKILLs mid-epoch.  The pool requeues
+    its in-flight task onto the survivor: every row arrives exactly
+    once, in order — teacher death costs a retry, not a batch."""
+    def predict_fn(feed):
+        time.sleep(0.02)                       # slow enough to die mid-epoch
+        return {"prediction": feed["x"] * 2.0}
+
+    replicas = [
+        TeacherReplica(memkv, "ek", TeacherServer(predict_fn, port=0),
+                       f"ek-svc", replica_id=f"t{i}", ttl=1.0,
+                       advert_period=0.2)
+        for i in range(2)]
+    fleet = DistillFleet(memkv, "ek", period=0.1)
+    assert fleet.wait_for(2, timeout=5.0)
+
+    n_batches, bs = 20, 3
+    dr = DistillReader(ins=["x", "idx"], predicts=["prediction"],
+                       feeds=["x"], teacher_batch_size=bs)
+    dr.set_sample_list_generator(sample_list_gen(n_batches, bs))
+    dr.set_servers_fn(fleet.endpoints_fn())
+    dr._pool_kw = {"manage_period": 0.2, "no_teacher_timeout": 20.0}
+
+    victim = replicas[0]
+    batches = []
+    try:
+        for i, batch in enumerate(dr()):
+            batches.append(batch)
+            if i == 2:                         # SIGKILL mid-epoch
+                victim._halt.set()
+                victim.server._advert_halt.set()
+                victim.server._rpc.stop()
+                victim._coord_session.abandon()
+        assert len(batches) == n_batches
+        ids = np.concatenate([b[1] for b in batches])
+        np.testing.assert_array_equal(ids, np.arange(n_batches * bs))
+        preds = np.concatenate([b[2] for b in batches])
+        np.testing.assert_allclose(preds[:, 0], np.arange(n_batches * bs) * 2.0)
+    finally:
+        for r in replicas:
+            try:
+                r.stop()
+            except Exception:
+                pass
+
+
+# -- StudentFeed backlog signal ----------------------------------------------
+
+def test_student_feed_accounting_and_cleanup(memkv, monkeypatch):
+    monkeypatch.setattr(reader_mod, "_NOP_PREDICT_TEST", True)
+    n_batches, bs = 8, 4
+    dr = DistillReader(ins=["x", "idx"], predicts=["prediction"],
+                       feeds=["x"], teacher_batch_size=3)
+    dr.set_fixed_teacher("t1", "t2")
+    dr.set_sample_list_generator(sample_list_gen(n_batches, bs))
+    dr._pool_kw = {"manage_period": 0.05}
+    feed = StudentFeed(memkv, "teachjob", dr, student_id="s1", period=0.05)
+    batches = list(feed)
+    assert len(batches) == n_batches
+    assert feed.submitted_rows == feed.consumed_rows == n_batches * bs
+    assert feed.backlog_rows() == 0
+    # stop() clears the durable record — a finished student's backlog
+    # does not linger for the autoscaler
+    assert scale.load_backlogs(memkv, "teachjob") == {}
+
+
+def test_student_feed_publishes_backlog_record(memkv, monkeypatch):
+    monkeypatch.setattr(reader_mod, "_NOP_PREDICT_TEST", True)
+    dr = DistillReader(ins=["x", "idx"], predicts=["prediction"],
+                       feeds=["x"])
+    dr.set_fixed_teacher("t1")
+    dr.set_sample_list_generator(sample_list_gen(2, 2))
+    feed = StudentFeed(memkv, "teachjob", dr, student_id="s2", period=60.0)
+    # simulate a stream mid-flight: 30 rows in, 10 back
+    feed.submitted_rows, feed.consumed_rows = 30, 10
+    feed._publish_once(now=100.0)
+    recs = scale.load_backlogs(memkv, "teachjob")
+    assert recs["s2"]["queued_rows"] == 20
+    assert recs["s2"]["rows_per_s"] == 0.0     # no rate observed yet
+    # one second later the teachers delivered 20 more rows
+    feed.consumed_rows = 30
+    feed._publish_once(now=101.0)
+    recs = scale.load_backlogs(memkv, "teachjob")
+    assert recs["s2"]["queued_rows"] == 0
+    assert recs["s2"]["rows_per_s"] == pytest.approx(20.0)
+    assert feed.observed_rows_per_s() == pytest.approx(20.0)
+
+
+def test_load_backlogs_skips_torn_records(memkv):
+    scale.save_backlog(memkv, "tj", "good", 5, 1.0)
+    from edl_tpu.cluster import paths
+    from edl_tpu.utils import constants
+    memkv.put(paths.key("tj", constants.ETCD_SCALE, "backlog/torn"),
+              b"{not json")
+    recs = scale.load_backlogs(memkv, "tj")
+    assert set(recs) == {"good"}
+    assert recs["good"]["queued_rows"] == 5
+
+
+# -- DistillAutoscaler -------------------------------------------------------
+
+def test_autoscaler_grow_hold_decay_ladder(memkv):
+    a = DistillAutoscaler(memkv, step=1, grow_s=5.0, hold_s=10.0,
+                          quiet_s=30.0, demand_ttl=120.0)
+    scale.save_backlog(memkv, "tj", "s1", 100, 1.0)   # 100s of backlog
+    # above the grow threshold but not yet held: no step
+    assert a.desired("tj", 1, 3, 1, now=0.0) == 1
+    assert a.desired("tj", 1, 3, 1, now=5.0) == 1
+    # held for the full window: one step, and the window re-arms
+    assert a.desired("tj", 1, 3, 1, now=10.0) == 2
+    assert a.desired("tj", 1, 3, 2, now=15.0) == 2    # re-armed at t=10
+    assert a.desired("tj", 1, 3, 2, now=20.0) == 3    # second held window
+    assert a.desired("tj", 1, 3, 3, now=30.0) == 3    # clamped at max
+    # backlog drains to zero: quiet clock runs, one step per window
+    scale.save_backlog(memkv, "tj", "s1", 0, 10.0)
+    assert a.desired("tj", 1, 3, 3, now=40.0) == 3    # quiet < 30s
+    assert a.desired("tj", 1, 3, 3, now=61.0) == 2    # first quiet window
+    assert a.desired("tj", 1, 3, 2, now=92.0) == 1    # second
+    assert a.desired("tj", 1, 3, 1, now=123.0) == 1   # floored at min
+    a2 = DistillAutoscaler(memkv, step=1, grow_s=5.0, hold_s=0.0,
+                           quiet_s=30.0)
+    # small-but-nonzero backlog refreshes the quiet clock, never grows
+    scale.save_backlog(memkv, "tj2", "s1", 3, 1.0)    # 3s < grow 5s
+    assert a2.desired("tj2", 1, 3, 2, now=0.0) == 2
+    assert a2.desired("tj2", 1, 3, 2, now=100.0) == 2
+
+
+def test_autoscaler_ignores_stale_backlog(memkv):
+    from edl_tpu.cluster import paths
+    from edl_tpu.utils import constants
+    a = DistillAutoscaler(memkv, step=1, grow_s=1.0, hold_s=0.0,
+                          quiet_s=5.0, demand_ttl=60.0)
+    memkv.put(paths.key("stale", constants.ETCD_SCALE, "backlog/dead"),
+              json.dumps({"queued_rows": 1000, "rows_per_s": 1.0,
+                          "at": time.time() - 999.0}).encode())
+    assert a.backlog_seconds("stale") is None
+    # a dead student's huge last backlog never grows the fleet, and the
+    # target decays on quiet down to min
+    assert a.desired("stale", 1, 3, 3, now=0.0) == 3
+    assert a.desired("stale", 1, 3, 3, now=6.0) == 2
+    assert a.desired("stale", 1, 3, 2, now=12.0) == 1
+
+
+# -- controller integration --------------------------------------------------
+
+def test_controller_job_view_counts_fleet_adverts(memkv):
+    from edl_tpu.controller.controller import Controller
+    scale.save_nodes_range(memkv, "teach", 1, 3)
+    scale.save_job_spec(memkv, "teach", kind="distill", fleet=True)
+    regs = [_advert(memkv, "teach", f"t{i}", f"t{i}:1") for i in range(2)]
+    scale.save_backlog(memkv, "teach", "s1", 500, 1.0)
+    ctrl = Controller(
+        memkv, job_ids=["teach"],
+        distill_autoscaler=DistillAutoscaler(memkv, step=1, grow_s=1.0,
+                                             hold_s=0.0, quiet_s=60.0))
+    try:
+        view = ctrl.job_view("teach")
+        assert view is not None
+        assert view.kind == "distill" and view.priority == 50
+        assert view.current_nodes == 2         # counted from live adverts
+        assert view.demand == 3                # backlog held: current + step
+    finally:
+        for r in regs:
+            r.stop()
