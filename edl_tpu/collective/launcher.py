@@ -161,10 +161,13 @@ class Launcher:
         self._resource_register = resource.register_pod(self._store, job_id,
                                                         self._pod, ttl=self._ttl)
         # if the env-gated /metrics endpoint is serving, advertise it in
-        # the coord store so edl-obs-agg discovers this launcher
+        # the coord store so edl-obs-agg discovers this launcher; the
+        # log_dir extra lets the postmortem bundler (obs/bundle.py)
+        # find this pod's workerlog.* tails without sharing env
         self._obs_register = obs_advert.advertise_installed(
             self._store, job_id, "launcher", ttl=self._ttl,
-            extra={"pod": self._pod.pod_id})
+            extra={"pod": self._pod.pod_id,
+                   "log_dir": self._job_env.log_dir})
         if self._cache_service is not None:
             # TTL-leased cache advert next to the pod resource advert:
             # the advert dying with this launcher is exactly the

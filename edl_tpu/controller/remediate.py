@@ -24,7 +24,13 @@ PR 12 gave the rule engine an action hook and one read-only action
   demand record (``cluster/scale.py save_demand``) asks the controller
   for more serving replicas; the controller's autoscaler
   (controller/autoscale.py) honors it and scales the fleet like
-  trainer pods, and scales back in on sustained quiet.
+  trainer pods, and scales back in on sustained quiet;
+- ``bundle`` (prepended to EVERY builtin rule's action list;
+  ``EDL_TPU_OBS_BUNDLE=0`` strips it) — the host-provided postmortem
+  capturer (:mod:`edl_tpu.obs.bundle`, normally the aggregator's):
+  flight-recorder rings, the TSDB window, coord state and workerlog
+  tails frozen into one archive BEFORE a restart/evict action destroys
+  the evidence it would explain.
 
 An actuator wired to an alert is a NEW failure mode, so every action
 runs behind rails:
@@ -120,10 +126,11 @@ class RemediationDispatcher:
     """The action handlers + rails; host-agnostic (needs only the coord
     store and the job id), normally owned by the job's aggregator."""
 
-    ACTIONS = ("restart", "evict", "scale-out")
+    ACTIONS = ("restart", "evict", "scale-out", "bundle")
 
     def __init__(self, store, job_id: str, incident_log=None,
-                 trace_provider=None, enabled: bool | None = None,
+                 trace_provider=None, bundle_fn=None,
+                 enabled: bool | None = None,
                  cooldown_s: float | None = None,
                  breaker_n: int | None = None,
                  breaker_window_s: float | None = None,
@@ -133,6 +140,10 @@ class RemediationDispatcher:
         self.job_id = job_id
         self.incidents = incident_log
         self._trace_provider = trace_provider
+        # the ``bundle`` actuator is host-provided: assembling a
+        # postmortem needs the aggregator's TSDB/history/incident-log,
+        # which the dispatcher deliberately doesn't own.  None -> noop.
+        self._bundle_fn = bundle_fn
         self.enabled = (os.environ.get("EDL_TPU_REMEDIATE", "1") != "0"
                         if enabled is None else bool(enabled))
         self.cooldown_s = (env_float("EDL_TPU_REMEDIATE_COOLDOWN", 30.0)
@@ -314,6 +325,12 @@ class RemediationDispatcher:
             from edl_tpu.gateway.fleet import list_replicas
             live = len(list_replicas(self.store, self.job_id))
             return {"replicas": live + self._scale_step}
+        if action == "bundle":
+            from edl_tpu.obs import advert as obs_advert
+            from edl_tpu.obs.bundle import bundle_dir_from_env
+            return {"dir": bundle_dir_from_env(),
+                    "targets": sorted(obs_advert.list_metrics_targets(
+                        self.store, self.job_id))}
         return {}
 
     # -- the actions ---------------------------------------------------------
@@ -324,6 +341,10 @@ class RemediationDispatcher:
             return self._act_evict(rule, group)
         if action == "scale-out":
             return self._act_scale_out(rule)
+        if action == "bundle":
+            if self._bundle_fn is None:
+                return "noop", {"error": "no bundle capturer on this host"}
+            return self._bundle_fn(rule, group)
         return "noop", {"error": f"unknown action {action!r}"}
 
     def _act_restart(self, rule) -> tuple[str, dict]:

@@ -24,6 +24,7 @@ in-flight counts cover the gap between refreshes).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
@@ -111,12 +112,23 @@ def list_replicas(store, job_id: str) -> dict[str, dict]:
 class FleetView:
     """Background-refreshed view of the replica fleet.
 
-    A poll thread re-reads the adverts every ``period`` seconds and
-    keeps a consistent-hash ring of the live replica ids in step (for
-    session affinity).  Readers get copy-on-write snapshots — the same
-    single-writer/many-readers split as the hash ring itself.  The
-    gateway additionally calls :meth:`refresh` inline after a transport
-    failure so a death is acted on before the next tick.
+    The background thread keeps the view current and a consistent-hash
+    ring of the live replica ids in step (for session affinity).  By
+    default it rides the store's long-poll ``wait()`` on the nodes
+    prefix as a **doorbell** (the ``obs/advert.py
+    MetricsTargetWatcher`` pattern): a replica advert appearing or
+    expiring wakes the thread immediately, which then runs the same
+    :meth:`refresh` read path as ever — pins and ring stay the product
+    of one code path, and an idle fleet costs one mostly-idle long
+    poll per period instead of waking only to re-read an unchanged
+    prefix.  ``EDL_TPU_FLEET_WATCH=0`` (or a store whose ``wait``
+    raises ``NotImplementedError``) restores pure periodic polling;
+    every wait return — event or timeout — still refreshes, so the
+    view is never staler than one period either way.  Readers get
+    copy-on-write snapshots — the same single-writer/many-readers
+    split as the hash ring itself.  The gateway additionally calls
+    :meth:`refresh` inline after a transport failure so a death is
+    acted on before the next tick.
     """
 
     def __init__(self, store, job_id: str,
@@ -129,6 +141,9 @@ class FleetView:
         self._pins: dict[str, str] = {}     # session -> adopted replica
         self.ring = ConsistentHash()
         self._halt = threading.Event()
+        self._watch = (os.environ.get("EDL_TPU_FLEET_WATCH", "1") != "0"
+                       and callable(getattr(store, "wait", None)))
+        self._rev = 0                       # watch thread only
         self.refresh()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"fleet:{job_id}")
@@ -187,8 +202,29 @@ class FleetView:
         return True
 
     def _run(self) -> None:
-        while not self._halt.wait(self._period):
+        while not self._halt.is_set():
+            if self._watch:
+                try:
+                    res = self._store.wait(_nodes_prefix(self._job_id),
+                                           self._rev, self._period)
+                    self._rev = res.revision
+                except NotImplementedError:
+                    self._watch = False     # permanent poll fallback
+                    logger.info("fleet watch unsupported by this store; "
+                                "falling back to polling")
+                    continue
+                except Exception:  # noqa: BLE001 — store blip: poll this round
+                    logger.debug("fleet watch wait failed", exc_info=True)
+                    if self._halt.wait(min(1.0, self._period)):
+                        return
+            elif self._halt.wait(self._period):
+                return
             self.refresh()
+            if self._watch and self._halt.wait(min(0.25, self._period)):
+                # debounce: every Register.update() load-stat write
+                # rings the doorbell too — coalesce storms to at most
+                # a few refreshes per second, still far under a period
+                return
 
     def stop(self) -> None:
         self._halt.set()

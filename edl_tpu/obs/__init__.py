@@ -49,10 +49,14 @@ from edl_tpu.obs.trace import configure_from_env as configure_tracer_from_env  #
 def install_from_env(component: str = "edl") -> None:
     """Enable the env-gated observability surfaces for this process:
     the /metrics endpoint (``EDL_TPU_METRICS_PORT``), the JSONL
-    tracer (``EDL_TPU_TRACE_DIR``), and the inherited distributed
+    tracer (``EDL_TPU_TRACE_DIR``), the inherited distributed
     trace context (``EDL_TPU_TRACE_CONTEXT``, stamped by the launcher
-    so a trainer's whole process joins its resize epoch's trace).
+    so a trainer's whole process joins its resize epoch's trace), and
+    the always-on flight recorder (``GET /flightrec`` —
+    :mod:`edl_tpu.obs.flightrec`; ``EDL_TPU_FLIGHTREC=0`` opts out).
     Idempotent, never raises."""
     serve_from_env(component)
     configure_tracer_from_env(component)
     context.install_from_env()
+    from edl_tpu.obs import flightrec
+    flightrec.install(component)

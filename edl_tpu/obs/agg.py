@@ -52,7 +52,9 @@ from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import profile as obs_profile
 from edl_tpu.obs import rules as obs_rules
 from edl_tpu.obs.metrics import REGISTRY, parse_exposition
-from edl_tpu.obs.tsdb import TSDB, quantile_from_buckets  # noqa: F401 — re-export
+from edl_tpu.obs.tsdb import (  # noqa: F401 — quantile_from_buckets re-export
+    TSDB, HistoryStore, quantile_from_buckets,
+)
 from edl_tpu.utils.logger import get_logger
 from edl_tpu.utils.network import local_ip
 
@@ -183,7 +185,8 @@ class Aggregator:
                  quantile_window: float | None = None,
                  rules: list | None = None,
                  incident_dir: str | None = None,
-                 enable_actions: bool = True):
+                 enable_actions: bool = True,
+                 history_dir: str | None = None):
         self.store = store
         self.job_id = job_id
         self.scrape_timeout = scrape_timeout
@@ -198,6 +201,22 @@ class Aggregator:
         retention = (float(os.environ.get("EDL_TPU_OBS_RETENTION", 600.0))
                      if retention_s is None else float(retention_s))
         self.tsdb = TSDB(retention_s=retention)
+        # durable history (EDL_TPU_OBS_HISTORY_DIR / --history_dir):
+        # every scrape lands in CRC'd on-disk segments (raw tier at the
+        # TSDB's retention + a downsampled long tier), and the rule
+        # engine's pending/firing holds snapshot to alerts.json — so a
+        # restarted aggregator resumes with its windowed quantiles,
+        # goodput and `for:`-held alerts intact instead of blind for a
+        # full retention window.  "" / unset disables (tests, edl-obs-top)
+        if history_dir is None:
+            history_dir = os.environ.get("EDL_TPU_OBS_HISTORY_DIR") or None
+        self.history: HistoryStore | None = None
+        if history_dir:
+            try:
+                self.history = HistoryStore(history_dir,
+                                            raw_retention_s=retention)
+            except Exception:  # noqa: BLE001 — history must never stop serving
+                logger.exception("obs history at %r disabled", history_dir)
         # goodput ledger: fed every scrape from the recovery records +
         # the live trainer-target view; its gauges live in THIS
         # process's registry, which rides the merged page (include_self)
@@ -210,7 +229,8 @@ class Aggregator:
         # observes-only).  Read-only hosts (edl-obs-top's embedded
         # aggregator) disable actions entirely; EDL_TPU_PROFILE_ON_ALERT=0
         # turns just the capture action off fleet-wide
-        incident_log = obs_rules.IncidentLog(incident_dir, "obs-agg", job_id)
+        self.incident_log = obs_rules.IncidentLog(incident_dir, "obs-agg",
+                                                  job_id)
         actions = None
         self.remediator = None
         if enable_actions:
@@ -219,15 +239,35 @@ class Aggregator:
                 actions["profile"] = self._profile_action
             from edl_tpu.controller.remediate import RemediationDispatcher
             self.remediator = RemediationDispatcher(
-                store, job_id, incident_log=incident_log,
-                trace_provider=self._job_trace_id)
+                store, job_id, incident_log=self.incident_log,
+                trace_provider=self._job_trace_id,
+                bundle_fn=self._bundle_capture)
             actions.update(self.remediator.handlers())
         self._action_last: dict[str, float] = {}
         self.engine = obs_rules.RuleEngine(
             self.tsdb,
             obs_rules.load_rules() if rules is None else rules,
-            incident_log=incident_log,
+            incident_log=self.incident_log,
             trace_provider=self._job_trace_id, actions=actions)
+        if self.history is not None:
+            # continuity across a restart: replay the raw tier into the
+            # in-memory TSDB, then re-seed the engine's pending/firing
+            # holds — an alert 40s into a 60s `for:` does NOT restart
+            # its hold because the aggregator died
+            try:
+                n = self.history.replay(self.tsdb)
+                snap = self.history.load_alert_state()
+                restored = self.engine.restore_state(snap)
+                if snap is not None:
+                    # same snapshot carries the goodput ledger: the
+                    # observation window resumes, it doesn't restart
+                    self.goodput.restore_state(snap.get("goodput"))
+                if n or restored:
+                    logger.info(
+                        "obs history: replayed %d scrapes, restored %d "
+                        "alert holds from %s", n, restored, history_dir)
+            except Exception:  # noqa: BLE001 — a bad replay must not stop startup
+                logger.exception("obs history replay failed")
         # discovery: a long-poll watch view of the obs adverts keeps
         # membership current between scrape cycles instead of one
         # O(targets) get_prefix scan per cycle — the first control-plane
@@ -261,9 +301,16 @@ class Aggregator:
         now = time.time() if now is None else now
         try:
             merged, info = self.collect()
-            self.tsdb.ingest(parse_exposition(merged), now)
+            parsed = parse_exposition(merged)
+            self.tsdb.ingest(parsed, now)
+            if self.history is not None:
+                self.history.append(parsed, now)
             self._update_goodput(now, info)
             self.engine.evaluate(now)
+            if self.history is not None:
+                snap = self.engine.export_state()
+                snap["goodput"] = self.goodput.export_state()
+                self.history.save_alert_state(snap)
         except Exception:  # noqa: BLE001 — the loop must survive anything
             logger.exception("scrape loop iteration failed")
         _LOOP_SECONDS.observe(time.perf_counter() - t0)
@@ -514,6 +561,42 @@ class Aggregator:
 
         threading.Thread(target=run, daemon=True,
                          name=f"edl-profile-action:{rule.name}").start()
+
+    def _bundle_capture(self, rule, group: str) -> tuple[str, dict]:
+        """The ``bundle`` actuator (controller/remediate.py rails):
+        freeze the incident's evidence — every target's flight-recorder
+        ring, the TSDB window, coord state, workerlog tails — into one
+        archive BEFORE restart/evict actions destroy it.  Runs inline
+        (not on a daemon thread like profile): the dispatcher's audit
+        record should carry the real bundle path/outcome, and capture
+        is bounded by one scrape timeout."""
+        from edl_tpu.obs import bundle as obs_bundle
+        out_dir = obs_bundle.bundle_dir_from_env()
+        if not out_dir and self.history is not None:
+            out_dir = os.path.join(self.history.dir, "bundles")
+        if not out_dir:
+            return "noop", {"error": "no bundle dir (EDL_TPU_OBS_BUNDLE_DIR"
+                                     " / EDL_TPU_OBS_HISTORY_DIR unset)"}
+        incident = self.incident_log.last_record(rule.name, group)
+        try:
+            targets = self.collect()[1].get("targets", {})
+        except Exception:  # noqa: BLE001 — capture_bundle rediscovers
+            targets = None
+        try:
+            manifest = obs_bundle.capture_bundle(
+                self.store, self.job_id, rule_name=rule.name, group=group,
+                incident=incident, tsdb=self.tsdb, history=self.history,
+                out_dir=out_dir, window_s=max(self.quantile_window, 300.0),
+                timeout=self.scrape_timeout, targets=targets)
+        except Exception as e:  # noqa: BLE001 — a failed capture is an audit row
+            logger.exception("postmortem bundle capture failed")
+            return "error", {"error": f"{type(e).__name__}: {e}"}
+        detail = {"path": manifest["path"], "id": manifest["id"],
+                  "members": len(manifest["members"]),
+                  "rings": manifest["flightrec_rings"]}
+        if manifest["missing"]:
+            detail["missing"] = sorted(manifest["missing"])
+        return "ok", detail
 
     def alerts_json(self) -> dict:
         """The ``/alerts`` body: the rule engine's state plus the
@@ -836,6 +919,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--retention", type=float, default=None,
                    help="TSDB retention window in seconds "
                         "(default EDL_TPU_OBS_RETENTION=600)")
+    p.add_argument("--history_dir", default=None,
+                   help="durable scrape history + alert-state snapshots "
+                        "(default EDL_TPU_OBS_HISTORY_DIR; unset disables)")
     args = p.parse_args(argv)
 
     from edl_tpu import obs
@@ -850,7 +936,8 @@ def main(argv: list[str] | None = None) -> int:
                               scrape_timeout=args.scrape_timeout,
                               cache_s=args.cache_s,
                               scrape_interval=args.scrape_interval,
-                              retention_s=args.retention).start()
+                              retention_s=args.retention,
+                              history_dir=args.history_dir).start()
     print(f"[edl-obs-agg] job {args.job_id}: serving merged /metrics + "
           f"/healthz + /alerts on {server.endpoint}", flush=True)
     try:

@@ -1,0 +1,359 @@
+"""Postmortem bundles: one self-contained archive per incident.
+
+The alert/remediate loop (PRs 8, 12, 15) detects and acts, but the
+evidence explaining *why* evaporates with the processes involved.  A
+bundle freezes it: when an alert fires (the ``bundle`` action, behind
+the remediation rails) — or on demand via ``edl-obs-bundle`` — the
+capture
+
+- fans out to every advertised target's ``GET /flightrec`` and writes
+  each ring as a per-process ``trace-<component>-<pid>.jsonl`` (plus
+  the raw snapshot with logs and last-scraped metrics), so
+  ``edl-obs-dump --merge <bundle_dir>`` and the Perfetto export render
+  the bundle as the causal timeline of the incident's trace_id;
+- snapshots the aggregator's TSDB window around the firing
+  (``tsdb-window.json``), or rebuilds it from the durable history
+  tiers (:class:`~edl_tpu.obs.tsdb.HistoryStore`) when capturing after
+  the fact;
+- pulls the coord store's ``dump_state`` (``coord-state.json``) and
+  the tails of every reachable ``workerlog.*`` under the job's log
+  dir(s);
+- writes ``manifest.json`` carrying the incident's id, rule, group and
+  trace_id — the join key into the merged trace timeline.
+
+A target that does not answer makes the bundle PARTIAL (listed under
+``missing`` in the manifest), never a failure: the postmortem of a
+dying fleet is exactly when targets are unreachable.
+
+``edl-obs-bundle --incident <id>`` reassembles a bundle for a past
+incident from the durable incident records + history tiers, long after
+the aggregator and the alerting processes are gone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import tsdb as obs_tsdb
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_BUNDLES_TOTAL = obs_metrics.counter(
+    "edl_bundles_total",
+    "Postmortem bundles assembled, by outcome (ok / partial / error)",
+    ("outcome",))
+_CAPTURE_SECONDS = obs_metrics.histogram(
+    "edl_bundle_capture_seconds",
+    "Wall-clock cost of one full bundle capture (fan-out + snapshot + "
+    "archive)")
+
+_TAIL_BYTES = 64 << 10          # per-workerlog tail kept in the bundle
+_MAX_LOG_FILES = 64             # workerlog fan-in cap per bundle
+
+
+def bundle_dir_from_env() -> str | None:
+    """Where bundles land: ``EDL_TPU_OBS_BUNDLE_DIR``, falling back to
+    ``<EDL_TPU_OBS_HISTORY_DIR>/bundles`` so enabling durable history
+    implicitly enables durable bundles."""
+    d = os.environ.get("EDL_TPU_OBS_BUNDLE_DIR")
+    if d:
+        return d
+    h = os.environ.get("EDL_TPU_OBS_HISTORY_DIR")
+    return os.path.join(h, "bundles") if h else None
+
+
+def _tail(path: str, max_bytes: int = _TAIL_BYTES) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        return f.read()
+
+
+def _workerlog_tails(log_dirs: list[str], bundle_dir: str) -> list[str]:
+    """Tail every ``workerlog.*`` under the given dirs into
+    ``workerlogs/`` bundle members; returns member paths written."""
+    members: list[str] = []
+    seen: set[str] = set()
+    out_dir = os.path.join(bundle_dir, "workerlogs")
+    for d in log_dirs:
+        if not d or not os.path.isdir(d):
+            continue
+        for path in sorted(glob.glob(os.path.join(d, "**", "workerlog.*"),
+                                     recursive=True)):
+            real = os.path.realpath(path)
+            if real in seen or len(members) >= _MAX_LOG_FILES:
+                continue
+            seen.add(real)
+            rel = os.path.relpath(path, d).replace(os.sep, "_")
+            member = os.path.join("workerlogs", rel + ".tail")
+            try:
+                data = _tail(path)
+                os.makedirs(out_dir, exist_ok=True)
+                with open(os.path.join(bundle_dir, member), "wb") as f:
+                    f.write(data)
+                members.append(member)
+            except OSError:
+                logger.debug("workerlog tail failed for %s", path,
+                             exc_info=True)
+    return members
+
+
+def _fetch_flightrec(endpoint: str, timeout: float) -> dict:
+    return json.loads(urllib.request.urlopen(
+        f"http://{endpoint}/flightrec", timeout=timeout).read().decode())
+
+
+def _json_default(o):
+    """Coord KV values are bytes (usually UTF-8 JSON payloads): decode
+    where possible, base64 the rest — a binary value must not cost the
+    bundle its coord-state member."""
+    if isinstance(o, (bytes, bytearray)):
+        try:
+            return bytes(o).decode("utf-8")
+        except UnicodeDecodeError:
+            import base64
+            return {"b64": base64.b64encode(bytes(o)).decode("ascii")}
+    return repr(o)
+
+
+def _write_json(bundle_dir: str, member: str, obj) -> str:
+    with open(os.path.join(bundle_dir, member), "w", encoding="utf-8") as f:
+        f.write(json.dumps(obj, indent=1, default=_json_default))
+    return member
+
+
+def capture_bundle(store, job_id: str, *, rule_name: str = "manual",
+                   group: str = "", trace_id: str | None = None,
+                   incident: dict | None = None,
+                   tsdb: obs_tsdb.TSDB | None = None,
+                   history: obs_tsdb.HistoryStore | None = None,
+                   out_dir: str | None = None, window_s: float = 600.0,
+                   timeout: float = 3.0,
+                   targets: dict[str, dict] | None = None,
+                   log_dirs: list[str] | None = None,
+                   now: float | None = None, source: str = "live") -> dict:
+    """Assemble one bundle directory; returns its manifest (with
+    ``path`` added).  Raises only on a bundle-dir setup failure —
+    everything inside the capture is best-effort and lands in the
+    manifest as ``missing``/``errors`` instead."""
+    t0 = time.perf_counter()
+    now = time.time() if now is None else now
+    out_dir = out_dir or bundle_dir_from_env()
+    if not out_dir:
+        raise ValueError("no bundle dir (EDL_TPU_OBS_BUNDLE_DIR / "
+                         "EDL_TPU_OBS_HISTORY_DIR unset)")
+    incident_id = (incident or {}).get("id") or f"{int(now * 1000):x}"
+    if trace_id is None:
+        trace_id = (incident or {}).get("trace_id")
+    if trace_id is None and store is not None:
+        from edl_tpu.obs import advert
+        try:
+            rec = advert.current_job_trace(store, job_id)
+            trace_id = rec.get("trace_id") if rec else None
+        except Exception:  # noqa: BLE001 — a store blip must not stop capture
+            logger.debug("bundle trace lookup failed", exc_info=True)
+    bundle_dir = os.path.join(out_dir, f"bundle-{rule_name}-{incident_id}")
+    os.makedirs(bundle_dir, exist_ok=True)
+
+    members: list[str] = []
+    missing: dict[str, str] = {}
+    rings = 0
+
+    # -- flight-recorder fan-out --------------------------------------------
+    if targets is None and store is not None:
+        from edl_tpu.obs import advert
+        try:
+            targets = advert.list_metrics_targets(store, job_id)
+        except Exception as e:  # noqa: BLE001 — capture what we can reach
+            logger.debug("bundle target discovery failed", exc_info=True)
+            missing["_discovery"] = f"{type(e).__name__}: {e}"
+            targets = {}
+    targets = targets or {}
+    if targets:
+        with ThreadPoolExecutor(max_workers=max(1, len(targets))) as pool:
+            futs = {name: pool.submit(_fetch_flightrec,
+                                      str(t.get("endpoint")), timeout)
+                    for name, t in targets.items() if t.get("endpoint")}
+            for name, fut in sorted(futs.items()):
+                try:
+                    snap = fut.result()
+                except Exception as e:  # noqa: BLE001 — partial bundle, not failure
+                    missing[name] = f"{type(e).__name__}: {e}"
+                    continue
+                rings += 1
+                comp = str(snap.get("component", "proc"))
+                pid = snap.get("pid", 0)
+                members.append(_write_json(
+                    bundle_dir, f"flightrec-{comp}-{pid}.json", snap))
+                # the ring's events, replayed as a trace file the
+                # merge/Perfetto tooling reads natively
+                member = f"trace-{comp}-{pid}.jsonl"
+                try:
+                    with open(os.path.join(bundle_dir, member), "w",
+                              encoding="utf-8") as f:
+                        for ev in snap.get("events", []):
+                            f.write(json.dumps(ev) + "\n")
+                    members.append(member)
+                except OSError:
+                    logger.debug("bundle trace member failed",
+                                 exc_info=True)
+
+    # -- TSDB window ---------------------------------------------------------
+    start, end = now - float(window_s), now
+    window = None
+    if tsdb is not None:
+        window = tsdb.dump_window(start, end)
+    if not window and history is not None:
+        window = history.read_window(start, end)
+    if window is not None:
+        members.append(_write_json(bundle_dir, "tsdb-window.json",
+                                   {"start": start, "end": end,
+                                    "series": window}))
+
+    # -- coord store state ---------------------------------------------------
+    if store is not None and hasattr(store, "dump_state"):
+        try:
+            members.append(_write_json(bundle_dir, "coord-state.json",
+                                       store.dump_state()))
+        except Exception as e:  # noqa: BLE001 — a dead store is itself evidence
+            missing["_coord_dump_state"] = f"{type(e).__name__}: {e}"
+
+    # -- workerlog tails -----------------------------------------------------
+    dirs = list(log_dirs or [])
+    for t in targets.values():
+        d = t.get("log_dir")
+        if d and d not in dirs:
+            dirs.append(str(d))
+    env_dir = os.environ.get("EDL_TPU_LOG_DIR")
+    if env_dir and env_dir not in dirs:
+        dirs.append(env_dir)
+    members.extend(_workerlog_tails(dirs, bundle_dir))
+
+    # -- the triggering incident, in dump-mergeable shape --------------------
+    if incident:
+        member = "incidents-bundle-0.jsonl"
+        try:
+            with open(os.path.join(bundle_dir, member), "w",
+                      encoding="utf-8") as f:
+                f.write(json.dumps(incident) + "\n")
+            members.append(member)
+        except OSError:
+            logger.debug("bundle incident member failed", exc_info=True)
+
+    manifest = {"id": incident_id, "job_id": job_id, "rule": rule_name,
+                "group": group, "trace_id": trace_id, "ts": now,
+                "window": [start, end], "source": source,
+                "flightrec_rings": rings, "members": sorted(members),
+                "missing": missing,
+                "outcome": "partial" if missing else "ok"}
+    _write_json(bundle_dir, "manifest.json", manifest)
+    manifest["path"] = bundle_dir
+    _BUNDLES_TOTAL.labels(outcome=manifest["outcome"]).inc()
+    _CAPTURE_SECONDS.observe(time.perf_counter() - t0)
+    logger.info("postmortem bundle %s: %d members, %d rings%s -> %s",
+                incident_id, len(members), rings,
+                f", {len(missing)} missing" if missing else "", bundle_dir)
+    return manifest
+
+
+def find_incident(incident_id: str, dirs: list[str]) -> dict | None:
+    """Scan incident JSONL files (current + rotated) in ``dirs`` for
+    the record carrying ``incident_id``."""
+    for d in dirs:
+        if not d:
+            continue
+        paths = (glob.glob(os.path.join(d, "incidents-*.jsonl"))
+                 + glob.glob(os.path.join(d, "incidents-*.jsonl.1")))
+        for path in sorted(paths):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(rec, dict) \
+                                and rec.get("id") == incident_id:
+                            return rec
+            except OSError:
+                continue
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        "edl-obs-bundle",
+        description="Assemble a postmortem bundle: flight-recorder rings "
+                    "from every live process, the obs-history window, the "
+                    "coord store state and workerlog tails — now, or "
+                    "reassembled for a past --incident id")
+    p.add_argument("--coord_endpoints", default=None,
+                   help="coord store to discover targets / dump state from "
+                        "(optional for --incident reassembly)")
+    p.add_argument("--job_id", default="")
+    p.add_argument("--out", default=None,
+                   help="bundle output dir (default EDL_TPU_OBS_BUNDLE_DIR "
+                        "or <EDL_TPU_OBS_HISTORY_DIR>/bundles)")
+    p.add_argument("--incident", default=None,
+                   help="reassemble the bundle for this incident id from "
+                        "durable incident records + history tiers")
+    p.add_argument("--history_dir", default=None,
+                   help="durable obs history (default "
+                        "EDL_TPU_OBS_HISTORY_DIR)")
+    p.add_argument("--trace_dir", default=None,
+                   help="where incident records live (default "
+                        "EDL_TPU_INCIDENT_DIR / EDL_TPU_TRACE_DIR)")
+    p.add_argument("--window", type=float, default=600.0,
+                   help="seconds of TSDB history around the incident")
+    p.add_argument("--timeout", type=float, default=3.0)
+    args = p.parse_args(argv)
+
+    store = None
+    if args.coord_endpoints:
+        from edl_tpu.coord.client import connect
+        store = connect(args.coord_endpoints)
+    history = None
+    hist_dir = args.history_dir or os.environ.get("EDL_TPU_OBS_HISTORY_DIR")
+    if hist_dir and os.path.isdir(hist_dir):
+        history = obs_tsdb.HistoryStore(hist_dir)
+
+    incident = None
+    rule_name, group, now, source = "manual", "", None, "live"
+    if args.incident:
+        dirs = [args.trace_dir or os.environ.get(
+            "EDL_TPU_INCIDENT_DIR", os.environ.get("EDL_TPU_TRACE_DIR"))]
+        incident = find_incident(args.incident, dirs)
+        if incident is None:
+            print(f"error: no incident record with id {args.incident!r} "
+                  f"under {dirs}", file=sys.stderr)
+            return 2
+        rule_name = str(incident.get("name", "alert/?")).split("/", 1)[-1]
+        group = str(incident.get("group", ""))
+        now = float(incident.get("ts", time.time())) + args.window / 2
+        source = "reassembled"
+
+    try:
+        manifest = capture_bundle(
+            store, args.job_id or str((incident or {}).get("job", "")),
+            rule_name=rule_name, group=group, incident=incident,
+            history=history, out_dir=args.out, window_s=args.window,
+            timeout=args.timeout, now=now, source=source)
+    finally:
+        if store is not None:
+            store.close()
+    print(json.dumps(manifest, indent=1))
+    return 0 if manifest.get("outcome") == "ok" else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

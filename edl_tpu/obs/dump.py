@@ -119,7 +119,9 @@ def read_trace_file(path: str) -> tuple[list[dict], int]:
 def read_trace_dir(trace_dir: str) -> tuple[list[dict], int]:
     """Every ``trace-*.jsonl`` (and rotated ``.jsonl.1``) in the shared
     directory, plus the aggregator's ``incidents-*.jsonl`` alert
-    records (:mod:`edl_tpu.obs.rules` writes them trace-event-shaped
+    records — rotated generations included, since
+    ``EDL_TPU_TRACE_MAX_MB`` caps incident files the same way
+    (:mod:`edl_tpu.obs.rules` writes them trace-event-shaped
     and stamped with the job's generation trace_id, so a firing alert
     lands inside the causal timeline of the resize/hang it belongs to);
     events are tagged with their source ``file`` so merged views can
@@ -128,7 +130,9 @@ def read_trace_dir(trace_dir: str) -> tuple[list[dict], int]:
     skipped = 0
     paths = sorted(glob.glob(os.path.join(trace_dir, "trace-*.jsonl"))
                    + glob.glob(os.path.join(trace_dir, "trace-*.jsonl.1"))
-                   + glob.glob(os.path.join(trace_dir, "incidents-*.jsonl")))
+                   + glob.glob(os.path.join(trace_dir, "incidents-*.jsonl"))
+                   + glob.glob(os.path.join(trace_dir,
+                                            "incidents-*.jsonl.1")))
     for path in paths:
         try:
             evs, bad = read_trace_file(path)

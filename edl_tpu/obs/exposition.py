@@ -49,6 +49,27 @@ def register_route(path: str, fn) -> None:
     _routes[path] = fn
 
 
+# observers of every served /metrics page: ``fn(text)`` runs after a
+# scrape renders, so the flight recorder (obs/flightrec.py) can keep
+# the LAST-SCRAPED exposition — what the aggregator actually saw —
+# without a second render.  Same registration pattern as _routes.
+_scrape_observers: list = []
+
+
+def observe_scrapes(fn) -> None:
+    """Register ``fn(exposition_text)`` to see every served page."""
+    if fn not in _scrape_observers:
+        _scrape_observers.append(fn)
+
+
+def _notify_scrape(text: str) -> None:
+    for fn in list(_scrape_observers):
+        try:
+            fn(text)
+        except Exception:  # noqa: BLE001 — an observer must not fail a scrape
+            logger.exception("scrape observer failed")
+
+
 def parse_query(query: str) -> dict[str, str]:
     """Query string → last-value-wins flat dict — the one parser every
     route handler (here, the aggregator's /profile, obs/profile.py)
@@ -86,7 +107,9 @@ class MetricsServer:
                         self.send_error(500)
                         return
                 elif path in ("/metrics", "/"):
-                    body = reg.render().encode("utf-8")
+                    text = reg.render()
+                    _notify_scrape(text)
+                    body = text.encode("utf-8")
                     ctype = CONTENT_TYPE
                 else:
                     self.send_error(404)

@@ -242,6 +242,46 @@ class GoodputLedger:
             obs_trace.emit("goodput/sample", counters=counters)
         return summ
 
+    # -- restart continuity ---------------------------------------------------
+    def export_state(self) -> dict:
+        """The ledger as one JSON-able snapshot; the aggregator persists
+        it next to the rule engine's alert holds so a restart resumes
+        the SAME observation window instead of opening a new one (and
+        silently forgetting every second of badput already watched)."""
+        return {"t0": self._t0, "last": self._last,
+                "idle_s": self._idle_s,
+                "idle_spans": [list(s) for s in self._idle_spans],
+                "record_badput": dict(self._record_badput),
+                "seen_trainers": self._seen_trainers}
+
+    def restore_state(self, snap: dict | None,
+                      max_age_s: float = 600.0) -> bool:
+        """Resume a prior process's observation window.  Only a fresh
+        ledger accepts (never clobber live accumulation), and snapshots
+        whose last update is older than ``max_age_s`` are ignored — the
+        gap since then was nobody's watch."""
+        if not isinstance(snap, dict) or self._t0 is not None:
+            return False
+        last = snap.get("last")
+        t0 = snap.get("t0")
+        if (not isinstance(last, (int, float))
+                or not isinstance(t0, (int, float))
+                # edl-lint: disable=clock — staleness vs a timestamp
+                # persisted by a PRIOR process: only wall clock spans
+                # a restart (monotonic resets with the process)
+                or time.time() - last > max_age_s):
+            return False
+        self._t0 = float(t0)
+        self._last = float(last)
+        self._idle_s = float(snap.get("idle_s", 0.0))
+        self._idle_spans = [[float(a), float(b)]
+                            for a, b in snap.get("idle_spans", [])][:256]
+        self._record_badput = {
+            r: float(snap.get("record_badput", {}).get(r, 0.0))
+            for r in BADPUT_REASONS}
+        self._seen_trainers = bool(snap.get("seen_trainers"))
+        return True
+
     def summary(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
         observed = max(0.0, (now - self._t0) if self._t0 is not None

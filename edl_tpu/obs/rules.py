@@ -39,6 +39,7 @@ import json
 import os
 import threading
 import time
+import uuid
 
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import trace as obs_trace
@@ -63,6 +64,9 @@ _RECORDED_G = obs_metrics.gauge(
     "edl_alerts_recorded",
     "Recording-rule outputs, by recorded name and series group",
     ("rule", "series"))
+_INCIDENT_ROTATIONS_TOTAL = obs_metrics.counter(
+    "edl_incident_rotations_total",
+    "Incident-log file rotations forced by EDL_TPU_TRACE_MAX_MB")
 _ACTIONS_TOTAL = obs_metrics.counter(
     "edl_alert_actions_total",
     "Alert action hooks invoked on firing transitions, by action and "
@@ -199,7 +203,7 @@ def builtin_rules() -> list[Rule]:
     requeue = float(os.environ.get("EDL_TPU_ALERT_REQUEUE_RATE", 50.0))
     backlog_slo = float(os.environ.get(
         "EDL_TPU_ALERT_DISTILL_BACKLOG_SLO", 30.0))
-    return [
+    rules = [
         # the StudentFeed's backlog-seconds gauge: sustained backlog
         # beyond the SLO means the teacher fleet is undersized faster
         # than the autoscaler is reacting (or the job is at max_nodes)
@@ -283,6 +287,15 @@ def builtin_rules() -> list[Rule]:
                      "a flapping rule is being suppressed; the job is "
                      "NOT self-healing until it half-opens"),
     ]
+    # every incident yields a postmortem bundle (obs/bundle.py): the
+    # capture action runs FIRST so the flight-recorder rings and TSDB
+    # window are frozen before a restart/evict destroys the evidence.
+    # It rides the same dispatcher rails (cooldown/breaker/dry-run);
+    # EDL_TPU_OBS_BUNDLE=0 strips it fleet-wide.
+    if os.environ.get("EDL_TPU_OBS_BUNDLE", "1") != "0":
+        for r in rules:
+            r.action = "bundle" if not r.action else f"bundle,{r.action}"
+    return rules
 
 
 _RULE_FIELDS = {f.name for f in dataclasses.fields(Rule)} | {"for"}
@@ -338,22 +351,36 @@ class IncidentLog:
     (best-effort either way; alerting must never die on a full disk)."""
 
     def __init__(self, dir_path: str | None = None,
-                 component: str = "obs-agg", job_id: str = ""):
+                 component: str = "obs-agg", job_id: str = "",
+                 max_bytes: int | None = None):
         self.dir = (dir_path if dir_path is not None
                     else os.environ.get("EDL_TPU_INCIDENT_DIR",
                                         os.environ.get("EDL_TPU_TRACE_DIR")))
         self.component = component
         self.job_id = job_id
+        # same size cap + <file>.1 rotation scheme as the trace files:
+        # a flapping rule must not grow the incident log without bound
+        self.max_bytes = (obs_trace._max_bytes_from_env()
+                          if max_bytes is None else int(max_bytes))
         self._lock = threading.Lock()
+        self._bytes: int | None = None   # lazily sized at first append
+        # last alert record per (rule, group): the bundle action reads
+        # the incident id + trace link of the firing it was triggered by
+        self._last: dict[tuple[str, str], dict] = {}
         self.path = None
         if self.dir:
             self.path = os.path.join(
                 self.dir, f"incidents-{component}-{os.getpid()}.jsonl")
 
+    def last_record(self, rule_name: str, group: str = "") -> dict | None:
+        with self._lock:
+            return self._last.get((rule_name, group))
+
     def write(self, state: str, rule: Rule, group: str, value: float,
               trace_id: str | None = None, at: float | None = None) -> dict:
         rec = {"ts": round(time.time() if at is None else at, 6),
                "name": f"alert/{rule.name}",
+               "id": uuid.uuid4().hex[:12],
                "component": self.component,
                "state": state, "severity": rule.severity,
                "value": round(float(value), 6)}
@@ -368,6 +395,8 @@ class IncidentLog:
         if trace_id:
             rec["trace_id"] = trace_id
         _INCIDENTS_TOTAL.labels(state=state).inc()
+        with self._lock:
+            self._last[(rule.name, group)] = rec
         self._append(rec)
         return rec
 
@@ -400,6 +429,7 @@ class IncidentLog:
     def _append(self, rec: dict) -> None:
         wrote = False
         if self.path:
+            line = json.dumps(rec) + "\n"
             try:
                 # edl-lint: disable=blocking-under-lock — the incident
                 # log's file lock: serializing the append is its whole
@@ -407,8 +437,17 @@ class IncidentLog:
                 # its own evaluation lock — the PR 8 review fix)
                 with self._lock:
                     os.makedirs(self.dir, exist_ok=True)
+                    if self._bytes is None:
+                        try:
+                            self._bytes = os.path.getsize(self.path)
+                        except OSError:
+                            self._bytes = 0
+                    if (self.max_bytes
+                            and self._bytes + len(line) > self.max_bytes):
+                        self._rotate_locked()
                     with open(self.path, "a", encoding="utf-8") as f:
-                        f.write(json.dumps(rec) + "\n")
+                        f.write(line)
+                    self._bytes += len(line)
                 wrote = True
             except OSError:
                 logger.exception("incident record write failed")
@@ -416,6 +455,18 @@ class IncidentLog:
             obs_trace.emit(rec["name"],
                            **{k: v for k, v in rec.items()
                               if k not in ("ts", "name")})
+
+    def _rotate_locked(self) -> None:
+        """Roll to ``<path>.1`` (previous generation replaced), the
+        trace-file scheme; a failed rename keeps appending to the
+        oversized file — losing history to a rotation error would be
+        worse than a big file."""
+        try:
+            os.replace(self.path, self.path + ".1")
+            self._bytes = 0
+            _INCIDENT_ROTATIONS_TOTAL.inc()
+        except OSError:
+            logger.exception("incident log rotation failed")
 
 
 class _AlertState:
@@ -557,6 +608,52 @@ class RuleEngine:
             transitions.append(("resolved", rule, group, st.value))
         st.pending_since = None
         st.firing_since = None
+
+    # -- restart continuity --------------------------------------------------
+    def export_state(self) -> dict:
+        """The per-(rule, group) hold state as one JSON-able snapshot.
+        The aggregator persists it next to the durable TSDB history
+        (``HistoryStore.save_alert_state``) after every evaluation."""
+        with self._lock:
+            return {"ts": time.time(),
+                    "state": [[name, group, st.pending_since,
+                               st.firing_since, st.value]
+                              for (name, group), st in self._state.items()]}
+
+    def restore_state(self, snap: dict,
+                      max_age_s: float = 600.0) -> int:
+        """Seed the state machine from a prior process's snapshot so an
+        aggregator restart does not reset pending ``for:`` holds or
+        silently re-fire already-firing alerts.  Snapshots older than
+        ``max_age_s`` are ignored (the holds they describe are stale);
+        entries for rules no longer configured are dropped.  Returns
+        the number of entries restored."""
+        try:
+            ts = float(snap.get("ts", 0.0))
+            entries = list(snap.get("state", []))
+        except (AttributeError, TypeError, ValueError):
+            return 0
+        # edl-lint: disable=clock — staleness vs a timestamp persisted
+        # by a PRIOR process: only wall clock spans a restart
+        if not entries or time.time() - ts > max_age_s:
+            return 0
+        names = {r.name for r in self.rules}
+        n = 0
+        with self._lock:
+            for entry in entries:
+                try:
+                    name, group, pending, firing, value = entry
+                except (TypeError, ValueError):
+                    continue
+                if name not in names:
+                    continue
+                st = _AlertState()
+                st.pending_since = None if pending is None else float(pending)
+                st.firing_since = None if firing is None else float(firing)
+                st.value = float(value)
+                self._state[(str(name), str(group))] = st
+                n += 1
+        return n
 
     # -- read side -----------------------------------------------------------
     def _rule(self, name: str) -> Rule | None:

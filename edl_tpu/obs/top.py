@@ -14,8 +14,10 @@ Two ways in:
   embeds one (scrape loop + TSDB + ruleset, no HTTP server) and drives
   it itself.
 
-``--once`` prints a single frame and exits (scripts/CI); otherwise the
-screen refreshes every ``--interval`` seconds until Ctrl-C.
+``--once`` prints a single frame and exits (scripts/CI); ``--json``
+prints the same snapshot machine-readable (``{"health", "alerts"}``)
+and exits; otherwise the screen refreshes every ``--interval`` seconds
+until Ctrl-C.
 """
 
 from __future__ import annotations
@@ -185,6 +187,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--once", action="store_true",
                    help="print one frame and exit")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable frame ({\"health\", "
+                        "\"alerts\"} JSON) and exit — implies --once")
     p.add_argument("--no_clear", action="store_true",
                    help="append frames instead of redrawing the screen")
     args = p.parse_args(argv)
@@ -202,23 +207,37 @@ def main(argv: list[str] | None = None) -> int:
         # the real aggregator's, however EDL_TPU_*_DIR is set
         # enable_actions=False for the same reason: a viewer must never
         # trigger profiler captures the real aggregator didn't ask for
+        # history_dir="": nor write durable history segments next to
+        # (and interleaved with) the real aggregator's
         agg = Aggregator(store, args.job_id,
                          scrape_interval=max(args.interval, 0.25),
-                         incident_dir="", enable_actions=False)
+                         incident_dir="", enable_actions=False,
+                         history_dir="")
 
-    def frame() -> str:
+    def snapshot() -> tuple[dict, dict | None]:
         if agg is not None:
             agg.scrape_once()
-            return render_top(agg.job_summary(), agg.alerts_json())
+            return agg.job_summary(), agg.alerts_json()
         base = f"http://{args.endpoint}"
         health = _fetch_json(base + "/healthz", timeout=10)
         try:
             alerts = _fetch_json(base + "/alerts", timeout=10)
         except Exception:  # noqa: BLE001 — pre-alerts aggregator: degrade
             alerts = None
+        return health, alerts
+
+    def frame() -> str:
+        health, alerts = snapshot()
         return render_top(health, alerts)
 
     try:
+        if args.json:
+            # one-shot machine-readable frame: the same health+alerts
+            # snapshot the human view renders, for scripts and CI
+            health, alerts = snapshot()
+            print(json.dumps({"health": health, "alerts": alerts},
+                             indent=1))
+            return 0
         while True:
             text = frame()
             if args.once:

@@ -49,19 +49,83 @@ _ROTATIONS_TOTAL = obs_metrics.counter(
     "edl_trace_rotations_total",
     "Trace file rotations forced by EDL_TPU_TRACE_MAX_MB")
 
+# in-process observers of every emitted trace event (the flight
+# recorder's ring — obs/flightrec.py).  Taps see the fully-built record
+# dict (context ids stamped) and run OUTSIDE any tracer file lock; a
+# tap that raises is dropped from the event, never from the process.
+# With taps installed, even a NullTracer process (no EDL_TPU_TRACE_DIR)
+# builds and delivers records — the flight recorder must capture the
+# last seconds before a crash whether or not durable tracing is on.
+_TAPS: list = []
+
+
+def add_tap(fn) -> None:
+    """Register ``fn(rec: dict)`` to observe every emitted event."""
+    if fn not in _TAPS:
+        _TAPS.append(fn)
+
+
+def remove_tap(fn) -> None:
+    try:
+        _TAPS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_taps(rec: dict) -> None:
+    for fn in list(_TAPS):
+        try:
+            fn(rec)
+        # edl-lint: disable=wire-error — taps run inside every emit on
+        # the hot path; logging a broken tap per event would flood the
+        # very log the flight recorder is also hooked into
+        except Exception:  # noqa: BLE001 — a bad tap must not stop tracing
+            pass
+
+
+def _build_record(name: str, component: str, dur: float | None,
+                  at: float | None, fields: dict) -> dict:
+    rec: dict = {"ts": round(time.time() if at is None else at, 6),
+                 "name": name}
+    if component:
+        rec["component"] = component
+    if dur is not None:
+        rec["dur"] = round(float(dur), 6)
+    rec.update(fields)
+    ctx = obs_context.current()
+    if ctx is not None:
+        # setdefault: an event may legitimately pin its own ids
+        # (e.g. re-emitting another process's record)
+        rec.setdefault("trace_id", ctx.trace_id)
+        rec.setdefault("span_id", ctx.span_id)
+        if ctx.parent_id is not None:
+            rec.setdefault("parent_id", ctx.parent_id)
+    return rec
+
 
 class NullTracer:
-    """Disabled tracer: every operation is a no-op."""
+    """Disabled tracer: every operation is a no-op (when no tap is
+    installed; with taps, records are built and delivered to them —
+    ring-only tracing)."""
 
     enabled = False
 
     def emit(self, name: str, *, dur: float | None = None,
              at: float | None = None, **fields) -> None:
-        pass
+        if _TAPS:
+            _run_taps(_build_record(name, "", dur, at, fields))
 
     @contextmanager
     def span(self, name: str, **fields):
-        yield
+        if not _TAPS:
+            yield
+            return
+        t_wall = time.time()
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.emit(name, dur=time.monotonic() - t0, at=t_wall, **fields)
 
     def close(self) -> None:
         pass
@@ -101,21 +165,9 @@ class Tracer:
 
     def emit(self, name: str, *, dur: float | None = None,
              at: float | None = None, **fields) -> None:
-        rec: dict = {"ts": round(time.time() if at is None else at, 6),
-                     "name": name}
-        if self.component:
-            rec["component"] = self.component
-        if dur is not None:
-            rec["dur"] = round(float(dur), 6)
-        rec.update(fields)
-        ctx = obs_context.current()
-        if ctx is not None:
-            # setdefault: an event may legitimately pin its own ids
-            # (e.g. re-emitting another process's record)
-            rec.setdefault("trace_id", ctx.trace_id)
-            rec.setdefault("span_id", ctx.span_id)
-            if ctx.parent_id is not None:
-                rec.setdefault("parent_id", ctx.parent_id)
+        rec = _build_record(name, self.component, dur, at, fields)
+        if _TAPS:
+            _run_taps(rec)
         line = json.dumps(rec) + "\n"
         # edl-lint: disable=blocking-under-lock — the tracer's file
         # lock: serializing the JSONL append is its whole purpose, and

@@ -29,11 +29,19 @@ Everything is lock-guarded; readers (rule engine, /healthz,
 
 from __future__ import annotations
 
+import binascii
+import json
 import math
+import os
+import struct
 import threading
+import time
 from collections import deque
 
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
 
 _SERIES_G = obs_metrics.gauge(
     "edl_tsdb_series", "Live series held by the aggregator's ring-buffer TSDB")
@@ -42,6 +50,19 @@ _POINTS_G = obs_metrics.gauge(
 _EVICTED_TOTAL = obs_metrics.counter(
     "edl_tsdb_series_evicted_total",
     "Series evicted after going one retention window without a sample")
+_HISTORY_RECORDS_TOTAL = obs_metrics.counter(
+    "edl_obs_history_records_total",
+    "Scrape records appended to the durable obs history, by tier",
+    ("tier",))
+_HISTORY_BYTES_G = obs_metrics.gauge(
+    "edl_obs_history_bytes", "On-disk bytes held per history tier",
+    ("tier",))
+_HISTORY_SEGMENTS_G = obs_metrics.gauge(
+    "edl_obs_history_segments", "Live segment files per history tier",
+    ("tier",))
+_HISTORY_TRUNCATED_TOTAL = obs_metrics.counter(
+    "edl_obs_history_truncated_total",
+    "Torn-tail segment truncations performed while loading history")
 
 # a series must cover at least this fraction of the asked window before
 # a rate over it is trusted — a just-started job must read as "no data
@@ -292,3 +313,343 @@ class TSDB:
                 continue
             out[group] = sums[group][0] / cnt
         return out
+
+    def dump_window(self, start: float, end: float,
+                    names: set[str] | None = None) -> list[dict]:
+        """Every held point in ``[start, end]`` as JSON-able series
+        dicts (the postmortem bundle's TSDB snapshot) — ``{"name",
+        "labels": [[k, v], ...], "points": [[ts, value], ...]}``,
+        sorted by series key so output is deterministic."""
+        with self._lock:
+            items = sorted(self._series.items())
+            out = []
+            for (name, labels), ring in items:
+                if names is not None and name not in names:
+                    continue
+                pts = [[t, v] for t, v in ring if start <= t <= end]
+                if pts:
+                    out.append({"name": name,
+                                "labels": [list(p) for p in labels],
+                                "points": pts})
+        return out
+
+
+# -- durable history ----------------------------------------------------------
+#
+# The in-memory TSDB dies with the aggregator: every windowed quantile,
+# goodput ratio and alert `for:` hold resets to "unknown" on a restart —
+# exactly when an operator is restarting things.  The history tier below
+# makes the ring durable with the WAL pattern from coord/wal.py: CRC'd
+# length-prefixed records appended to segment files, torn tails
+# truncated on load (a SIGKILL mid-append loses at most the last
+# record), old segments deleted by retention.  Two tiers:
+#
+# - ``raw/``    — every ingested scrape, kept for the TSDB's own
+#                 retention window; replayed into the ring on start so
+#                 windows are continuous across the restart;
+# - ``rollup/`` — one downsampled record (last value per series) every
+#                 ``EDL_TPU_OBS_HISTORY_ROLLUP`` seconds, kept for
+#                 ``EDL_TPU_OBS_HISTORY_RETENTION`` — the long tail
+#                 ``edl-obs-bundle --incident`` reassembles windows
+#                 from after the fact.  Last-value downsampling is
+#                 exact for cumulative counters and histogram buckets
+#                 (an increase between two rollup points equals the raw
+#                 increase), which is what every windowed read here is
+#                 built on.
+
+_REC_HEADER = struct.Struct(">II")  # payload length, crc32(payload)
+
+
+def _crc(payload: bytes) -> int:
+    return binascii.crc32(payload) & 0xFFFFFFFF
+
+
+class _SegmentLog:
+    """One append-only tier: ``seg-<start_ms>.log`` files of CRC'd
+    records under one directory.  A segment rotates on size or age;
+    whole segments expire by retention.  All writes are serialized
+    under one lock; reads open the files independently."""
+
+    def __init__(self, dir_path: str, retention_s: float, tier: str,
+                 max_segment_bytes: int = 4 << 20,
+                 max_segment_age_s: float | None = None):
+        self.dir = dir_path
+        self.retention_s = float(retention_s)
+        self.tier = tier
+        self.max_segment_bytes = int(max_segment_bytes)
+        self.max_segment_age_s = (max(60.0, self.retention_s / 8.0)
+                                  if max_segment_age_s is None
+                                  else float(max_segment_age_s))
+        self._lock = threading.Lock()
+        self._f = None
+        self._path: str | None = None
+        self._bytes = 0
+        self._opened_at = 0.0
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _segments(self) -> list[str]:
+        try:
+            names = [n for n in os.listdir(self.dir)
+                     if n.startswith("seg-") and n.endswith(".log")]
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in sorted(names)]
+
+    def _update_gauges_locked(self) -> None:
+        segs = self._segments()
+        total = 0
+        for p in segs:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        _HISTORY_SEGMENTS_G.labels(tier=self.tier).set(len(segs))
+        _HISTORY_BYTES_G.labels(tier=self.tier).set(total)
+
+    def _roll_locked(self, now: float) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+        self._path = os.path.join(self.dir, f"seg-{int(now * 1000):015d}.log")
+        self._f = open(self._path, "ab")
+        self._bytes = self._f.tell()
+        self._opened_at = now
+        # retention prune: a segment's name carries its FIRST record's
+        # ts and rotation bounds its span, so name-ts alone decides
+        cutoff = now - self.retention_s - self.max_segment_age_s
+        for p in self._segments():
+            try:
+                start_ms = int(os.path.basename(p)[4:-4])
+            except ValueError:
+                continue
+            if p != self._path and start_ms / 1000.0 < cutoff:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def append(self, rec: dict, now: float | None = None) -> bool:
+        """Append one record; best-effort (a full disk drops the
+        record, never raises — observability must not kill its host)."""
+        now = time.time() if now is None else now
+        payload = json.dumps(rec).encode("utf-8")
+        frame = _REC_HEADER.pack(len(payload), _crc(payload)) + payload
+        try:
+            # edl-lint: disable=blocking-under-lock — the tier's file
+            # lock: serializing the append + rotation is its purpose
+            with self._lock:
+                if (self._f is None or self._bytes + len(frame)
+                        > self.max_segment_bytes
+                        or now - self._opened_at > self.max_segment_age_s):
+                    self._roll_locked(now)
+                self._f.write(frame)
+                self._f.flush()
+                self._bytes += len(frame)
+                _HISTORY_RECORDS_TOTAL.labels(tier=self.tier).inc()
+                self._update_gauges_locked()
+            return True
+        except OSError:
+            logger.exception("history append failed (%s tier)", self.tier)
+            return False
+
+    def records(self) -> list[dict]:
+        """Every decodable record, oldest segment first.  A corrupt or
+        short record ends its segment's read; when the bad bytes are a
+        torn tail (everything after the last good record), the segment
+        is truncated back to clean state — the coord/wal.py replay
+        rule."""
+        out: list[dict] = []
+        with self._lock:
+            segs = self._segments()
+            open_path = self._path
+        for path in segs:
+            out.extend(self._read_segment(path, path != open_path))
+        return out
+
+    def _read_segment(self, path: str, may_truncate: bool) -> list[dict]:
+        recs: list[dict] = []
+        good_end = 0
+        torn = False
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return recs
+        off = 0
+        while off + _REC_HEADER.size <= len(data):
+            length, crc = _REC_HEADER.unpack_from(data, off)
+            start = off + _REC_HEADER.size
+            end = start + length
+            if end > len(data):
+                torn = True
+                break
+            payload = data[start:end]
+            if _crc(payload) != crc:
+                torn = True
+                break
+            try:
+                recs.append(json.loads(payload.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                torn = True
+                break
+            off = end
+            good_end = end
+        if off < len(data):
+            torn = True
+        if torn:
+            logger.warning("history segment %s: torn tail at byte %d "
+                           "(%d of %d bytes kept)", path, good_end,
+                           good_end, len(data))
+            _HISTORY_TRUNCATED_TOTAL.inc()
+            if may_truncate:
+                try:
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+                except OSError:
+                    logger.exception("history truncate failed for %s", path)
+        return recs
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+def _encode_scrape(parsed: dict, ts: float) -> dict:
+    return {"t": round(ts, 6),
+            "s": [[name, [list(p) for p in labels], value]
+                  for (name, labels), value in parsed.items()]}
+
+
+def _decode_scrape(rec: dict):
+    """(ts, parsed-dict) or None for a record this reader can't use."""
+    try:
+        ts = float(rec["t"])
+        parsed = {(str(name), tuple((str(k), str(v)) for k, v in labels)):
+                  float(value) for name, labels, value in rec["s"]}
+    except (KeyError, TypeError, ValueError):
+        return None
+    return ts, parsed
+
+
+class HistoryStore:
+    """Durable scrape history under ``EDL_TPU_OBS_HISTORY_DIR``: the
+    raw + rollup segment tiers, plus the atomically-written alert-state
+    snapshot that lets a restarted aggregator's rule engine keep its
+    pending ``for:`` holds instead of restarting them."""
+
+    def __init__(self, dir_path: str, retention_s: float | None = None,
+                 raw_retention_s: float = 600.0,
+                 rollup_s: float | None = None):
+        if retention_s is None:
+            try:
+                retention_s = float(os.environ.get(
+                    "EDL_TPU_OBS_HISTORY_RETENTION", 86400.0))
+            except ValueError:
+                retention_s = 86400.0
+        if rollup_s is None:
+            try:
+                rollup_s = float(os.environ.get(
+                    "EDL_TPU_OBS_HISTORY_ROLLUP", 60.0))
+            except ValueError:
+                rollup_s = 60.0
+        self.dir = dir_path
+        self.retention_s = float(retention_s)
+        self.raw_retention_s = float(raw_retention_s)
+        self.rollup_s = max(1.0, float(rollup_s))
+        self._raw = _SegmentLog(os.path.join(dir_path, "raw"),
+                                self.raw_retention_s, "raw")
+        self._rollup = _SegmentLog(os.path.join(dir_path, "rollup"),
+                                   self.retention_s, "rollup")
+        self._pending: dict = {}          # series seen since the last flush
+        self._last_flush = 0.0
+        self._state_path = os.path.join(dir_path, "alerts.json")
+
+    # -- writes --------------------------------------------------------------
+    def append(self, parsed: dict, ts: float) -> None:
+        """One scrape into the raw tier; every ``rollup_s`` the latest
+        value per live series is folded into the rollup tier."""
+        self._raw.append(_encode_scrape(parsed, ts), now=ts)
+        self._pending.update(parsed)
+        if self._last_flush == 0.0:
+            # seed the rollup tier with the very first scrape: counter
+            # increases over the long tail need the birth baseline after
+            # the raw tier has expired it
+            self._rollup.append(_encode_scrape(parsed, ts), now=ts)
+            self._pending = {}
+            self._last_flush = ts
+        elif ts - self._last_flush >= self.rollup_s:
+            self._rollup.append(_encode_scrape(self._pending, ts), now=ts)
+            self._pending = {}
+            self._last_flush = ts
+
+    def save_alert_state(self, snap: dict) -> None:
+        """Atomic (tmp + rename) alert-state snapshot — a SIGKILL can
+        never leave a half-written state file."""
+        tmp = self._state_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(snap))
+            os.replace(tmp, self._state_path)
+        except OSError:
+            logger.exception("alert-state snapshot failed")
+
+    # -- reads ---------------------------------------------------------------
+    def load_alert_state(self) -> dict | None:
+        try:
+            with open(self._state_path, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return snap if isinstance(snap, dict) else None
+
+    def replay(self, tsdb: TSDB, now: float | None = None) -> int:
+        """Re-ingest the raw tier (records inside the TSDB's retention
+        window) into ``tsdb``, oldest first; returns scrapes replayed.
+        This is the restart-continuity path: windowed quantiles, rates
+        and goodput pick up exactly where the dead aggregator left
+        off."""
+        now = time.time() if now is None else now
+        cutoff = now - tsdb.retention_s
+        rows = []
+        for rec in self._raw.records():
+            decoded = _decode_scrape(rec)
+            if decoded is not None and decoded[0] >= cutoff:
+                rows.append(decoded)
+        rows.sort(key=lambda r: r[0])
+        for ts, parsed in rows:
+            tsdb.ingest(parsed, ts)
+        return len(rows)
+
+    def read_window(self, start: float, end: float) -> list[dict]:
+        """Series points in ``[start, end]`` from BOTH tiers (raw where
+        it still exists, rollup for the long tail), merged and
+        deduplicated per series — the same shape as
+        :meth:`TSDB.dump_window`."""
+        series: dict = {}
+        for log in (self._rollup, self._raw):
+            for rec in log.records():
+                decoded = _decode_scrape(rec)
+                if decoded is None:
+                    continue
+                ts, parsed = decoded
+                if not start <= ts <= end:
+                    continue
+                for key, value in parsed.items():
+                    series.setdefault(key, {})[round(ts, 6)] = value
+        out = []
+        for (name, labels), pts in sorted(series.items()):
+            out.append({"name": name,
+                        "labels": [list(p) for p in labels],
+                        "points": [[t, v] for t, v in sorted(pts.items())]})
+        return out
+
+    def close(self) -> None:
+        self._raw.close()
+        self._rollup.close()

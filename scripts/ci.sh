@@ -137,6 +137,18 @@ JAX_PLATFORMS=cpu python scripts/profiling_smoke.py
 # alert -> action -> recovery handoff
 JAX_PLATFORMS=cpu python scripts/remediation_smoke.py
 
+# postmortem smoke: the black-box flight recorder + bundle loop — an
+# induced straggler must AUTOMATICALLY produce a self-contained bundle
+# (flight-recorder rings from >=2 processes, TSDB window, coord
+# dump_state, workerlog tail, incident record, all joined by the
+# generation trace_id on the edl-obs-dump --merge timeline); a
+# SIGKILLed aggregator restarted onto the same --history_dir must
+# answer windowed rates immediately, resume the goodput observation
+# window, and keep the straggler's original firing_since; and
+# edl-obs-bundle --incident must reassemble the bundle from the
+# durable pieces alone
+JAX_PLATFORMS=cpu python scripts/postmortem_smoke.py
+
 # distill chaos smoke: elastic distillation as a production workload
 # (ISSUE 18) — real teacher child processes advertised through the
 # serving table, a serving spike makes training yield a pod
@@ -208,6 +220,11 @@ assert out['delta_steps_lost_per_failure'] \
 # continuous profiling (ISSUE 13): the per-step phase ledger must cost
 # the hot loop under 2% of step time (measured directly, noise-immune)
 assert out['step_phase_overhead_pct'] < 2, out['step_phase_overhead_pct']
+# flight recorder (ISSUE 19): the always-on ring tap must cost the
+# step loop under 2% (per-event delta measured directly, noise-immune)
+# and a live bundle capture must complete and report its wall time
+assert out['flightrec_overhead_pct'] < 2, out['flightrec_overhead_pct']
+assert out.get('bundle_capture_seconds') is not None, out
 # paged KV cache (ISSUE 14): on the shared-system-prompt workload the
 # prefix-hit engine must not lose to cold prefill and must actually
 # skip most of the prompt; the drain handoff must yield a latency
@@ -231,13 +248,14 @@ edl-controller --help >/dev/null 2>&1 || { echo "edl-controller missing"; exit 1
 edl-obs-dump --help >/dev/null 2>&1 || { echo "edl-obs-dump missing"; exit 1; }
 edl-obs-agg --help >/dev/null 2>&1 || { echo "edl-obs-agg missing"; exit 1; }
 edl-obs-top --help >/dev/null 2>&1 || { echo "edl-obs-top missing"; exit 1; }
+edl-obs-bundle --help >/dev/null 2>&1 || { echo "edl-obs-bundle missing"; exit 1; }
 edl-gateway --help >/dev/null 2>&1 || { echo "edl-gateway missing"; exit 1; }
 edl-replica --help >/dev/null 2>&1 || { echo "edl-replica missing"; exit 1; }
 
 # doc drift: every CLI the operator guide teaches must exist
 for cmd in edl-coord edl-launch edl-controller edl-discovery edl-bench \
-           edl-obs-dump edl-obs-agg edl-obs-top edl-gateway edl-replica \
-           edl-lint; do
+           edl-obs-dump edl-obs-agg edl-obs-top edl-obs-bundle \
+           edl-gateway edl-replica edl-lint; do
     grep -q "$cmd" doc/usage.md || { echo "doc/usage.md missing $cmd"; exit 1; }
 done
 for f in examples/lm/serve_lm.py examples/collective/collector.py \

@@ -1,0 +1,285 @@
+"""CI smoke: the black-box flight recorder + postmortem bundle loop —
+an induced incident must automatically produce a self-contained
+archive, and a SIGKILLed aggregator must resume with its windowed
+history, goodput window and alert holds intact.
+
+Three "trainer" child processes (each: a /metrics endpoint with a live
+``edl_train_step_seconds`` histogram, a flight recorder serving
+``GET /flightrec``, and a TTL-leased coord advert) against an
+in-process coordination server.  A real ``edl-obs-agg`` SUBPROCESS
+(built-in ruleset, windows shrunk via ``EDL_TPU_ALERT_SCALE``) runs
+with ``--history_dir`` + ``EDL_TPU_OBS_BUNDLE_DIR`` +
+``EDL_TPU_REMEDIATE=1``:
+
+1. **automated bundle** — one child steps 5x slower than the fleet;
+   ``trainer-straggler`` fires, and its built-in ``bundle`` action
+   must land a postmortem archive: manifest stamped with the published
+   generation trace_id, flight-recorder rings from >=2 processes,
+   the TSDB window, the coord ``dump_state``, a workerlog tail, and
+   the triggering incident record — and ``edl-obs-dump``'s reader must
+   join the ring events + incident on that trace's timeline;
+2. **aggregator restart continuity** — the aggregator is SIGKILLed
+   and restarted onto the same ``--history_dir``; its first /healthz
+   must already answer windowed rates (replayed raw tier), the goodput
+   observation window must RESUME (observed_s keeps growing, not reset
+   to zero), and /alerts must still show the straggler FIRING with its
+   original ``firing_since`` — the hold survived the restart;
+3. **after-the-fact reassembly** — ``edl-obs-bundle --incident <id>``
+   rebuilds a bundle for the same incident from the durable pieces
+   alone (incident JSONL + history segments), no live fleet needed.
+
+Run by scripts/ci.sh:  JAX_PLATFORMS=cpu python scripts/postmortem_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_TRACE_DIR = os.environ.setdefault("EDL_TPU_TRACE_DIR",
+                                   tempfile.mkdtemp(prefix="edl-pm-"))
+os.environ.setdefault("EDL_TPU_METRICS_PORT", "0")
+os.environ.setdefault("EDL_TPU_ALERT_SCALE", "0.1")
+# short quantile window so windowed rates have coverage within the smoke
+os.environ.setdefault("EDL_TPU_OBS_QUANTILE_WINDOW", "20")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_CHILD = r"""
+import dataclasses, os, sys, time
+sys.path.insert(0, {repo!r})
+from edl_tpu.coord.client import CoordClient
+from edl_tpu.obs import advert, flightrec
+from edl_tpu.obs import context as obs_context
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.metrics import Registry
+
+coord_ep, job, step_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+reg = Registry()
+steps = reg.histogram("edl_train_step_seconds", "per-step wall time")
+srv = MetricsServer(reg, host="127.0.0.1").start()
+store = CoordClient(coord_ep)
+handle = advert.advertise_metrics(store, job, "trainer", srv.endpoint,
+                                  name=f"trainer-{{os.getpid()}}", ttl=60)
+# the black box: ring-only tracing (no tracer installed -> NullTracer),
+# events land in the flight recorder and are served on GET /flightrec
+flightrec.install("trainer")
+jt = advert.current_job_trace(store, job)
+ctx = dataclasses.replace(obs_context.new_trace(), trace_id=jt["trace_id"])
+print("trainer up", srv.endpoint, flush=True)
+i = 0
+with obs_context.use(ctx):
+    while True:
+        time.sleep(step_s)
+        steps.observe(step_s)
+        obs_trace.emit("train/step", step=i)
+        i += 1
+"""
+
+
+def _spawn_trainer(coord_ep, job, step_s):
+    env = dict(os.environ, EDL_TPU_METRICS_PORT="")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD.format(repo=_REPO),
+         coord_ep, job, str(step_s)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "trainer up" in line:
+            return proc, line.rsplit(" ", 1)[-1].strip()
+        if not line and proc.poll() is not None:
+            raise AssertionError("trainer child died before announcing")
+    raise AssertionError("trainer child never announced")
+
+
+def _spawn_agg(coord_ep, job, history_dir, env):
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "edl_tpu.obs.agg",
+         "--coord_endpoints", coord_ep, "--job_id", job,
+         "--host", "127.0.0.1", "--cache_s", "0",
+         "--scrape_interval", "0.25", "--history_dir", history_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "serving merged /metrics" in line:
+            return proc, line.rsplit(" ", 1)[-1].strip()
+        if not line and proc.poll() is not None:
+            raise AssertionError("aggregator died before announcing")
+    raise AssertionError("aggregator never announced its endpoint")
+
+
+def _get_json(url):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read().decode())
+
+
+def _wait(pred, deadline, what, every=0.2):
+    while time.time() < deadline:
+        got = pred()
+        if got is not None:
+            return got
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _find_bundle(bundle_dir):
+    for name in sorted(os.listdir(bundle_dir) if os.path.isdir(bundle_dir)
+                       else []):
+        mf = os.path.join(bundle_dir, name, "manifest.json")
+        if os.path.exists(mf):
+            with open(mf, encoding="utf-8") as f:
+                manifest = json.load(f)
+            manifest["path"] = os.path.join(bundle_dir, name)
+            return manifest
+    return None
+
+
+def main() -> None:
+    from edl_tpu import obs
+    from edl_tpu.coord.client import CoordClient
+    from edl_tpu.coord.server import start_server
+    from edl_tpu.obs import context as obs_context
+    from edl_tpu.obs import dump as obs_dump
+    from edl_tpu.obs import trace as obs_trace
+    from edl_tpu.obs.advert import advertise_installed, publish_job_trace
+
+    obs.install_from_env("parent")
+    coord = start_server("127.0.0.1", 0)
+    coord_ep = f"127.0.0.1:{coord.port}"
+    store = CoordClient(coord_ep)
+    job = "pmsmoke"
+
+    history = tempfile.mkdtemp(prefix="edl-pm-hist-")
+    bundles = tempfile.mkdtemp(prefix="edl-pm-bundles-")
+    # a workerlog for the bundler to tail (what the launcher leaves
+    # under EDL_TPU_LOG_DIR on a real pod)
+    log_dir = tempfile.mkdtemp(prefix="edl-pm-logs-")
+    os.makedirs(os.path.join(log_dir, "pod-smoke"))
+    with open(os.path.join(log_dir, "pod-smoke", "workerlog.0"), "w") as f:
+        f.writelines(f"step {i} ok\n" for i in range(200))
+
+    # the generation trace every piece of evidence must join
+    ctx = obs_context.new_trace(job=job)
+    publish_job_trace(store, job, ctx, stage="gen0")
+    with obs_context.use(ctx):
+        obs_trace.emit("smoke/generation", stage="gen0")
+    parent_reg = advertise_installed(store, job, "parent")
+    assert parent_reg is not None
+
+    agg_env = dict(os.environ,
+                   EDL_TPU_REMEDIATE="1",
+                   EDL_TPU_PROFILE_ON_ALERT="0",
+                   EDL_TPU_OBS_BUNDLE_DIR=bundles,
+                   EDL_TPU_LOG_DIR=log_dir,
+                   EDL_TPU_METRICS_PORT="")
+
+    children = [_spawn_trainer(coord_ep, job, s) for s in (0.05, 0.05, 0.25)]
+    agg = agg2 = None
+    try:
+        agg, agg_ep = _spawn_agg(coord_ep, job, history, agg_env)
+
+        # 1 -- straggler fires -> the bundle action freezes the evidence
+        t0 = time.time()
+        alert = _wait(
+            lambda: next((a for a in
+                          _get_json(f"http://{agg_ep}/alerts")["firing"]
+                          if a["alert"] == "trainer-straggler"), None),
+            t0 + 60.0, "trainer-straggler to fire")
+        firing_since = alert["firing_since"]
+        manifest = _wait(lambda: _find_bundle(bundles), time.time() + 30.0,
+                         "postmortem bundle to land")
+        assert manifest["rule"] == "trainer-straggler", manifest
+        assert manifest["trace_id"] == ctx.trace_id, \
+            f"bundle trace_id {manifest['trace_id']} != generation " \
+            f"trace {ctx.trace_id}"
+        assert manifest["flightrec_rings"] >= 2, manifest
+        members = set(manifest["members"])
+        for want in ("tsdb-window.json", "coord-state.json",
+                     "incidents-bundle-0.jsonl"):
+            assert want in members, (want, sorted(members))
+        assert any(m.startswith("workerlogs/") for m in members), \
+            f"no workerlog tail in bundle: {sorted(members)}"
+        # the rings replay as dump-mergeable trace files: child step
+        # events + the incident land on ONE causal timeline by trace_id
+        events, _skipped = obs_dump.read_trace_dir(manifest["path"])
+        tl = obs_dump.merge_timeline(events, ctx.trace_id)
+        names = {e["name"] for e in tl}
+        assert "train/step" in names, \
+            f"no flight-recorder step events on the timeline: {sorted(names)}"
+        assert "alert/trainer-straggler" in names, sorted(names)
+        print(f"smoke: bundle {manifest['id']} landed at "
+              f"{manifest['path']} ({len(members)} members, "
+              f"{manifest['flightrec_rings']} rings, "
+              f"{len(tl)} timeline events)")
+
+        # 2 -- SIGKILL the aggregator; the successor resumes the watch
+        pre = _wait(
+            lambda: (lambda h: h if h.get("rates", {})
+                     .get("train_steps_per_s") else None)(
+                _get_json(f"http://{agg_ep}/healthz")),
+            time.time() + 30.0, "windowed rates before the kill")
+        pre_observed = pre["goodput"]["observed_s"]
+        assert pre_observed > 0, pre
+        agg.send_signal(signal.SIGKILL)
+        agg.wait(timeout=30)
+        kill_ts = time.time()
+
+        agg2, agg2_ep = _spawn_agg(coord_ep, job, history, agg_env)
+        health = _wait(
+            lambda: (lambda h: h if h.get("rates", {})
+                     .get("train_steps_per_s") else None)(
+                _get_json(f"http://{agg2_ep}/healthz")),
+            time.time() + 20.0, "windowed rates after the restart")
+        # goodput RESUMED the dead aggregator's observation window:
+        # observed_s kept growing across the kill instead of resetting
+        assert health["goodput"]["observed_s"] >= pre_observed, \
+            (health["goodput"], pre_observed)
+        alerts2 = _get_json(f"http://{agg2_ep}/alerts")
+        survived = [a for a in alerts2["firing"]
+                    if a["alert"] == "trainer-straggler"]
+        assert survived, f"straggler hold lost in restart: {alerts2}"
+        assert abs(survived[0]["firing_since"] - firing_since) < 1.0, \
+            (survived[0]["firing_since"], firing_since)
+        assert survived[0]["firing_since"] < kill_ts
+        print(f"smoke: aggregator restart kept windowed rates "
+              f"({health['rates']}), goodput window "
+              f"({health['goodput']['observed_s']:.1f}s observed) and the "
+              f"straggler hold (firing since "
+              f"{kill_ts - firing_since:.1f}s before the kill)")
+
+        # 3 -- after-the-fact reassembly from the durable pieces alone
+        from edl_tpu.obs import bundle as obs_bundle
+        re_out = tempfile.mkdtemp(prefix="edl-pm-re-")
+        rc = obs_bundle.main([
+            "--incident", manifest["id"], "--out", re_out,
+            "--history_dir", history, "--trace_dir", _TRACE_DIR,
+            "--job_id", job])
+        assert rc == 0, f"edl-obs-bundle --incident exited {rc}"
+        re_manifest = _find_bundle(re_out)
+        assert re_manifest and re_manifest["source"] == "reassembled"
+        assert re_manifest["trace_id"] == ctx.trace_id
+        assert "tsdb-window.json" in re_manifest["members"]
+        print(f"smoke: edl-obs-bundle --incident {manifest['id']} "
+              f"reassembled {len(re_manifest['members'])} members "
+              f"from history alone")
+    finally:
+        for p in (agg, agg2):
+            if p is not None:
+                p.kill()
+        for proc, _ in children:
+            proc.kill()
+        parent_reg.stop()
+        store.close()
+        coord.stop()
+    print("postmortem smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,383 @@
+"""Black-box flight recorder + durable obs history + postmortem
+bundles: torn-tail truncation of history segments on reload, rollup
+downsampling that preserves windowed quantiles exactly, alert-hold
+continuity across an aggregator restart, flight-recorder ring eviction
+under pressure, partial bundles when a target is unreachable, incident
+log rotation, and the fleet watch doorbell."""
+
+import json
+import os
+import time
+
+from edl_tpu.obs import exposition
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import rules as obs_rules
+from edl_tpu.obs.agg import Aggregator
+from edl_tpu.obs.bundle import capture_bundle, find_incident
+from edl_tpu.obs.dump import read_trace_dir
+from edl_tpu.obs.exposition import MetricsServer
+from edl_tpu.obs.flightrec import FlightRecorder
+from edl_tpu.obs.metrics import Registry
+from edl_tpu.obs.rules import Rule, RuleEngine
+from edl_tpu.obs.tsdb import TSDB, HistoryStore, _SegmentLog
+
+
+# -- durable history: CRC'd segments + torn-tail truncation ------------------
+
+def test_segment_log_roundtrip_and_torn_tail_truncation(tmp_path):
+    d = str(tmp_path / "raw")
+    log = _SegmentLog(d, retention_s=600.0, tier="raw")
+    for i in range(5):
+        assert log.append({"i": i}, now=1000.0 + i)
+    log.close()
+
+    # SIGKILL mid-append: a torn half-record lands at the tail
+    segs = sorted(os.listdir(d))
+    assert len(segs) == 1
+    path = os.path.join(d, segs[0])
+    clean_size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\x30garbage-that-is-not-a-full-record")
+
+    reopened = _SegmentLog(d, retention_s=600.0, tier="raw")
+    recs = reopened.records()
+    assert [r["i"] for r in recs] == [0, 1, 2, 3, 4]
+    # the torn tail was truncated away: the file is byte-clean again
+    assert os.path.getsize(path) == clean_size
+    # a second read sees a clean segment (no re-truncation needed)
+    assert [r["i"] for r in reopened.records()] == [0, 1, 2, 3, 4]
+    reopened.close()
+
+
+def test_segment_log_corrupt_middle_stops_segment_read(tmp_path):
+    d = str(tmp_path / "raw")
+    log = _SegmentLog(d, retention_s=600.0, tier="raw")
+    for i in range(3):
+        log.append({"i": i}, now=1000.0 + i)
+    log.close()
+    path = os.path.join(d, sorted(os.listdir(d))[0])
+    data = bytearray(open(path, "rb").read())
+    data[12] ^= 0xFF                    # flip a byte inside record 0
+    open(path, "wb").write(bytes(data))
+    # everything from the corruption on is dropped — prefix integrity,
+    # never a garbage record
+    assert _SegmentLog(d, retention_s=600.0, tier="raw").records() == []
+
+
+def test_history_replay_restores_windowed_reads(tmp_path):
+    hs = HistoryStore(str(tmp_path), retention_s=86400.0,
+                      raw_retention_s=600.0, rollup_s=30.0)
+    t0 = time.time() - 100.0
+    for i in range(11):
+        hs.append({("edl_r_total", ()): float(i * 10)}, t0 + i * 10)
+    hs.close()
+
+    fresh = TSDB(retention_s=600.0)
+    n = HistoryStore(str(tmp_path)).replay(fresh)
+    assert n == 11
+    r = fresh.rate("edl_r_total", 100.0, now=t0 + 100.0)
+    assert abs(r[""] - 1.0) < 1e-6      # 10 per 10s, continuous
+
+
+def test_rollup_downsampling_preserves_windowed_quantiles(tmp_path):
+    """Last-value downsampling is EXACT for cumulative histogram
+    buckets: a quantile computed from the rollup tier's points equals
+    the raw-window quantile on rollup boundaries."""
+    hs = HistoryStore(str(tmp_path), retention_s=86400.0,
+                      raw_retention_s=600.0, rollup_s=30.0)
+    t0 = 1_700_000_000.0
+
+    def buckets_at(n_obs):
+        # observations alternate 0.05s and 0.4s: cumulative le-buckets
+        return {("edl_q_seconds_bucket", (("le", "0.1"),)):
+                    float((n_obs + 1) // 2),
+                ("edl_q_seconds_bucket", (("le", "0.5"),)): float(n_obs),
+                ("edl_q_seconds_bucket", (("le", "+Inf"),)): float(n_obs)}
+
+    for i in range(121):                        # one scrape/s for 2 min
+        hs.append(buckets_at(i), t0 + i)
+    hs.close()
+
+    raw = TSDB(retention_s=600.0)
+    for i in range(121):
+        raw.ingest(buckets_at(i), t0 + i)
+    down = TSDB(retention_s=600.0)
+    from edl_tpu.obs.tsdb import _decode_scrape
+    rollup_recs = [_decode_scrape(r)
+                   for r in _SegmentLog(str(tmp_path / "rollup"),
+                                        86400.0, "rollup").records()]
+    # birth-seed point + one flush per ~30s over 120s
+    assert 4 <= len(rollup_recs) <= 6
+    for ts, parsed in rollup_recs:
+        down.ingest(parsed, ts)
+
+    # window [t0, t0+120]: both ends are rollup points (the seed point
+    # carries the birth baseline), so the downsampled increase per
+    # cumulative bucket — and thus the quantile — is EXACT
+    for q in (0.5, 0.9, 0.99):
+        raw_q = raw.quantile_over_window("edl_q_seconds", q, 120.0,
+                                         now=t0 + 120.0)
+        down_q = down.quantile_over_window("edl_q_seconds", q, 120.0,
+                                           now=t0 + 120.0)
+        assert raw_q is not None
+        assert down_q == raw_q
+
+
+# -- alert-hold continuity across restart ------------------------------------
+
+def test_engine_state_survives_export_restore():
+    rule = Rule(name="hold", kind="gauge", metric="edl_hold_g", op=">", threshold=5.0,
+                window=60.0, for_s=30.0)
+    t = TSDB(retention_s=600.0)
+    eng = RuleEngine(t, [rule])
+    t.ingest({("edl_hold_g", ()): 9.0}, 1000.0)
+    assert eng.evaluate(now=1000.0) == []       # pending, not firing
+    snap = eng.export_state()
+
+    # restart: a NEW engine over a NEW tsdb, holds re-seeded
+    t2 = TSDB(retention_s=600.0)
+    eng2 = RuleEngine(t2, [rule])
+    assert eng2.restore_state(snap) == 1
+    t2.ingest({("edl_hold_g", ()): 9.0}, 1040.0)
+    fired = eng2.evaluate(now=1040.0)
+    assert [a["alert"] for a in fired] == ["hold"]
+    # the hold started BEFORE the restart — continuity, not a reset
+    assert fired[0]["pending_since"] == 1000.0
+
+    # a fresh engine WITHOUT the snapshot would still be pending
+    t3 = TSDB(retention_s=600.0)
+    eng3 = RuleEngine(t3, [rule])
+    t3.ingest({("edl_hold_g", ()): 9.0}, 1040.0)
+    assert eng3.evaluate(now=1040.0) == []
+
+
+def test_engine_restore_ignores_stale_and_unknown(monkeypatch):
+    rule = Rule(name="hold", kind="gauge", metric="edl_hold_g", op=">", threshold=5.0,
+                window=60.0, for_s=30.0)
+    eng = RuleEngine(TSDB(), [rule])
+    assert eng.restore_state(None) == 0
+    assert eng.restore_state({}) == 0
+    old = {"ts": time.time() - 3600.0,
+           "state": [["hold", "", 1.0, None, 9.0]]}
+    assert eng.restore_state(old) == 0          # stale snapshot
+    other = {"ts": time.time(),
+             "state": [["renamed-rule", "", 1.0, None, 9.0],
+                       ["hold", "", 1.0, None, 9.0]]}
+    assert eng.restore_state(other) == 1        # unknown rule dropped
+
+
+def test_aggregator_restart_replays_history_and_holds(tmp_path, memkv):
+    hist = str(tmp_path / "hist")
+    g = obs_metrics.gauge("edl_fr_restart_g", "restart-continuity probe")
+    g.set(9.0)
+    rule = Rule(name="fr-hold", kind="gauge", metric="edl_fr_restart_g", op=">",
+                threshold=5.0, window=120.0, for_s=3600.0)
+    agg = Aggregator(memkv, "job-fr", cache_s=0.0, scrape_interval=0,
+                     rules=[rule], incident_dir="", enable_actions=False,
+                     history_dir=hist)
+    t0 = time.time()
+    for i in range(4):
+        agg.scrape_once(now=t0 - 30.0 + i * 10.0)
+    agg.stop_loop()
+    assert agg.engine.to_json()["pending"], \
+        "hold should be pending before restart"
+
+    agg2 = Aggregator(memkv, "job-fr", cache_s=0.0, scrape_interval=0,
+                      rules=[rule], incident_dir="", enable_actions=False,
+                      history_dir=hist)
+    # windowed reads are continuous: the replayed TSDB already holds the
+    # pre-restart samples before any new scrape
+    assert agg2.tsdb.latest("edl_fr_restart_g")
+    pend = agg2.engine.to_json()["pending"]
+    assert [a["alert"] for a in pend] == ["fr-hold"]
+    assert abs(pend[0]["pending_since"] - (t0 - 30.0)) < 1e-6
+    # the goodput ledger resumed the SAME observation window: ~30s
+    # already watched, not a fresh t0
+    assert agg2.goodput.summary(t0)["observed_s"] >= 29.0
+    agg2.stop_loop()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flightrec_ring_evicts_oldest_under_pressure():
+    rec = FlightRecorder("test", capacity=16)
+    ev_evicted0 = rec._ev_evicted.value
+    for i in range(50):
+        rec.record_event({"ts": float(i), "name": f"e{i}"})
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 16
+    # oldest dropped, newest kept, order preserved
+    assert [e["name"] for e in snap["events"]] == [f"e{i}"
+                                                  for i in range(34, 50)]
+    assert rec._ev_evicted.value - ev_evicted0 == 34
+    assert snap["capacity"] == 16 and snap["pid"] == os.getpid()
+
+
+def test_flightrec_snapshot_logs_and_scrape_source():
+    import logging
+    rec = FlightRecorder("test", capacity=32)
+    lr = logging.LogRecord("edl_tpu.x", logging.WARNING, "f.py", 7,
+                           "boom %d", (3,), None)
+    rec.record_log(lr)
+    snap = rec.snapshot()
+    assert snap["logs"][0]["msg"] == "boom 3"
+    assert snap["logs"][0]["level"] == "WARNING"
+    # never scraped: metrics fall back to a live registry render
+    assert snap["metrics"]["source"] == "live"
+    rec.note_scrape("edl_fake_total 1\n")
+    snap = rec.snapshot(limit=5)
+    assert snap["metrics"]["source"] == "scrape"
+    assert snap["metrics"]["text"] == "edl_fake_total 1\n"
+
+
+def test_trace_tap_feeds_ring_through_null_tracer():
+    from edl_tpu.obs import trace as obs_trace
+    rec = FlightRecorder("test", capacity=8)
+    tracer = obs_trace.NullTracer()
+    tracer.emit("quiet/event", x=1)             # no tap: no record built
+    obs_trace.add_tap(rec.record_event)
+    try:
+        tracer.emit("ring/event", x=2)
+        with tracer.span("ring/span"):
+            pass
+    finally:
+        obs_trace.remove_tap(rec.record_event)
+    names = [e["name"] for e in rec.snapshot()["events"]]
+    assert names == ["ring/event", "ring/span"]
+    span = rec.snapshot()["events"][1]
+    assert "dur" in span                        # ring-only span measured
+
+
+# -- postmortem bundles ------------------------------------------------------
+
+def _serve_flightrec(rec):
+    srv = MetricsServer(Registry(), host="127.0.0.1").start()
+    exposition.register_route("/flightrec", rec.route)
+    return srv
+
+
+def test_bundle_partial_when_target_unreachable(tmp_path, memkv):
+    rec = FlightRecorder("trainer", capacity=32)
+    rec.record_event({"ts": 1.0, "name": "train/step", "trace_id": "tid-1"})
+    srv = _serve_flightrec(rec)
+    try:
+        targets = {
+            "live": {"endpoint": f"127.0.0.1:{srv.port}",
+                     "component": "trainer"},
+            "dead": {"endpoint": "127.0.0.1:9", "component": "trainer"},
+        }
+        incident = {"id": "abc123", "name": "alert/straggler",
+                    "trace_id": "tid-1", "ts": time.time()}
+        tsdb = TSDB(retention_s=600.0)
+        tsdb.ingest({("edl_b_g", ()): 1.0}, time.time())
+        manifest = capture_bundle(
+            memkv, "job-b", rule_name="straggler", incident=incident,
+            tsdb=tsdb, out_dir=str(tmp_path), timeout=1.0, targets=targets)
+    finally:
+        exposition._routes.pop("/flightrec", None)
+        srv.stop()
+
+    # one unreachable target makes the bundle PARTIAL, never a failure
+    assert manifest["outcome"] == "partial"
+    assert list(manifest["missing"]) == ["dead"]
+    assert manifest["flightrec_rings"] == 1
+    assert manifest["trace_id"] == "tid-1"
+    bdir = manifest["path"]
+    members = set(manifest["members"])
+    assert "tsdb-window.json" in members
+    assert "coord-state.json" in members        # MemoryKV.dump_state
+    assert "incidents-bundle-0.jsonl" in members
+    trace_members = [m for m in members if m.startswith("trace-trainer-")]
+    assert len(trace_members) == 1
+    # the ring replays as a dump-mergeable trace file joined by trace_id
+    events, _skipped = read_trace_dir(bdir)
+    assert any(e.get("trace_id") == "tid-1" and e["name"] == "train/step"
+               for e in events)
+    assert any(e["name"] == "alert/straggler" for e in events)
+    man = json.load(open(os.path.join(bdir, "manifest.json")))
+    assert man["id"] == "abc123"
+
+
+def test_bundle_reassembles_from_incident_and_history(tmp_path):
+    # durable pieces left behind by a dead aggregator
+    hist = HistoryStore(str(tmp_path / "hist"), retention_s=86400.0,
+                        rollup_s=30.0)
+    t0 = time.time()
+    hist.append({("edl_b2_g", ()): 7.0}, t0 - 5.0)
+    hist.close()
+    inc_dir = tmp_path / "incidents"
+    inc_dir.mkdir()
+    log = obs_rules.IncidentLog(str(inc_dir), "obs-agg", "job-b2")
+    rule = Rule(name="late", kind="gauge", metric="edl_b2_g", op=">", threshold=5.0,
+                window=60.0)
+    rec = log.write("firing", rule, "", 7.0, trace_id="tid-2")
+
+    found = find_incident(rec["id"], [str(inc_dir)])
+    assert found is not None and found["trace_id"] == "tid-2"
+    manifest = capture_bundle(
+        None, "job-b2", rule_name="late", incident=found,
+        history=HistoryStore(str(tmp_path / "hist")),
+        out_dir=str(tmp_path / "bundles"), targets={}, now=t0,
+        source="reassembled")
+    assert manifest["outcome"] == "ok" and manifest["source"] == "reassembled"
+    window = json.load(open(os.path.join(manifest["path"],
+                                         "tsdb-window.json")))
+    assert any(s["name"] == "edl_b2_g" for s in window["series"])
+
+
+# -- incident rotation + rotated files in the merge --------------------------
+
+def test_incident_log_rotates_and_dump_reads_rotated(tmp_path):
+    log = obs_rules.IncidentLog(str(tmp_path), "obs-agg", "job-r",
+                                max_bytes=600)
+    rule = Rule(name="noisy", kind="gauge", metric="edl_n_g", op=">", threshold=0.0,
+                window=60.0)
+    ids = [log.write("firing", rule, "", 1.0)["id"] for _ in range(12)]
+    rotated = [p for p in os.listdir(tmp_path) if p.endswith(".jsonl.1")]
+    assert len(rotated) == 1
+    live = [p for p in os.listdir(tmp_path)
+            if p.startswith("incidents-") and p.endswith(".jsonl")]
+    live_ids = {json.loads(ln)["id"]
+                for ln in open(os.path.join(tmp_path, live[0]))}
+    # the merge view reads live + rotated generations: the timeline
+    # holds strictly more than the live file alone
+    events, _ = read_trace_dir(str(tmp_path))
+    got = {e.get("id") for e in events}
+    assert ids[-1] in got
+    assert live_ids < got <= set(ids)
+    # --incident reassembly finds records in rotated generations too
+    assert find_incident(ids[-1], [str(tmp_path)]) is not None
+
+
+# -- fleet watch doorbell ----------------------------------------------------
+
+def test_fleet_view_watch_doorbell_and_poll_fallback(memkv, monkeypatch):
+    from edl_tpu.gateway import fleet
+    view = fleet.FleetView(memkv, "job-w", period=30.0)
+    try:
+        assert view._watch        # MemoryKV has wait(): doorbell mode
+        reg = fleet.advertise(memkv, "job-w", "r0",
+                              {"endpoint": "h:1"}, ttl=5)
+        # a 30s poll period would miss this for half a minute; the
+        # doorbell delivers it in well under a second
+        deadline = time.monotonic() + 5.0
+        while "r0" not in view.replicas():
+            assert time.monotonic() < deadline, "watch never woke the view"
+            time.sleep(0.02)
+        reg.stop()
+    finally:
+        view.stop()
+
+    monkeypatch.setenv("EDL_TPU_FLEET_WATCH", "0")
+    view2 = fleet.FleetView(memkv, "job-w", period=0.05)
+    try:
+        assert not view2._watch   # env kill-switch: plain polling
+        reg = fleet.advertise(memkv, "job-w", "r1",
+                              {"endpoint": "h:2"}, ttl=5)
+        deadline = time.monotonic() + 5.0
+        while "r1" not in view2.replicas():
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        reg.stop()
+    finally:
+        view2.stop()

@@ -355,8 +355,19 @@ def test_builtin_profile_actions_and_goodput_rule():
     assert "evict" in rules["trainer-straggler"].action_names()
     assert "profile" in rules["gateway-p99-slo"].action_names()
     assert "scale-out" in rules["gateway-p99-slo"].action_names()
+    # the postmortem bundle capture is prepended to EVERY builtin rule
+    # (evidence is frozen before restart/evict acts on it)
+    assert all(r.action_names()[0] == "bundle" for r in rules.values())
+    assert rules["trainer-hang"].action_names() == ["bundle", "restart"]
+    assert rules["gateway-reject-burn"].action_names() == ["bundle",
+                                                           "scale-out"]
+
+
+def test_builtin_bundle_action_strips_with_env(monkeypatch):
+    monkeypatch.setenv("EDL_TPU_OBS_BUNDLE", "0")
+    rules = {r.name: r for r in obs_rules.builtin_rules()}
     assert rules["trainer-hang"].action_names() == ["restart"]
-    assert rules["gateway-reject-burn"].action_names() == ["scale-out"]
+    assert all("bundle" not in r.action_names() for r in rules.values())
     gr = rules["goodput-regression"]
     assert gr.metric == "edl_goodput_ratio" and gr.op == "<"
 
