@@ -115,16 +115,27 @@ class TeacherReplica:
         _QUEUE_G.labels(job=self.job_id).set(depth)
         _ROWS_S_G.labels(job=self.job_id).set(
             float(stats.get("rows_per_s", 0.0)))
-        return {"endpoint": self.server.endpoint,
-                "service": self.service,
-                "service_class": DISTILL_SERVICE_CLASS,
-                "slots": self._slots,
-                "free_slots": max(0, self._slots - depth),
-                "queue_depth": depth,
-                "rows_per_s": float(stats.get("rows_per_s", 0.0)),
-                "rows": int(stats.get("rows", 0)),
-                "draining": False,
-                "ts": time.time()}
+        payload = {"endpoint": self.server.endpoint,
+                   "service": self.service,
+                   "service_class": DISTILL_SERVICE_CLASS,
+                   "slots": self._slots,
+                   "free_slots": max(0, self._slots - depth),
+                   "queue_depth": depth,
+                   "rows_per_s": float(stats.get("rows_per_s", 0.0)),
+                   "rows": int(stats.get("rows", 0)),
+                   "draining": False,
+                   "ts": time.time()}
+        # KV-aware LM teachers (ISSUE 20): a server whose extra_stats
+        # hook surfaces a paged engine's stats gets its cache warmth on
+        # the replica advert — operators and routers see how much of
+        # the shared distillation prompt the teacher reuses without an
+        # extra RPC (the same trick as the LM replica's advert)
+        for k in ("engine_kv_prefix_hits", "engine_kv_prefix_misses",
+                  "engine_kv_prefill_tokens_skipped",
+                  "engine_tokens_per_s"):
+            if k in stats:
+                payload[k] = stats[k]
+        return payload
 
     def _refresh_loop(self, period: float) -> None:
         while not self._halt.wait(period):

@@ -321,3 +321,33 @@ def jit_teacher(model_apply, variables, fetch_name: str = "logits",
         return {fetch_name: np.asarray(fwd(*args))}
 
     return predict
+
+
+def lm_teacher(engine, max_new: int = 8) -> Callable[[dict], dict]:
+    """Wrap a serving ``ContinuousBatcher`` into a teacher predict_fn:
+    feed ``{"ids": [B, L] int32, "lens": [B] int32}``, fetch
+    ``{"tokens": [B, max_new] int32}`` (rows right-padded with -1).
+
+    Rows fan out as individual engine submits and the engine's slot
+    scheduler recombines them on-device — so a PAGED engine turns the
+    shared system prompt every distillation batch carries into
+    warm-prefix admissions instead of B cold prefills (ISSUE 20 /
+    ROADMAP item 4: the KV-aware LM teacher).  Zero-length rows (the
+    server's bucket padding) cost one 1-token prompt each and are
+    sliced off server-side.
+
+    Pair with ``TeacherServer(..., extra_stats=lambda: {f"engine_{k}":
+    v for k, v in engine.stats().items()})`` so the KV hit rate rides
+    the teacher's advert (doc/serving.md "KV-aware LM teachers")."""
+    def predict(feed: dict) -> dict:
+        ids = np.asarray(feed["ids"], np.int32)
+        lens = np.asarray(feed["lens"], np.int32).reshape(-1)
+        futs = [engine.submit(row[:max(1, int(n))], max_new)
+                for row, n in zip(ids, lens)]
+        out = np.full((len(ids), max_new), -1, np.int32)
+        for i, f in enumerate(futs):
+            toks = np.asarray(f.result(), np.int32)[:max_new]
+            out[i, :len(toks)] = toks
+        return {"tokens": out}
+
+    return predict

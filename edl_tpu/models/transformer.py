@@ -86,6 +86,15 @@ class TransformerConfig:
     # cache ("cache" collection) instead of recomputing the prefix
     # (models/generate.py drives this)
     decode: bool = False
+    # multi-token decode calls (L > 1) write K/V at PER-EXAMPLE cache
+    # indices (an XLA scatter) instead of one batch-uniform
+    # dynamic_update_slice.  Off by default: prefill always writes from
+    # index 0 of a fresh cache, where the contiguous DUS is the faster
+    # path.  The serving engine's speculative-decode verify model flips
+    # this on — verified slots sit at heterogeneous positions
+    # (serving/engine.py) — with out-of-bounds rows DROPPED, never
+    # clamped (a clamp would smear the last position over live state).
+    decode_scatter: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -197,10 +206,14 @@ class Block(nn.Module):
         contract continuous batching needs (serving/engine.py): each
         slot advances independently, and the mask is computed per
         example.  Single-token steps (L == 1) scatter each example's
-        new k/v at its own index; multi-token calls (prefill) require a
-        UNIFORM index across the batch (they dynamic-update one
-        contiguous slab) — generate()/the engine always prefill from a
-        fresh cache at index 0, which satisfies this.
+        new k/v at its own index.  Multi-token calls default to one
+        contiguous dynamic-update slab, which requires a UNIFORM index
+        across the batch — generate()/the engine always prefill from a
+        fresh cache at index 0, which satisfies this.  With
+        ``cfg.decode_scatter`` multi-token calls instead scatter each
+        example's L new entries at ITS OWN index (speculative-decode
+        verify: every slot checks k+1 candidates from a different
+        position), dropping out-of-bounds rows.
 
         Cache layouts match the two attention matmuls exactly — keys
         ``[B, Hk, D, max_len]`` (contraction over D, time on the lane
@@ -232,6 +245,21 @@ class Block(nn.Module):
                 k[:, 0].astype(cfg.dtype))
             cv.value = cv.value.at[jnp.arange(B), :, idx, :].set(
                 v[:, 0].astype(cfg.dtype))
+        elif cfg.decode_scatter:
+            # per-example multi-token scatter: each example's L new
+            # entries land at ITS OWN index (spec-decode verify feeds
+            # k+1 candidates per slot at heterogeneous positions).
+            # Advanced indices sit at non-adjacent dims, so the update
+            # operand's dims come to the front — [B, L, Hk, Dh], which
+            # is exactly k/v's layout.  mode="drop": a lane
+            # speculating past the cache tail must not write at all
+            # (clamping would overwrite the final live position).
+            pos = idx[:, None] + jnp.arange(L)            # [B, L]
+            bi = jnp.arange(B)[:, None]
+            ck.value = ck.value.at[bi, :, :, pos].set(
+                k.astype(cfg.dtype), mode="drop")
+            cv.value = cv.value.at[bi, :, pos, :].set(
+                v.astype(cfg.dtype), mode="drop")
         else:
             # contiguous slab at a batch-uniform index (see docstring)
             ck.value = jax.lax.dynamic_update_slice(

@@ -21,6 +21,18 @@ pool of ``slots`` decode lanes over ONE persistent KV cache:
   ``steps_per_sync`` tokens every tick no matter how fast requests
   arrive; ``stats()['prefill_stall_s']`` bounds the decode wall-time
   cost of prefill dispatches;
+- **chunked prefill** (``prefill_chunk``, ISSUE 20): an admission whose
+  prompt exceeds the chunk size prefills into a private one-lane slab
+  ONE chunk per tick, interleaved with the decode dispatches, so an
+  8k-token prompt costs live lanes one chunk of stall per tick instead
+  of one monolithic prefill — the final chunk rides the shared
+  insert/finish path like any other admission;
+- **speculative decoding** (``spec_k`` + a draft model, ISSUE 20): each
+  tick runs draft-k/verify-once rounds — the draft proposes k tokens
+  per slot, the target checks all k+1 positions in ONE multi-token
+  pass, and greedy acceptance (token == the target's argmax) keeps the
+  emitted stream bit-identical to plain decode while consuming up to
+  k+1 tokens per target dispatch;
 - a finished slot (token budget or ``eos_id``) frees immediately and
   the next queued request takes it — no convoy behind the longest
   generation in a batch.
@@ -83,6 +95,19 @@ class _Request:
         self.t_submit = time.monotonic()
 
 
+@dataclass
+class _ChunkState:
+    """One chunked admission in flight: the request holds a claimed
+    slot while its prompt prefills into a private one-lane slab, one
+    chunk per tick (``ContinuousBatcher._advance_chunk``)."""
+
+    req: "_Request"
+    slot: int
+    slab: object          # one-lane decode cache, index == offset
+    offset: int           # prompt tokens already prefilled
+    drops: object         # device MoE-drop accumulator (traced through)
+
+
 class _Task:
     """A closure the ENGINE THREAD runs between ticks (single-writer
     device mutations from other threads — e.g. a migrated-session KV
@@ -115,7 +140,16 @@ class ContinuousBatcher:
     /root/reference/README.md:51-64).  The slot logic stays host-side
     and unchanged; XLA inserts the tp collectives from the shardings.
     Tokens match the unsharded engine exactly (greedy parity tested on
-    a tp=2 mesh).
+    a tp=2 mesh).  Mesh engines page too (ISSUE 20): the block pool
+    shards over the same ``tp`` axis as the slot slabs with one
+    host-side trie over all shards (kv_cache.PagedKVCache).
+
+    ``prefill_chunk`` / ``spec_k`` are the serving fast-path knobs
+    (module docstring); ``spec_k > 0`` needs ``draft_cfg`` +
+    ``draft_params`` (a smaller model over the SAME vocabulary) and a
+    greedy engine (``temperature <= 0``) — acceptance compares the
+    draft against the target's argmax, which is what makes the output
+    provably identical to plain decode.
     """
 
     def __init__(self, cfg: TransformerConfig, params, *, slots: int = 8,
@@ -126,7 +160,11 @@ class ContinuousBatcher:
                  steps_per_sync: int = 8, rng_seed: int = 20_26,
                  mesh=None, rules=None, kv_block: int = 0,
                  kv_pool_blocks: int = 0, prefix_reuse: bool = True,
-                 kv_max_sessions: int | None = None):
+                 kv_max_sessions: int | None = None,
+                 prefill_chunk: int | None = None,
+                 spec_k: int | None = None,
+                 draft_cfg: TransformerConfig | None = None,
+                 draft_params=None):
         cache_len = max_len or cfg.max_len
         self.cfg = cfg
         self._dcfg = dataclasses.replace(
@@ -176,28 +214,31 @@ class ContinuousBatcher:
         # pool, no index, no extra dispatches); with a block size, every
         # finished request's full KV blocks persist in the pool and an
         # admission whose prompt extends a committed chain prefills only
-        # the suffix.  Mesh engines stay unpaged for now: the pool
-        # scatter/gather would need the tp sharding propagated through
-        # two more jit families for a path the sharded cache already
-        # dominates with HBM, not prefill compute.
+        # the suffix.  On a mesh the pool shards with the slot slabs
+        # (same tp axis, one host trie over all shards) — the pool jits
+        # are shard_map'd inside PagedKVCache, so paging costs a mesh
+        # engine no collectives.
         self._kv = None
         self._reuse = bool(prefix_reuse)
         if kv_block > 0:
-            if mesh is not None:
-                raise ValueError(
-                    "paged KV cache is not supported on a mesh engine "
-                    "yet; construct with kv_block=0")
             from edl_tpu.serving.kv_cache import PagedKVCache
             blocks_per_slot = max(1, cache_len // kv_block)
             pool_blocks = kv_pool_blocks or (2 * slots * blocks_per_slot + 1)
             self._kv = PagedKVCache(
                 self._cache_shapes(1), kv_block, pool_blocks,
                 constants.KV_SESSIONS if kv_max_sessions is None
-                else kv_max_sessions)
+                else kv_max_sessions, mesh=mesh)
         self._kv_hits = 0
         self._kv_misses = 0
         self._prefill_tokens = 0
         self._prefill_tokens_skipped = 0
+        # -- chunked prefill (long admissions interleave with decode) --
+        chunk = (constants.PREFILL_CHUNK if prefill_chunk is None
+                 else prefill_chunk)
+        self._chunk_tokens = max(0, int(chunk))
+        self._chunking: "_ChunkState | None" = None
+        self._prefill_chunks = 0
+        self._chunked_admissions = 0
         self._tasks: "deque[_Task]" = deque()
         self._queue: queue.Queue[_Request | _Task | None] = queue.Queue()
         self._stopping = False
@@ -233,6 +274,69 @@ class ContinuousBatcher:
         else:
             self._step_jit = jax.jit(self._step_impl, donate_argnums=(0,))
             self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        # -- speculative decoding (draft-k / verify-once rounds) --
+        self._spec_k = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rounds_run = 0
+        self._draft_cache = None
+        k = constants.SPEC_K if spec_k is None else int(spec_k)
+        if k > 0:
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "spec_k > 0 requires draft_cfg + draft_params (a "
+                    "smaller model over the same vocabulary)")
+            if temperature > 0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (temperature "
+                    "<= 0): acceptance compares the draft against the "
+                    "target's argmax, which is what keeps the output "
+                    "bit-identical to plain decode")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}")
+            self._spec_k = k
+            # rounds per tick sized so a tick still consumes about
+            # steps_per_sync tokens at full acceptance
+            self._spec_rounds = max(1, self._T // (k + 1))
+            self._draft_dcfg = dataclasses.replace(
+                draft_cfg, decode=True, attention_impl="dense", mesh=None,
+                max_len=cache_len)
+            self._draft_model = TransformerLM(self._draft_dcfg)
+            dsplit = _split_layer_params(draft_params, draft_cfg.num_layers)
+            if mesh is not None:
+                # the draft is small by contract: replicate it (and its
+                # cache) rather than threading a second sharding family
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(mesh, PartitionSpec())
+                dsplit = jax.device_put(
+                    dsplit, jax.tree.map(lambda _: rep, dsplit))
+            self._draft_params = dsplit
+            self._draft_cache = self._draft_fresh_cache(slots)
+            # the verify model shares the target's params and cache
+            # layout but scatters multi-token writes at PER-EXAMPLE
+            # indices — each slot verifies its k+1 candidates from its
+            # own position (transformer.TransformerConfig.decode_scatter)
+            self._vmodel = TransformerLM(dataclasses.replace(
+                self._dcfg, decode_scatter=True))
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(mesh, PartitionSpec())
+                dsh = jax.tree.map(lambda _: rep,
+                                   self._draft_cache_shapes(slots))
+                sh = self._pool_cache_shardings()
+                self._spec_jit = jax.jit(
+                    self._spec_impl, donate_argnums=(0, 1),
+                    out_shardings=(sh, dsh, rep, rep))
+                self._draft_insert_jit = jax.jit(
+                    self._insert_impl, donate_argnums=(0,),
+                    out_shardings=dsh)
+            else:
+                self._spec_jit = jax.jit(self._spec_impl,
+                                         donate_argnums=(0, 1))
+                self._draft_insert_jit = jax.jit(self._insert_impl,
+                                                 donate_argnums=(0,))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._thread.start()
@@ -364,6 +468,37 @@ class ContinuousBatcher:
             jax.block_until_ready(toks)
         self._step_jit.lower(self._cache, jnp.asarray(self._toks), key,
                              self._params).compile()
+        if self._chunk_tokens and prompt_len > self._chunk_tokens:
+            # chunk ladder: the mid-chunk body plus the final suffix
+            # bucket this prompt class lands on (same fit guard as
+            # _maybe_start_chunk — an unfittable split falls back to
+            # the monolithic prefill warmed above)
+            C = self._chunk_tokens
+            off = C * ((prompt_len - 1) // C)
+            if off + self._bucket(prompt_len - off) <= self._dcfg.max_len:
+                slab = self._fresh_cache(1)
+                slab, drops = self._chunk_mid_fn(C)(
+                    self._params, slab, jnp.zeros((1, C), jnp.int32),
+                    jnp.zeros((), jnp.int32))
+                Pf = self._bucket(prompt_len - off)
+                slab, toks, _ = self._chunk_final_fn(Pf)(
+                    self._params, slab, jnp.zeros((1, Pf), jnp.int32),
+                    jnp.ones((1,), jnp.int32), drops, key)
+                jax.block_until_ready(toks)
+        if self._spec_k:
+            for K in self.PREFILL_KS:
+                dslab = self._draft_prefill_fn(P, K)(
+                    self._draft_params, jnp.zeros((K, P), jnp.int32),
+                    jnp.ones((K,), jnp.int32))
+                self._draft_insert_jit.lower(
+                    self._draft_cache, dslab,
+                    jnp.zeros((K,), jnp.int32),
+                    jnp.ones((K,), jnp.int32)).compile()
+                jax.block_until_ready(jax.tree.leaves(dslab)[0])
+            # lower+compile only: executing would donate the live caches
+            self._spec_jit.lower(self._cache, self._draft_cache,
+                                 jnp.asarray(self._toks), self._params,
+                                 self._draft_params).compile()
         if self._kv is not None and self._reuse:
             # the reuse-prefill family too — the first prefix hit per
             # (suffix bucket, padded chain depth) must not compile on
@@ -418,8 +553,28 @@ class ContinuousBatcher:
                 "max_prompt_len": self._dcfg.max_len - 1,
                 "uptime_s": round(dt, 3),
                 "draining": self._draining,
+                # chunked prefill: dispatch/admission counters (0s when
+                # off or no prompt ever exceeded the chunk size)
+                "prefill_chunk": self._chunk_tokens,
+                "prefill_chunks": self._prefill_chunks,
+                "chunked_admissions": self._chunked_admissions,
                 **self._kv_stats(),
+                **self._spec_stats(),
             }
+
+    def _spec_stats(self) -> dict:
+        """Speculative-decode counters (empty when spec is off, so
+        stats() consumers see the plain shape unchanged)."""
+        if not self._spec_k:
+            return {}
+        prop = max(1, self._spec_proposed)
+        return {
+            "spec_k": self._spec_k,
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
+            "spec_accept_rate": round(self._spec_accepted / prop, 3),
+            "spec_rounds": self._spec_rounds_run,
+        }
 
     def _kv_stats(self) -> dict:
         """Paged-KV counters (empty when paging is off, so stats()
@@ -477,6 +632,10 @@ class ContinuousBatcher:
                 s.request.future.set_exception(
                     RuntimeError("engine stopped mid-generation"))
                 s.request = None
+        if self._chunking is not None:     # mid-chunk admission in flight
+            self._chunking.req.future.set_exception(
+                RuntimeError("engine stopped mid-prefill"))
+            self._chunking = None
         while self._pending:      # engine thread joined: safe to touch
             self._pending.popleft().future.set_exception(
                 RuntimeError("engine stopped"))
@@ -615,10 +774,194 @@ class ContinuousBatcher:
                 return leaf
         raise AssertionError("no cache_index leaf found")
 
+    # -- speculative decoding ------------------------------------------------
+    def _draft_cache_shapes(self, B: int):
+        return jax.eval_shape(
+            lambda: self._draft_model.init(
+                jax.random.key(0), jnp.zeros((B, 1), jnp.int32),
+                positions=jnp.zeros((B, 1), jnp.int32)))["cache"]
+
+    def _draft_fresh_cache(self, B: int):
+        shapes = self._draft_cache_shapes(B)
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            zeros = jax.device_put(zeros,
+                                   jax.tree.map(lambda _: rep, shapes))
+        return zeros
+
+    def _draft_prefill_fn(self, P: int, K: int):
+        """Compiled per (bucket, sub-batch): the draft's prompt prefill
+        beside every target admission — same padded ids/lens, no
+        sampling (the draft only ever continues from the target's last
+        token)."""
+        cached = self._prefill_cache.get(("draft", P, K))
+        if cached is not None:
+            return cached
+        draft = self._draft_model
+
+        def dpre(params, ids, true_lens):
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    lambda: draft.init(
+                        jax.random.key(0), jnp.zeros((K, 1), jnp.int32),
+                        positions=jnp.zeros((K, 1), jnp.int32)))["cache"])
+            _, mut = draft.apply(
+                {"params": params, "cache": cache}, ids,
+                positions=jnp.broadcast_to(jnp.arange(ids.shape[1]),
+                                           ids.shape),
+                token_mask=jnp.arange(ids.shape[1])[None, :]
+                < true_lens[:, None],
+                mutable=["cache"])
+            return mut["cache"]
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            fn = jax.jit(dpre, out_shardings=jax.tree.map(
+                lambda _: rep, self._draft_cache_shapes(K)))
+        else:
+            fn = jax.jit(dpre)
+        self._prefill_cache[("draft", P, K)] = fn
+        return fn
+
+    def _draft_slab_for(self, req: "_Request"):
+        """One-lane draft prefill from the FULL prompt — used by the
+        reuse and chunked admission paths, which never fed the draft.
+        The draft has no pool and no chunking on purpose: it is small
+        by contract, and its state only moves the ACCEPT RATE, never
+        correctness (greedy acceptance re-checks every token)."""
+        P = self._bucket(len(req.ids))
+        ids = np.zeros((1, P), np.int32)
+        ids[0, :len(req.ids)] = req.ids
+        return self._draft_prefill_fn(P, 1)(
+            self._draft_params, jnp.asarray(ids),
+            jnp.asarray([len(req.ids)], jnp.int32))
+
+    def _spec_impl(self, cache, draft_cache, toks, params, draft_params):
+        """``self._spec_rounds`` draft-k/verify-once rounds for every
+        slot in ONE dispatch.  Per round: sync the draft to the
+        target's frontier, scan k greedy draft steps, feed the last
+        token + the k drafts through the VERIFY model (multi-token,
+        per-example positions), and accept the longest prefix where
+        draft == the target's argmax, plus the target's own next token
+        (the "bonus") — so every consumed token IS the plain-greedy
+        token, by induction over positions.  Rejection costs nothing to
+        correctness: both caches' indices rewind to the accepted
+        frontier, and the stale K/V beyond it is overwritten by the
+        next round's k+1 writes before any mask can reach it (the same
+        invariant padded prefill relies on).  Writes past the cache end
+        are DROPPED (decode_scatter), and the host consumes at most
+        ``remaining`` tokens, so overhang is dead weight, not state.
+
+        Returns ``(cache, draft_cache, out [R, slots, k+1],
+        counts [R, slots])`` — per round, ``counts`` tokens of ``out``
+        are consumable per slot."""
+        k = self._spec_k
+        B = len(self._slots)
+        draft, vmodel = self._draft_model, self._vmodel
+
+        def set_index(c, new_idx):
+            return jax.tree.map(
+                lambda leaf: new_idx if leaf.ndim == 1 else leaf, c)
+
+        def dstep(carry, _):
+            dcache, tok = carry
+            logits, mut = draft.apply(
+                {"params": draft_params, "cache": dcache}, tok[:, None],
+                positions=self._positions(dcache)[:, None],
+                mutable=["cache"])
+            nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+            return (mut["cache"], nxt), nxt
+
+        def one_round(carry, _):
+            cache, dcache, toks = carry
+            idx = self._positions(cache)
+            # the draft rides the target's frontier exactly: same last
+            # token, same index (this also rewinds the draft's own
+            # stale tail from the previous round)
+            (dcache, last), drafts = jax.lax.scan(
+                dstep, (set_index(dcache, idx), toks), None, length=k)
+            # write the LAST draft token's KV too (its logits are dead
+            # weight): at full acceptance the next round's frontier
+            # sits right after it — without this write a perfect draft
+            # attends to a hole and rejects its own continuation every
+            # other round.  On partial acceptance the row is stale and
+            # the usual rewind-overwrite invariant disposes of it.
+            (dcache, _), _ = dstep((dcache, last), None)
+            drafts = drafts.T                                   # [B, k]
+            feed = jnp.concatenate([toks[:, None], drafts], axis=1)
+            pos = idx[:, None] + jnp.arange(k + 1)[None, :]
+            logits, mut = vmodel.apply(
+                {"params": params, "cache": cache}, feed,
+                positions=pos, mutable=["cache"])
+            greedy = logits.argmax(-1).astype(jnp.int32)        # [B, k+1]
+            match = (greedy[:, :k] == drafts).astype(jnp.int32)
+            n_acc = jnp.cumprod(match, axis=1).sum(axis=1)      # [B]
+            bonus = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)
+            j = jnp.arange(k + 1)[None, :]
+            dpad = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            out = jnp.where(j < n_acc[:, None], dpad,
+                            jnp.where(j == n_acc[:, None], bonus, 0))
+            new_idx = idx + n_acc + 1
+            return (set_index(mut["cache"], new_idx),
+                    set_index(dcache, new_idx),
+                    bonus[:, 0]), (out, n_acc + 1)
+
+        (cache, draft_cache, _), (outs, counts) = jax.lax.scan(
+            one_round, (cache, draft_cache, toks), None,
+            length=self._spec_rounds)
+        return cache, draft_cache, outs, counts
+
+    def _finish_spec(self, toks: np.ndarray, counts: np.ndarray,
+                     n_active: int) -> None:
+        """Consume one speculative chunk: ``toks [R, slots, k+1]`` with
+        ``counts[r, i]`` consumable tokens per round.  Same contract as
+        :meth:`_finish_decode` (runs before this tick's prefill
+        finishes), just ragged per round."""
+        R = toks.shape[0]
+        lane_tokens = R * (self._spec_k + 1)
+        with self._stats_lock:
+            self._lane_steps += len(self._slots) * lane_tokens
+            self._active_lane_steps += n_active * lane_tokens
+            self._spec_rounds_run += R
+        for i, s in enumerate(self._slots):
+            if s.free:
+                continue
+            with self._stats_lock:
+                # device-side acceptance for the rate gauge: counts - 1
+                # accepted drafts out of k proposed, per round
+                self._spec_proposed += R * self._spec_k
+                self._spec_accepted += int(counts[:, i].sum()) - R
+            done = False
+            for r in range(R):
+                for t in range(int(counts[r, i])):
+                    if s.remaining <= 0:
+                        done = True
+                        break
+                    tok = int(toks[r, i, t])
+                    s.emitted.append(tok)
+                    s.remaining -= 1
+                    if tok == self._eos or s.remaining == 0:
+                        self._finish(i)
+                        done = True
+                        break
+                if done:
+                    break
+            else:
+                self._toks[i] = int(
+                    toks[R - 1, i, int(counts[R - 1, i]) - 1])
+
     # -- the loop ------------------------------------------------------------
     def _loop(self) -> None:
         while True:
-            self._drain(block=not self._any_active())
+            # a mid-chunk admission is live work even with no active
+            # slots and an empty queue — never block on the queue then
+            self._drain(block=not self._any_active()
+                        and self._chunking is None)
             if self._stopping:
                 return  # stop() fails active slots + pending
             try:
@@ -664,6 +1007,8 @@ class ContinuousBatcher:
         pres: list[tuple] = []
         t0 = time.monotonic()
         taken: set[int] = set()       # slots claimed by THIS tick's admissions
+        if self._chunking is not None:
+            taken.add(self._chunking.slot)
         while True:
             # drain consecutive front-of-queue prefix hits first — each
             # is a cheap one-lane suffix prefill, and a shared-prefix
@@ -676,11 +1021,22 @@ class ContinuousBatcher:
             if pre is not None:
                 taken.add(reuse[0])
                 pres.append(pre)
-        group = self._next_group(taken)
-        if group is not None:
-            pre = self._dispatch_prefill(*group)
+        # long-prompt path: at most one chunked admission in flight; it
+        # advances ONE chunk per tick (the final chunk lands in pres and
+        # rides the shared insert/finish path), displacing this tick's
+        # cold-group slot in the dispatch budget
+        if self._chunking is None:
+            self._maybe_start_chunk(taken)
+        if self._chunking is not None:
+            pre = self._advance_chunk()
             if pre is not None:
                 pres.append(pre)
+        else:
+            group = self._next_group(taken)
+            if group is not None:
+                pre = self._dispatch_prefill(*group)
+                if pre is not None:
+                    pres.append(pre)
         if pres and active:
             with self._stats_lock:
                 self._prefill_stall_s += time.monotonic() - t0
@@ -690,16 +1046,31 @@ class ContinuousBatcher:
         # requests, so fail the admitted futures before re-raising
         try:
             dec = None
+            counts = None
             if active:
-                self._rng, key = jax.random.split(self._rng)
-                self._cache, dec = self._step_jit(
-                    self._cache, jnp.asarray(self._toks), key, self._params)
-            for slab, _, _, slots, _, lens in pres:
+                if self._spec_k:
+                    (self._cache, self._draft_cache, dec,
+                     counts) = self._spec_jit(
+                        self._cache, self._draft_cache,
+                        jnp.asarray(self._toks), self._params,
+                        self._draft_params)
+                else:
+                    self._rng, key = jax.random.split(self._rng)
+                    self._cache, dec = self._step_jit(
+                        self._cache, jnp.asarray(self._toks), key,
+                        self._params)
+            for slab, _, _, slots, _, lens, dslab in pres:
                 self._cache = self._insert_jit(
                     self._cache, slab, jnp.asarray(slots, jnp.int32),
                     jnp.asarray(lens, jnp.int32))
+                if dslab is not None:
+                    self._draft_cache = self._draft_insert_jit(
+                        self._draft_cache, dslab,
+                        jnp.asarray(slots, jnp.int32),
+                        jnp.asarray(lens, jnp.int32))
             # single sync point for decode + every admission
             dec_np = np.asarray(dec) if dec is not None else None
+            counts_np = np.asarray(counts) if counts is not None else None
             fins = [(p[3], p[4], np.asarray(p[1]), int(np.asarray(p[2])))
                     for p in pres]
         except Exception as e:  # noqa: BLE001
@@ -710,7 +1081,10 @@ class ContinuousBatcher:
                 self._failed_requests += sum(len(p[4]) for p in pres)
             raise
         if dec_np is not None:
-            self._finish_decode(dec_np, len(active))
+            if counts_np is not None:
+                self._finish_spec(dec_np, counts_np, len(active))
+            else:
+                self._finish_decode(dec_np, len(active))
         for slots, reqs, ptoks_np, drops in fins:
             self._finish_prefill(slots, reqs, ptoks_np, drops)
 
@@ -721,6 +1095,10 @@ class ContinuousBatcher:
                 s.request.future.set_exception(e)
                 s.request = None
                 n += 1
+        if self._chunking is not None:
+            self._chunking.req.future.set_exception(e)
+            self._chunking = None
+            n += 1
         with self._stats_lock:
             self._failed_requests += n
 
@@ -777,7 +1155,10 @@ class ContinuousBatcher:
             self._rng, key = jax.random.split(self._rng)
             slab, toks, drops = self._prefill_fn(P, K)(
                 self._params, jnp.asarray(ids), jnp.asarray(lens), key)
-            return slab, toks, drops, slots, reqs, lens
+            dslab = (self._draft_prefill_fn(P, K)(
+                self._draft_params, jnp.asarray(ids), jnp.asarray(lens))
+                if self._spec_k else None)
+            return slab, toks, drops, slots, reqs, lens, dslab
         except Exception as e:  # noqa: BLE001 — fail THIS group only
             logger.exception("prefill failed (bucket %d, %d reqs)", P, K)
             for req in reqs:
@@ -785,6 +1166,148 @@ class ContinuousBatcher:
             with self._stats_lock:
                 self._failed_requests += len(reqs)
             return None
+
+    # -- chunked prefill (long admissions) -----------------------------------
+    def _maybe_start_chunk(self, taken: set) -> None:
+        """Claim the front pending request as a CHUNKED admission when
+        its prompt exceeds the chunk size: the prompt prefills
+        ``prefill_chunk`` tokens per tick into a private one-lane slab,
+        interleaved with every decode dispatch, so a long admission
+        costs live lanes one chunk of stall per tick instead of one
+        monolithic prefill (doc/serving.md "Chunked prefill")."""
+        C = self._chunk_tokens
+        if not C or self._stopping or not self._pending:
+            return
+        n = len(self._pending[0].ids)
+        if n <= C:
+            return
+        # the final chunk pads to its suffix bucket and its cache write
+        # is a CLAMPED dynamic_update_slice (transformer.py) — if
+        # offset + bucket overhangs the cache it would shift backwards
+        # over the already-prefilled prefix.  Prompts that close to the
+        # cache cap fall back to the monolithic prefill, which always
+        # fits by submit()'s bound.
+        off = C * ((n - 1) // C)
+        if off + self._bucket(n - off) > self._dcfg.max_len:
+            return
+        slot = next((i for i, s in enumerate(self._slots)
+                     if s.free and i not in taken), None)
+        if slot is None:
+            return
+        req = self._pending.popleft()
+        if self._kv is not None:
+            # one admission, counted once at start (the reuse matcher
+            # already passed on it — this is the cold long-prompt path)
+            self._kv_misses += 1
+            self._prefill_tokens += len(req.ids)
+        self._chunking = _ChunkState(req, slot, self._fresh_cache(1), 0,
+                                     jnp.zeros((), jnp.int32))
+        with self._stats_lock:
+            self._chunked_admissions += 1
+
+    def _advance_chunk(self):
+        """Dispatch ONE chunk of the in-flight chunked admission (no
+        sync).  Mid chunks write straight into the private slab — the
+        slab's own cache_index tracks the offset, so every mid chunk of
+        one size shares one executable.  The final chunk pads to its
+        suffix bucket, samples the first token, and returns the same
+        in-flight tuple as :meth:`_dispatch_prefill`, so insert/finish/
+        commit are the shared path."""
+        st = self._chunking
+        assert st is not None
+        ids, C = st.req.ids, self._chunk_tokens
+        rest = len(ids) - st.offset
+        try:
+            if rest > C:
+                chunk = np.asarray(ids[st.offset:st.offset + C])[None, :]
+                st.slab, st.drops = self._chunk_mid_fn(C)(
+                    self._params, st.slab, jnp.asarray(chunk), st.drops)
+                st.offset += C
+                with self._stats_lock:
+                    self._prefill_chunks += 1
+                return None
+            P = self._bucket(rest)
+            tail = np.zeros((1, P), np.int32)
+            tail[0, :rest] = ids[st.offset:]
+            self._rng, key = jax.random.split(self._rng)
+            slab, toks, drops = self._chunk_final_fn(P)(
+                self._params, st.slab, jnp.asarray(tail),
+                jnp.asarray([rest], jnp.int32), st.drops, key)
+            self._chunking = None
+            with self._stats_lock:
+                self._prefill_chunks += 1
+            dslab = self._draft_slab_for(st.req) if self._spec_k else None
+            return slab, toks, drops, [st.slot], [st.req], [len(ids)], dslab
+        except Exception as e:  # noqa: BLE001 — fail THIS request only
+            logger.exception("chunked prefill failed (offset %d of %d)",
+                             st.offset, len(ids))
+            st.req.future.set_exception(e)
+            self._chunking = None
+            with self._stats_lock:
+                self._failed_requests += 1
+            return None
+
+    def _chunk_mid_fn(self, C: int):
+        """Compiled per chunk size: advance a one-lane prefill slab by
+        C prompt tokens (every token real — the only padded chunk is
+        the final one, which is a bucketed suffix prefill)."""
+        cached = self._prefill_cache.get(("chunk", C))
+        if cached is not None:
+            return cached
+        model = self._model
+
+        def mid(params, slab, ids, drops_in):
+            from edl_tpu.models.generate import _sum_drops
+            idx = self._positions(slab)           # == tokens prefilled
+            _, mut = model.apply(
+                {"params": params, "cache": slab}, ids,
+                positions=idx[:, None] + jnp.arange(C)[None, :],
+                mutable=["cache", "intermediates"])
+            return mut["cache"], drops_in + _sum_drops(
+                mut.get("intermediates"))
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sh = jax.tree.map(self._leaf_sharding, self._cache_shapes(1))
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            fn = jax.jit(mid, donate_argnums=(1,), out_shardings=(sh, rep))
+        else:
+            fn = jax.jit(mid, donate_argnums=(1,))
+        self._prefill_cache[("chunk", C)] = fn
+        return fn
+
+    def _chunk_final_fn(self, P: int):
+        """Compiled per suffix bucket: the last chunk — bucketed,
+        token-masked, sampled at the prompt's true last position."""
+        cached = self._prefill_cache.get(("chunkfin", P))
+        if cached is not None:
+            return cached
+        model = self._model
+
+        def fin(params, slab, ids, rel_lens, drops_in, key):
+            from edl_tpu.models.generate import _sum_drops
+            idx = self._positions(slab)
+            logits, mut = model.apply(
+                {"params": params, "cache": slab}, ids,
+                positions=idx[:, None] + jnp.arange(P)[None, :],
+                token_mask=jnp.arange(P)[None, :] < rel_lens[:, None],
+                mutable=["cache", "intermediates"])
+            last = jnp.take_along_axis(
+                logits, (rel_lens - 1)[:, None, None], axis=1)[:, 0]
+            toks = self._sample(last, key)
+            return (mut["cache"], toks,
+                    drops_in + _sum_drops(mut.get("intermediates")))
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sh = jax.tree.map(self._leaf_sharding, self._cache_shapes(1))
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            fn = jax.jit(fin, donate_argnums=(1,),
+                         out_shardings=(sh, rep, rep))
+        else:
+            fn = jax.jit(fin, donate_argnums=(1,))
+        self._prefill_cache[("chunkfin", P)] = fn
+        return fn
 
     # -- prefix reuse (paged KV engines only) --------------------------------
     def _next_reuse(self, taken: set[int] = frozenset()
@@ -857,8 +1380,11 @@ class ContinuousBatcher:
                 jnp.asarray([len(suffix)], jnp.int32), key)
             # insert true_lens = the FULL prompt length: the slab's
             # cache_index already sits at prefix+suffix and the pool
-            # lane must agree
-            return slab, toks, drops, [slot], [req], [len(req.ids)]
+            # lane must agree.  The draft has no pool: its slab is
+            # rebuilt from the FULL prompt in one small-model pass
+            # (draft state moves the accept rate, never correctness).
+            dslab = self._draft_slab_for(req) if self._spec_k else None
+            return slab, toks, drops, [slot], [req], [len(req.ids)], dslab
         except Exception as e:  # noqa: BLE001 — fail THIS request only
             logger.exception("reuse prefill failed (suffix bucket %d, "
                              "%d blocks)", P, n)

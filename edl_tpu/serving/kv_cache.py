@@ -44,7 +44,15 @@ Design (PagedAttention re-shaped for the engine's attention layout):
   imports into another replica's pool, deduping against blocks the
   target already holds.  ``ReplicaServer.drain()`` uses this to hand
   live conversations to an adoptive replica instead of cold-starting
-  them (doc/serving.md "Session KV migration").
+  them (doc/serving.md "Session KV migration");
+- **mesh-native** (ISSUE 20) — on a tp mesh the pool buffers shard
+  over the KV-head axis, exactly like the engine's slot slabs
+  (``ContinuousBatcher._leaf_sharding``): every shard holds the SAME
+  block ids for ITS heads, so the one host-side trie indexes all
+  shards at once and block identity stays a host concept.  The
+  gather/scatter/import jits lift through ``shard_map`` so every
+  block move is shard-local by construction — no collective can
+  appear in the pool path (doc/serving.md "Mesh-sharded paged KV").
 
 Thread model: single-writer — every mutating call runs on the engine
 thread (admission, finish-commit, import-task); ``export_chain`` runs
@@ -87,10 +95,15 @@ class PagedKVCache:
     (``{layer: {cached_key, cached_value, cache_index}}`` eval_shape
     tree) — pool layouts are derived from it so the gather/scatter jits
     line up with the slot slabs by construction.
+
+    ``mesh`` (optional) shards the pool buffers over the mesh's ``tp``
+    axis on the KV-head dim, mirroring the engine's slot-slab sharding
+    predicate per layer — every shard keeps the same block indices, so
+    the host trie / free list / pins need no changes at all.
     """
 
     def __init__(self, cache_shapes, block: int, n_blocks: int,
-                 max_sessions: int):
+                 max_sessions: int, mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -117,6 +130,16 @@ class PagedKVCache:
                     f"kv block {block} exceeds cache length {max_len}")
             self._layout[name] = (hk, d, k.dtype)
         self.max_len = max_len
+        self._mesh = mesh
+        self._tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
+        # per-layer: shard the pool over ``tp`` on the KV-head axis
+        # exactly when the engine shards that layer's slot slabs
+        # (ContinuousBatcher._leaf_sharding: axis-1 divisible by tp) —
+        # per-shard pools with IDENTICAL block ids, so a block move
+        # never crosses shards and one host trie covers every shard
+        self._layer_sharded = {
+            name: self._tp > 1 and hk % self._tp == 0
+            for name, (hk, d, _) in self._layout.items()}
         # block 0 is a reserved scratch block (never allocated) so a
         # zero-filled block-id vector can never alias live state
         self.pool = {
@@ -126,6 +149,13 @@ class PagedKVCache:
             }
             for name, (hk, d, dtype) in self._layout.items()
         }
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            self.pool = jax.device_put(self.pool, {
+                name: {ax: NamedSharding(mesh, spec)
+                       for ax, spec in node.items()}
+                for name, node in self._pool_specs().items()})
         self._free: list[int] = list(range(n_blocks - 1, 0, -1))
         self._root = _Node((), 0, None)
         self._nodes: set[_Node] = set()         # every live non-root node
@@ -305,6 +335,48 @@ class PagedKVCache:
     def blocks_free(self) -> int:
         return len(self._free)
 
+    # -- mesh sharding -------------------------------------------------------
+    def _pool_specs(self):
+        """Per-layer PartitionSpec tree for the pool's k/v buffers —
+        the shard_map in/out specs and the constructor's device_put.
+        Blocks stay whole on every shard (axis 0 unsharded); only the
+        KV-head axis splits, and only for layers the engine shards."""
+        from jax.sharding import PartitionSpec as P
+
+        return {name: {"k": P(None, "tp") if self._layer_sharded[name]
+                       else P(),
+                       "v": P(None, "tp") if self._layer_sharded[name]
+                       else P()}
+                for name in self._layers}
+
+    def _cache_specs(self):
+        """PartitionSpec tree for a full engine cache passed into the
+        scatter jit (slot slabs shard like the pool; indices are
+        replicated)."""
+        from jax.sharding import PartitionSpec as P
+
+        out = {}
+        for name in self._layers:
+            kv = P(None, "tp") if self._layer_sharded[name] else P()
+            out[name] = {"cached_key": kv, "cached_value": kv,
+                         "cache_index": P()}
+        return out
+
+    def _pool_jit(self, fn, in_specs, donate=()):
+        """jit ``fn`` over pool-shaped operands; on a mesh, lift it
+        through ``shard_map`` first so every block move is shard-local
+        by construction (per-shard pools, identical indices — the body
+        can never emit a collective).  ``check_vma=False``: the bodies
+        are all gathers/scatters by replicated indices, which the old
+        shard_map's replication checker cannot prove through."""
+        if self._mesh is None:
+            return self._jax.jit(fn, donate_argnums=donate)
+        from edl_tpu.utils.jax_compat import shard_map
+
+        wrapped = shard_map(fn, mesh=self._mesh, in_specs=in_specs,
+                            out_specs=self._pool_specs(), check_vma=False)
+        return self._jax.jit(wrapped, donate_argnums=donate)
+
     # -- jitted device ops ---------------------------------------------------
     def load_prefix_into(self, cache, pool, block_ids, n: int, prefix_len):
         """Pure helper traced INSIDE the engine's reuse-prefill jit
@@ -346,13 +418,17 @@ class PagedKVCache:
             return fn
         jax, jnp = self._jax, self._jnp
         bs = self.block
-        layers, layout = self._layers, self._layout
+        layers = self._layers
 
         def scatter(pool, cache, slot, start, block_ids):
             out = {}
             for name in layers:
-                hk, d, _ = layout[name]
+                # head/feature extents come from the OPERANDS, not the
+                # global layout: under shard_map this body sees the
+                # per-shard slice (hk/tp heads), and the slab/pool pair
+                # agree per shard by construction
                 k_lane = jnp.take(cache[name]["cached_key"], slot, axis=0)
+                hk, d = k_lane.shape[0], k_lane.shape[1]
                 k_sl = jax.lax.dynamic_slice(k_lane, (0, 0, start),
                                              (hk, d, n * bs))
                 k_blocks = jnp.moveaxis(k_sl.reshape(hk, d, n, bs), 2, 0)
@@ -366,7 +442,11 @@ class PagedKVCache:
                 }
             return out
 
-        fn = jax.jit(scatter, donate_argnums=(0,))
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._pool_jit(
+            scatter, (self._pool_specs(), self._cache_specs(),
+                      P(), P(), P()), donate=(0,))
         self._jit_cache[key] = fn
         return fn
 
@@ -394,7 +474,9 @@ class PagedKVCache:
                            "v": pool[name]["v"][block_ids]}
                     for name in layers}
 
-        fn = self._jax.jit(gather)
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._pool_jit(gather, (self._pool_specs(), P()))
         self._jit_cache[key] = fn
         return fn
 
@@ -491,7 +573,15 @@ class PagedKVCache:
             key = ("import", len(fresh))
             fn = self._jit_cache.get(key)
             if fn is None:
-                fn = self._jax.jit(put, donate_argnums=(0,))
+                from jax.sharding import PartitionSpec as P
+
+                # the upload shards like the pool (jit reshards the
+                # host arrays on the way in), so each shard writes only
+                # ITS heads of every fresh block — shape-aligned with
+                # its pool slice by construction
+                fn = self._pool_jit(
+                    put, (self._pool_specs(), P(), self._pool_specs()),
+                    donate=(0,))
                 self._jit_cache[key] = fn
             self.pool = fn(self.pool, ids, upload)
         if node is self._root:

@@ -99,11 +99,28 @@ _KV_MIGRATED = obs_metrics.counter(
 _KV_MIGRATION_SECONDS = obs_metrics.histogram(
     "edl_serving_kv_migration_seconds",
     "Wall time exporting + pushing one session chain on drain")
+_PREFILL_CHUNKS = obs_metrics.counter(
+    "edl_serving_prefill_chunks_total",
+    "Prompt chunks dispatched by chunked prefill")
+_SPEC_PROPOSED = obs_metrics.counter(
+    "edl_serving_spec_proposed_total",
+    "Draft tokens proposed by speculative decoding")
+_SPEC_ACCEPTED = obs_metrics.counter(
+    "edl_serving_spec_accepted_total",
+    "Proposed draft tokens the target's greedy verify pass accepted")
+_SPEC_ACCEPT_RATE = obs_metrics.gauge(
+    "edl_serving_spec_accept_rate",
+    "Lifetime fraction of proposed draft tokens accepted")
 
 
-def publish_engine_stats(stats: dict) -> None:
+def publish_engine_stats(stats: dict, totals: dict | None = None) -> None:
     """Mirror :meth:`ContinuousBatcher.stats` into the metrics registry
-    (the replica's /metrics page must cover the engine itself)."""
+    (the replica's /metrics page must cover the engine itself).
+
+    ``totals`` holds the last published value of every stat mirrored as
+    a Prometheus COUNTER (the engine reports lifetime totals, counters
+    take deltas).  It is caller-owned, per replica — two in-process
+    replicas sharing module state would double- or under-count."""
     _FREE_SLOTS.set(stats["slots"] - stats["active_slots"])
     _QUEUE_DEPTH.set(stats["queue_depth"])
     _PREFILL_STALL.set(stats["prefill_stall_s"])
@@ -117,6 +134,17 @@ def publish_engine_stats(stats: dict) -> None:
         _KV_SKIPPED.set(stats["kv_prefill_tokens_skipped"])
         _KV_EVICTIONS.set(stats["kv_evictions"])
         _KV_SESSIONS.set(stats["kv_sessions"])
+    if "spec_accept_rate" in stats:
+        _SPEC_ACCEPT_RATE.set(stats["spec_accept_rate"])
+    if totals is not None:
+        for key, metric in (("prefill_chunks", _PREFILL_CHUNKS),
+                            ("spec_proposed", _SPEC_PROPOSED),
+                            ("spec_accepted", _SPEC_ACCEPTED)):
+            cur = int(stats.get(key, 0))
+            delta = cur - totals.get(key, 0)
+            if delta > 0:
+                metric.inc(delta)
+            totals[key] = cur
 
 
 class ReplicaServer:
@@ -143,6 +171,7 @@ class ReplicaServer:
         self._result_ttl = result_ttl
         self._draining = False
         self._drained = threading.Event()
+        self._metric_totals: dict[str, int] = {}   # counter-mirror state
         self._import_staging: dict[str, dict] = {}   # session -> staging
         self._session_pins: dict[str, object] = {}  # session -> Register
         self._pin_misses: dict[str, int] = {}   # pruner-thread-only state
@@ -498,6 +527,9 @@ class ReplicaServer:
             payload["kv_blocks_free"] = s["kv_blocks_free"]
             payload["kv_prefix_hit_rate"] = round(
                 s["kv_prefix_hits"] / admits, 3) if admits else 0.0
+        if s.get("spec_k"):
+            payload["spec_k"] = s["spec_k"]
+            payload["spec_accept_rate"] = s["spec_accept_rate"]
         return payload
 
     def _refresh_loop(self, period: float) -> None:
@@ -508,7 +540,7 @@ class ReplicaServer:
                         json.dumps(self._payload()).encode())
                 except Exception as e:  # noqa: BLE001 — Register self-heals
                     logger.warning("advert refresh failed: %s", e)
-            publish_engine_stats(self._engine.stats())
+            publish_engine_stats(self._engine.stats(), self._metric_totals)
             self._evict_stale_results()
             self._prune_session_pins()
 
@@ -616,6 +648,25 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
                    default=constants.KV_POOL_BLOCKS,
                    help="paged-KV pool size; 0 = 2x the slot capacity "
                         "(EDL_TPU_KV_POOL_BLOCKS)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel width; > 1 builds a (dp, tp) "
+                        "mesh and shards the engine (incl. the paged KV "
+                        "pool) over it")
+    p.add_argument("--prefill_chunk", type=int,
+                   default=constants.PREFILL_CHUNK,
+                   help="chunked-prefill chunk size in tokens; 0 = "
+                        "monolithic prefills (EDL_TPU_PREFILL_CHUNK)")
+    p.add_argument("--spec_k", type=int, default=constants.SPEC_K,
+                   help="speculative-decode draft length; 0 = off "
+                        "(EDL_TPU_SPEC_K; greedy sampling only)")
+    p.add_argument("--draft_layers", type=int, default=1)
+    p.add_argument("--draft_embed", type=int, default=16)
+    p.add_argument("--draft_heads", type=int, default=2)
+    p.add_argument("--draft_mlp", type=int, default=32)
+    p.add_argument("--draft_seed", type=int, default=None,
+                   help="seeded-init draft params (default: --seed; "
+                        "matching dims + seed = a self-draft, handy for "
+                        "parity smokes)")
     args = p.parse_args(argv)
     configure()
     obs.install_from_env("replica")
@@ -650,13 +701,31 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - thin CLI
         params = TransformerLM(cfg).init(
             jax.random.key(args.seed), jnp.zeros((1, 4), jnp.int32))["params"]
 
+    mesh = None
+    if args.tp > 1:
+        from edl_tpu.parallel import MeshSpec, build_mesh
+        mesh = build_mesh(MeshSpec(dp=-1, tp=args.tp))
+    draft_cfg = draft_params = None
+    if args.spec_k > 0:
+        draft_cfg = TransformerConfig(
+            vocab_size=args.vocab, num_layers=args.draft_layers,
+            embed_dim=args.draft_embed, num_heads=args.draft_heads,
+            mlp_dim=args.draft_mlp, max_len=args.max_len,
+            remat=False, dtype=jnp.float32)
+        dseed = args.seed if args.draft_seed is None else args.draft_seed
+        draft_params = TransformerLM(draft_cfg).init(
+            jax.random.key(dseed), jnp.zeros((1, 4), jnp.int32))["params"]
     engine = ContinuousBatcher(cfg, params, slots=args.slots,
                                temperature=args.temperature,
                                top_k=args.top_k,
                                steps_per_sync=args.steps_per_sync,
                                kv_block=args.kv_block,
                                kv_pool_blocks=args.kv_pool_blocks,
-                               prefix_reuse=bool(constants.KV_REUSE))
+                               prefix_reuse=bool(constants.KV_REUSE),
+                               mesh=mesh,
+                               prefill_chunk=args.prefill_chunk,
+                               spec_k=args.spec_k, draft_cfg=draft_cfg,
+                               draft_params=draft_params)
     store = connect(args.coord_endpoints)
     # TTL-leased advert so edl-obs-agg can discover this /metrics page
     obs_advert.advertise_installed(store, args.job_id, "replica")

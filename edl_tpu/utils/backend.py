@@ -39,6 +39,29 @@ def probe_backend(timeout_s: float | None = None) -> int | None:
         return None
 
 
+def devices_or_cpu():
+    """The caller's FIRST in-process backend touch, hardened.  The
+    subprocess probe (:func:`ensure_live_backend`) catches hangs, but a
+    backend can probe alive in a fresh child and still fail to
+    *initialize* in this process (BENCH_r05: ``RuntimeError: Unable to
+    initialize backend`` at exactly ``jax.devices()``, rc=1, no
+    artifact) — catch the init error (``jax.errors.JaxRuntimeError``
+    subclasses RuntimeError), pin the CPU platform through BOTH the env
+    var and the live config, and retry so artifact-emitting entry
+    points (bench.py, serving_perf_smoke.py) always ship their one
+    JSON line."""
+    import jax
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        print(f"backend init failed ({type(e).__name__}: {e}); "
+              f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr,
+              flush=True)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def ensure_live_backend(timeout_s: float | None = None) -> int | None:
     """Probe; on hang/error force the CPU platform for THIS process so
     the caller's subsequent jax init cannot wedge.  Returns the probed

@@ -304,8 +304,10 @@ DISTILL_BACKLOG_HOLD_DEFAULT = 15.0   # EDL_TPU_DISTILL_BACKLOG_HOLD
 # pre-paged contiguous slabs (no prefix reuse, no migration).  Library
 # constructors take kv_block= directly.  ON by default since the
 # ROADMAP item 3 burn-in (ISSUE 17) — EDL_TPU_KV_BLOCK=0 is the
-# documented opt-out to contiguous slabs (mesh/tp engines still
-# construct with kv_block=0 explicitly: the pool is single-device).
+# documented opt-out to contiguous slabs.  Mesh (tp-sharded) engines
+# page too since ISSUE 20: the pool shards over the same ``tp`` axis
+# as the heads, one host-side trie indexes every shard at once
+# (doc/serving.md "Mesh-sharded paged KV").
 KV_BLOCK = int(_f("EDL_TPU_KV_BLOCK", 16))
 # pool capacity in blocks; 0 sizes it at 2x the slot pool's worth so a
 # full fleet of lanes can commit without evicting each other
@@ -316,3 +318,20 @@ KV_REUSE = int(_f("EDL_TPU_KV_REUSE", 1))
 KV_MIGRATE = int(_f("EDL_TPU_KV_MIGRATE", 1))
 # max pinned session chains per replica (LRU unpin beyond this)
 KV_SESSIONS = int(_f("EDL_TPU_KV_SESSIONS", 64))
+
+# -- serving fast path (serving/engine.py, ISSUE 20) ----------------------
+# chunked prefill: admissions whose prompt exceeds this many tokens
+# prefill in chunks of this size, ONE chunk per engine tick,
+# interleaved with decode — a long prompt costs streaming sessions one
+# chunk of stall per tick instead of one monolithic prefill (0 = off:
+# every admission prefills in one dispatch).  Library constructors
+# take prefill_chunk= directly.
+PREFILL_CHUNK = int(_f("EDL_TPU_PREFILL_CHUNK", 512))
+# speculative decoding: a draft model proposes this many tokens per
+# tick round and the target verifies them in ONE multi-token pass;
+# greedy acceptance keeps outputs bit-identical to plain decode, so
+# this is a pure latency knob (0 = off; greedy engines only — the
+# constructor rejects spec_k > 0 with temperature > 0).  The replica
+# CLI builds a seeded draft from the --draft_* args; library
+# constructors pass draft_cfg/draft_params.
+SPEC_K = int(_f("EDL_TPU_SPEC_K", 0))

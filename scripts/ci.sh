@@ -182,6 +182,13 @@ JAX_PLATFORMS=cpu python scripts/transfer_smoke.py
 # a producer mid-epoch — must audit exactly-once
 JAX_PLATFORMS=cpu python scripts/data_throughput_smoke.py
 
+# serving perf smoke: the big-model fast path — a tp=2 CPU-mesh
+# replica with the sharded paged pool + chunked prefill + self-draft
+# speculation behind a real gateway (mixed traffic, bit-exact), the
+# chunked starvation bound (warm-short p99 within 2x of monolithic),
+# and 100+ prompts bit-identical spec vs plain greedy
+JAX_PLATFORMS=cpu python scripts/serving_perf_smoke.py
+
 # bench smoke: the driver's bench entry must always produce its JSON
 # line (tiny CPU knobs; LM/pipeline sections skipped off-TPU).  bench
 # now exits 0 even on failure (partial-artifact contract), so CI must
@@ -190,6 +197,8 @@ EDL_TPU_BENCH_SIZE=32 EDL_TPU_BENCH_BS=4 EDL_TPU_BENCH_STEPS=2 \
 EDL_TPU_BENCH_WIDTH=8 EDL_TPU_BENCH_PIPELINE=0 EDL_TPU_BENCH_LM=0 \
 EDL_TPU_BENCH_MEMSTATE_MB=8 EDL_TPU_BENCH_TRANSFER_MB=8 \
 EDL_TPU_BENCH_DELIVERY_FILES=2 EDL_TPU_BENCH_DELIVERY_RECORDS=96 \
+EDL_TPU_BENCH_SERVING_REQS=6 EDL_TPU_BENCH_SERVING_LONG=96 \
+EDL_TPU_BENCH_SERVING_CHUNK=16 \
 JAX_PLATFORMS=cpu python bench.py | tail -1 \
     | python -c "
 import json, sys
@@ -232,6 +241,13 @@ pw, pc = out['serving_prefix_tokens_s'], out['serving_cold_tokens_s']
 assert pw >= pc, (pw, pc)
 assert out['serving_prefill_skipped_frac'] > 0.5, out
 assert out.get('serving_kv_migration_ms') is not None, out
+# serving fast path (ISSUE 20): the mesh throughput, chunked-prefill
+# p99, and spec accept-rate sections must land in the artifact, and
+# the self-draft spec run must accept near-everything (bit-exactness
+# itself is gated by tests + serving_perf_smoke)
+assert out.get('serving_mesh_tokens_s'), out
+assert out.get('serving_prefill_p99_ms') is not None, out
+assert out['serving_spec_accept_rate'] > 0.9, out
 # distill fleet elasticity (ISSUE 18): three teachers must beat one on
 # the same slow-teacher stream (routing/fan-out actually helps), and a
 # published backlog record must step the autoscaler's target promptly

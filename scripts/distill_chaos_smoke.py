@@ -244,6 +244,81 @@ def _student_gen():
     return gen
 
 
+def _lm_teacher_phase(store):
+    """ISSUE 20 / ROADMAP item 4 residual: a TeacherReplica serving a
+    PAGED LM engine.  Every distillation batch carries the same system
+    prompt, so after the first row commits its chain the rest must
+    admit through prefix reuse — asserted via the engine's
+    kv_prefix_hits AND via the advert payload (the extra_stats hook),
+    with every returned row bit-identical to generate() greedy."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.distill.fleet import TeacherReplica
+    from edl_tpu.distill.predict_client import TeacherClient
+    from edl_tpu.distill.teacher import TeacherServer, lm_teacher
+    from edl_tpu.gateway.fleet import list_replicas
+    from edl_tpu.models.generate import generate
+    from edl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from edl_tpu.serving import ContinuousBatcher
+
+    max_new = 4
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, embed_dim=32,
+                            num_heads=4, mlp_dim=64, max_len=128,
+                            remat=False, dtype=jnp.float32)
+    params = TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    rng = np.random.default_rng(11)
+    system = rng.integers(1, 61, (24,)).astype(np.int32)
+    tails = [rng.integers(1, 61, (4,)).astype(np.int32) for _ in range(6)]
+    prompts = [np.concatenate([system, t]) for t in tails]
+
+    engine = ContinuousBatcher(cfg, params, slots=4, temperature=0.0,
+                               steps_per_sync=2, kv_block=8,
+                               prefill_buckets=(8, 16, 32))
+    server = replica = client = None
+    try:
+        server = TeacherServer(
+            lm_teacher(engine, max_new=max_new), port=0,
+            extra_stats=lambda: {f"engine_{k}": v
+                                 for k, v in engine.stats().items()})
+        replica = TeacherReplica(store, "teach-lm", server, "lm-svc",
+                                 replica_id="lm-t1", ttl=5.0,
+                                 advert_period=0.25)
+        client = TeacherClient(server.endpoint, fetch=["tokens"])
+        # two batches: the first's lead row commits the system-prompt
+        # chain, everything after rides it
+        ids = np.zeros((len(prompts), len(prompts[0])), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i] = p
+        lens = np.full((len(prompts),), len(prompts[0]), np.int32)
+        got = [client.predict({"ids": ids[:3], "lens": lens[:3]}),
+               client.predict({"ids": ids[3:], "lens": lens[3:]})]
+        toks = np.concatenate([g["tokens"] for g in got])
+        for p, row in zip(prompts, toks):
+            want = np.asarray(generate(cfg, params, jnp.asarray(p[None]),
+                                       max_new, temperature=0.0))[0]
+            np.testing.assert_array_equal(row[:len(want)], want)
+        st = engine.stats()
+        assert st["kv_prefix_hits"] > 0, st
+        _wait(lambda: list_replicas(store, "teach-lm").get(
+            "lm-t1", {}).get("engine_kv_prefix_hits", 0) > 0, 30,
+            "the kv hit rate to ride the teacher advert")
+        print(f"smoke 0: KV-aware LM teacher — {st['kv_prefix_hits']} of "
+              f"{len(prompts)} admissions rode the shared system prompt "
+              f"({st['kv_prefill_tokens_skipped']} prefill tokens "
+              f"skipped), outputs greedy-exact, hit rate on the advert")
+    finally:
+        if client is not None:
+            client.close()
+        if replica is not None:
+            replica.stop()
+        elif server is not None:
+            server.stop()
+        engine.stop()
+
+
 def main() -> None:
     import numpy as np
 
@@ -268,6 +343,8 @@ def main() -> None:
 
     agg_srv, ctl, fleet = None, None, None
     try:
+        _lm_teacher_phase(store)
+
         # -- boot the three job kinds ------------------------------------
         scale_mod.save_job_spec(store, "train", kind="training")
         scale_mod.save_job_spec(store, "svc", kind="serving")

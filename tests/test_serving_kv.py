@@ -245,10 +245,26 @@ def test_reuse_off_still_commits_for_migration(small):
     assert stats["kv_blocks_used"] > 0
 
 
-def test_mesh_engine_refuses_paging(small):
+def test_mesh_engine_accepts_paging_bit_exact(small):
+    """ISSUE 20 flipped the old refusal: a tp>1 engine now pages by
+    sharding the pool over the head axis (one shared host trie, every
+    pool op lifted through shard_map) — shared-prefix traffic on a mesh
+    engine must hit the trie AND stay bit-identical to generate()."""
     from edl_tpu.parallel import MeshSpec, build_mesh
 
     cfg, params = small
     mesh = build_mesh(MeshSpec(dp=-1, tp=2))
-    with pytest.raises(ValueError, match="mesh"):
-        ContinuousBatcher(cfg, params, slots=2, mesh=mesh, kv_block=4)
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, 97, (12,)).astype(np.int32)
+    prompts = [np.concatenate([prefix,
+                               rng.integers(1, 97, (n,)).astype(np.int32)])
+               for n in (3, 6, 2)]
+    eng = _engine(cfg, params, slots=2, mesh=mesh)
+    try:
+        outs = [eng.generate(p, 5, timeout=120) for p in prompts]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    for p, out in zip(prompts, outs):
+        np.testing.assert_array_equal(out, _want(cfg, params, p, 5))
+    assert stats["kv_prefix_hits"] >= len(prompts) - 1, stats
